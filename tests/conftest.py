@@ -1,0 +1,30 @@
+"""Test configuration: run every test against 8 virtual CPU devices.
+
+This is the TPU-native analogue of testing torch SyncBN on the ``gloo``
+CPU backend (the reference stack's CPU path at
+``[torch] nn/modules/_functions.py:64-86`` exists for exactly this):
+``--xla_force_host_platform_device_count=8`` gives JAX eight host "devices"
+in one process, so every collective (psum/pmean/all_gather over the mesh)
+executes for real under pytest without TPU hardware.
+
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # hard override: env may pre-select the TPU tunnel
+
+import jax  # noqa: E402
+
+# A pytest plugin may import jax before this conftest runs, caching
+# jax_platforms from the ambient env (which points at the TPU tunnel).
+# Backend init is lazy, so overriding the config here still wins.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+
+def pytest_report_header(config):
+    return f"jax devices: {jax.device_count()} ({jax.default_backend()})"

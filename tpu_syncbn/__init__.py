@@ -1,0 +1,32 @@
+"""tpu-syncbn: a TPU-native data-parallel training framework with
+synchronized BatchNorm.
+
+A ground-up JAX/XLA/Pallas rebuild of the capability surface of
+``dougsouza/pytorch-sync-batchnorm-example`` (reference ``README.md:1-104``):
+multi-replica data-parallel training in which per-channel BatchNorm statistics
+are reduced across *all* replicas each step, so that small per-chip batches
+(object detection, GANs — reference ``README.md:3``) normalize against the
+true global batch.
+
+The reference's six-step recipe maps onto this package as:
+
+==============================================  ================================
+Reference step (README.md line)                  tpu-syncbn equivalent
+==============================================  ================================
+``--local_rank`` arg parsing (11-19)            none needed: single program,
+                                                ``runtime.process_index()``
+``torch.cuda.set_device`` +                     ``runtime.initialize()`` —
+``init_process_group('nccl','env://')``         slice-metadata discovery, mesh
+(22-36)                                         over ICI/DCN
+``convert_sync_batchnorm`` (40-45)              ``nn.convert_sync_batchnorm``
+``DistributedDataParallel`` wrap (62-72)        ``parallel.DataParallel`` /
+                                                ``parallel.make_train_step``
+``DistributedSampler`` + ``DataLoader``         ``data.DistributedSampler`` +
+(74-92)                                         ``data.DataLoader``
+``torch.distributed.launch`` (94-103)           ``python -m tpu_syncbn.launch``
+==============================================  ================================
+"""
+
+__version__ = "0.1.0"
+
+from tpu_syncbn import runtime, parallel, ops, nn, models, data, utils  # noqa: F401

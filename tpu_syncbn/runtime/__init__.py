@@ -1,0 +1,37 @@
+"""Process & device runtime: the TPU-native equivalent of the reference's
+L0/L1 layers (launcher env contract + rank→device binding + rendezvous;
+reference ``README.md:11-36, 94-103``)."""
+
+from tpu_syncbn.runtime.distributed import (
+    initialize,
+    is_initialized,
+    shutdown,
+    process_index,
+    process_count,
+    local_device_count,
+    global_device_count,
+    is_master,
+    master_print,
+    get_logger,
+    data_parallel_mesh,
+    make_mesh,
+    barrier,
+    DistributedConfig,
+)
+
+__all__ = [
+    "initialize",
+    "is_initialized",
+    "shutdown",
+    "process_index",
+    "process_count",
+    "local_device_count",
+    "global_device_count",
+    "is_master",
+    "master_print",
+    "get_logger",
+    "data_parallel_mesh",
+    "make_mesh",
+    "barrier",
+    "DistributedConfig",
+]

@@ -1,0 +1,290 @@
+"""Distributed runtime: initialization, device mesh construction, process
+identity, and rank-0 conventions.
+
+This module is the TPU-native replacement for the reference recipe's entire
+process/rendezvous stack (reference ``README.md:22-36``):
+
+* ``argparse --local_rank`` (``README.md:11-19``) — not needed. TPU training
+  is single-program multi-device: one Python process per *host*, all chips
+  driven from it. Process identity comes from the TPU slice metadata via
+  :func:`process_index`, not from a launcher-injected CLI argument.
+* ``torch.cuda.set_device(local_rank)`` (``README.md:27``) — not needed.
+  Each host process owns its local chips implicitly from slice topology.
+* ``init_process_group('nccl', init_method='env://', world_size, rank)``
+  (``README.md:29-35``) — replaced by :func:`initialize`, which (on
+  multi-host) calls ``jax.distributed.initialize`` to join the slice's
+  coordination service, then builds a :class:`jax.sharding.Mesh` over all
+  chips. Collectives become XLA AllReduce/AllGather HLOs over ICI/DCN
+  instead of runtime-issued NCCL calls.
+* rank-0 "master" logging convention (``README.md:9``) — :func:`is_master` /
+  :func:`master_print`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import sys
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_loggers: dict[str, logging.Logger] = {}
+_initialized: bool = False
+_jax_distributed_active: bool = False
+
+#: Name of the data-parallel mesh axis used throughout the framework. The
+#: reference's "process group" of N single-GPU processes (README.md:5)
+#: becomes this one named axis spanning every chip in the slice.
+DATA_AXIS = "data"
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedConfig:
+    """Explicit multi-host wiring, mirroring the env contract the reference's
+    launcher sets (``MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE``; reference
+    ``README.md:32-35`` reads them via ``init_method='env://'``).
+
+    All fields default to ``None`` meaning "autodetect from the environment"
+    — on a real TPU slice, ``jax.distributed.initialize`` discovers
+    everything from slice metadata and none of this is needed.
+    """
+
+    coordinator_address: str | None = None  # MASTER_ADDR:MASTER_PORT analogue
+    num_processes: int | None = None        # WORLD_SIZE analogue (hosts, not chips)
+    process_id: int | None = None           # RANK analogue
+
+    @staticmethod
+    def from_env() -> "DistributedConfig":
+        """Read the reference-compatible env contract if present.
+
+        Honors both our names (``TPU_SYNCBN_COORDINATOR`` etc.) and the
+        reference's torchrun names (``MASTER_ADDR``/``MASTER_PORT``/``RANK``/
+        ``WORLD_SIZE``; documented in the reference at ``README.md:32-35``)
+        so scripts written against the recipe's env contract keep working.
+        """
+        addr = os.environ.get("TPU_SYNCBN_COORDINATOR")
+        if addr is None and "MASTER_ADDR" in os.environ:
+            port = os.environ.get("MASTER_PORT", "12355")
+            addr = f"{os.environ['MASTER_ADDR']}:{port}"
+        nproc = os.environ.get("TPU_SYNCBN_NUM_PROCESSES", os.environ.get("WORLD_SIZE"))
+        pid = os.environ.get("TPU_SYNCBN_PROCESS_ID", os.environ.get("RANK"))
+        return DistributedConfig(
+            coordinator_address=addr,
+            num_processes=int(nproc) if nproc is not None else None,
+            process_id=int(pid) if pid is not None else None,
+        )
+
+
+def initialize(config: DistributedConfig | None = None) -> None:
+    """Join the distributed job. One call replaces the reference's step 1+2
+    (``--local_rank`` parse, ``cuda.set_device``, ``init_process_group``;
+    ``README.md:11-36``).
+
+    Single-host (including the 1-chip and forced-host-device test cases):
+    a no-op beyond marking the runtime initialized — JAX already sees all
+    local devices.
+
+    Multi-host: calls ``jax.distributed.initialize``, which performs the
+    rendezvous the reference does through ``env://`` + TCPStore
+    (``[torch] distributed/distributed_c10d.py:1889``) but against the TPU
+    coordination service. On a Cloud TPU slice all arguments are discovered
+    from slice metadata and ``config`` may be ``None``.
+    """
+    global _initialized, _jax_distributed_active
+    if _initialized:
+        return
+    if config is None:
+        config = DistributedConfig.from_env()
+    # A coordinator address alone (e.g. a stale MASTER_ADDR export from an
+    # old GPU script) must not force the multi-host path: require an actual
+    # world size > 1.
+    multi_host = (config.num_processes or 1) > 1 or (
+        os.environ.get("TPU_SYNCBN_FORCE_DIST") == "1"
+    )
+    if multi_host:
+        jax.distributed.initialize(
+            coordinator_address=config.coordinator_address,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+        )
+        _jax_distributed_active = True
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    """Analogue of ``torch.distributed.is_initialized`` (consulted by the
+    reference's SyncBN sync-or-fallback check,
+    ``[torch] nn/modules/batchnorm.py:837-860``)."""
+    return _initialized
+
+
+def shutdown() -> None:
+    """Tear down the coordination client (tests / clean exit)."""
+    global _initialized, _jax_distributed_active
+    if _jax_distributed_active:
+        jax.distributed.shutdown()
+        _jax_distributed_active = False
+    _initialized = False
+    _loggers.clear()
+    _barrier_cache.clear()
+
+
+def process_index() -> int:
+    """This host's index — the analogue of the recipe's ``RANK`` env var
+    (``README.md:34``), except it indexes *hosts*, not chips: TPU is one
+    process per host, many chips per process."""
+    return jax.process_index()
+
+
+def process_count() -> int:
+    """Number of host processes — analogue of ``WORLD_SIZE`` (``README.md:33``)
+    at host granularity."""
+    return jax.process_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def global_device_count() -> int:
+    """Total chips in the slice: the true replica count for data parallelism
+    (what the reference calls ``world_size`` = ``nproc_per_node`` × nodes,
+    ``README.md:96-100``)."""
+    return jax.device_count()
+
+
+def is_master() -> bool:
+    """True on the rank-0 host. The reference's convention: "print losses and
+    stuff to the console only on the master process" (``README.md:9``)."""
+    return jax.process_index() == 0
+
+
+def master_print(*args, **kwargs) -> None:
+    """``print`` gated to the master host (``README.md:9``)."""
+    if is_master():
+        print(*args, **kwargs)
+        sys.stdout.flush()
+
+
+class _MasterOnlyFilter(logging.Filter):
+    """Drops sub-WARNING records on non-master hosts, deciding at *emit*
+    time so master-ness is never frozen before ``initialize()`` has run
+    (``jax.process_index`` is only consulted once a record is logged)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        return record.levelno >= logging.WARNING or is_master()
+
+
+def get_logger(name: str = "tpu_syncbn") -> logging.Logger:
+    """A logger that emits on the master host only and is silenced (WARNING+)
+    elsewhere — the structured version of the rank-0 print convention
+    (``README.md:9``)."""
+    global _loggers
+    if name not in _loggers:
+        logger = logging.getLogger(name)
+        if not logger.handlers:
+            handler = logging.StreamHandler(sys.stdout)
+            handler.setFormatter(
+                logging.Formatter(
+                    "%(asctime)s [%(levelname)s %(name)s] %(message)s",
+                    datefmt="%H:%M:%S",
+                )
+            )
+            logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.addFilter(_MasterOnlyFilter())
+        logger.propagate = False
+        _loggers[name] = logger
+    return _loggers[name]
+
+
+def make_mesh(
+    axis_sizes: Mapping[str, int] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a named device mesh over the slice.
+
+    With ``axis_sizes=None`` (the common case) this returns the pure
+    data-parallel mesh: one ``'data'`` axis spanning every chip — the
+    TPU-native form of the reference's process group of N single-GPU
+    replicas (``README.md:5, 96-100``). Arbitrary extra axes (``'model'``
+    etc.) may be requested; a size of ``-1`` on at most one axis means
+    "everything left", like a reshape wildcard.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if axis_sizes is None:
+        axis_sizes = {DATA_AXIS: n}
+    names = tuple(axis_sizes.keys())
+    sizes = list(axis_sizes.values())
+    if any(s != -1 and s < 1 for s in sizes):
+        raise ValueError(f"mesh axis sizes must be positive (or -1): {axis_sizes}")
+    wild = [i for i, s in enumerate(sizes) if s == -1]
+    if len(wild) > 1:
+        raise ValueError("at most one mesh axis may have size -1")
+    if wild:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by fixed axes {axis_sizes}")
+        sizes[wild[0]] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(
+            f"mesh axes {dict(zip(names, sizes))} do not cover {n} devices"
+        )
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def data_parallel_mesh(num_replicas: int | None = None) -> Mesh:
+    """The framework's default mesh: ``('data',)`` over all chips (or the
+    first ``num_replicas`` chips, for tests that model a smaller world)."""
+    devices = jax.devices()
+    if num_replicas is not None:
+        if num_replicas > len(devices):
+            raise ValueError(
+                f"requested {num_replicas} replicas but only "
+                f"{len(devices)} devices are present"
+            )
+        devices = devices[:num_replicas]
+    return make_mesh({DATA_AXIS: len(devices)}, devices=devices)
+
+
+_barrier_cache: dict = {}
+
+
+def barrier(name: str = "barrier") -> None:
+    """Block until every replica reaches this point.
+
+    The reference gets barriers implicitly from blocking NCCL collectives.
+    Here: multi-host uses the coordination-service barrier
+    (``multihost_utils.sync_global_devices``); single-host runs a cached,
+    jit-compiled sum over a local-device-sharded array and blocks on it,
+    forcing a cross-device AllReduce to complete. The jitted fn and mesh
+    are cached so repeated barriers don't retrace.
+    """
+    if process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+        return
+    key = tuple(jax.local_devices())
+    if key not in _barrier_cache:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = make_mesh({DATA_AXIS: len(key)}, devices=key)
+        fn = jax.jit(
+            jax.numpy.sum, out_shardings=NamedSharding(mesh, P())
+        )
+        _barrier_cache[key] = (mesh, fn)
+    mesh, fn = _barrier_cache[key]
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ones = jax.numpy.ones((len(key),), dtype=jax.numpy.int32)
+    sharded = jax.device_put(ones, NamedSharding(mesh, P(DATA_AXIS)))
+    fn(sharded).block_until_ready()
