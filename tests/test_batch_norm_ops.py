@@ -1,0 +1,155 @@
+"""Numerical parity of the functional BN ops against torch.nn.BatchNorm2d
+(the reference stack's semantics oracle — SURVEY §4 pins these as the
+secondary tests: momentum=None cumulative mode, biased/unbiased split,
+eval fallback, masked/uneven counts)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tpu_syncbn.ops import batch_norm as ops
+
+B, C, H, W = 4, 6, 5, 3
+
+
+def make_torch_bn(momentum, affine=True):
+    torch.manual_seed(0)
+    bn = torch.nn.BatchNorm2d(C, momentum=momentum, affine=affine)
+    if affine:
+        with torch.no_grad():
+            bn.weight.uniform_(0.5, 1.5)
+            bn.bias.uniform_(-0.5, 0.5)
+    return bn
+
+
+def rand_x(seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(B, C, H, W) * 2 + 0.7).astype(np.float32)
+
+
+def to_nhwc(x):
+    return jnp.asarray(np.transpose(x, (0, 2, 3, 1)))
+
+
+def from_nhwc(y):
+    return np.transpose(np.asarray(y), (0, 3, 1, 2))
+
+
+@pytest.mark.parametrize("momentum", [0.1, 0.3, None])
+def test_train_forward_and_running_stats_parity(momentum):
+    bn = make_torch_bn(momentum)
+    w = jnp.asarray(bn.weight.detach().numpy())
+    b = jnp.asarray(bn.bias.detach().numpy())
+    rm = jnp.zeros(C)
+    rv = jnp.ones(C)
+    nbt = jnp.zeros((), jnp.int32)
+
+    for step in range(3):
+        x = rand_x(step)
+        yt = bn(torch.from_numpy(x))
+        y, (rm, rv, nbt) = ops.batch_norm_train(
+            to_nhwc(x), rm, rv, nbt, w, b, momentum=momentum, eps=bn.eps
+        )
+        np.testing.assert_allclose(
+            from_nhwc(y), yt.detach().numpy(), rtol=1e-4, atol=1e-5
+        )
+    np.testing.assert_allclose(
+        np.asarray(rm), bn.running_mean.numpy(), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(rv), bn.running_var.numpy(), rtol=1e-5, atol=1e-6
+    )
+    assert int(nbt) == int(bn.num_batches_tracked) == 3
+
+
+def test_eval_parity():
+    bn = make_torch_bn(0.1)
+    x = rand_x(0)
+    bn(torch.from_numpy(x))  # one train step to move running stats
+    bn.eval()
+    x2 = rand_x(1)
+    yt = bn(torch.from_numpy(x2)).detach().numpy()
+    y = ops.batch_norm_inference(
+        to_nhwc(x2),
+        jnp.asarray(bn.running_mean.numpy()),
+        jnp.asarray(bn.running_var.numpy()),
+        jnp.asarray(bn.weight.detach().numpy()),
+        jnp.asarray(bn.bias.detach().numpy()),
+        eps=bn.eps,
+    )
+    np.testing.assert_allclose(from_nhwc(y), yt, rtol=1e-4, atol=1e-5)
+
+
+def test_no_affine_no_tracking():
+    bn = torch.nn.BatchNorm2d(C, affine=False, track_running_stats=False)
+    x = rand_x(2)
+    yt = bn(torch.from_numpy(x)).detach().numpy()
+    y, stats = ops.batch_norm_train(to_nhwc(x), None, None, None, None, None)
+    assert stats == (None, None, None)
+    np.testing.assert_allclose(from_nhwc(y), yt, rtol=1e-4, atol=1e-5)
+
+
+def test_gradient_parity():
+    """d(loss)/dx, dw, db must match torch autograd through training BN."""
+    bn = make_torch_bn(0.1)
+    x = rand_x(3)
+    xt = torch.from_numpy(x).requires_grad_(True)
+    yt = bn(xt)
+    loss_t = (yt * torch.arange(yt.numel()).float().reshape(yt.shape) / yt.numel()).sum()
+    loss_t.backward()
+
+    w = jnp.asarray(bn.weight.detach().numpy())
+    b = jnp.asarray(bn.bias.detach().numpy())
+    coeff = jnp.asarray(
+        np.arange(x.size, dtype=np.float32).reshape(B, C, H, W) / x.size
+    )
+
+    def loss_fn(xj, wj, bj):
+        y, _ = ops.batch_norm_train(
+            xj, jnp.zeros(C), jnp.ones(C), jnp.zeros((), jnp.int32), wj, bj,
+            momentum=0.1, eps=bn.eps,
+        )
+        return jnp.sum(y * to_nhwc(np.asarray(coeff)))
+
+    gx, gw, gb = jax.grad(loss_fn, argnums=(0, 1, 2))(to_nhwc(x), w, b)
+    np.testing.assert_allclose(from_nhwc(gx), xt.grad.numpy(), rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw), bn.weight.grad.numpy(), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gb), bn.bias.grad.numpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_masked_moments_match_subset():
+    """Masked stats must equal stats of the valid subset (uneven-shard path)."""
+    x = rand_x(4)
+    xj = to_nhwc(x)  # (B,H,W,C)
+    valid = 2  # only first 2 batch elements valid
+    mask = (jnp.arange(B) < valid).astype(jnp.float32)[:, None, None, None]
+    mean, var, count = ops.sync_moments(xj, mask=mask)
+    sub = np.transpose(x[:valid], (0, 2, 3, 1)).reshape(-1, C)
+    np.testing.assert_allclose(np.asarray(mean), sub.mean(0), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(var), sub.var(0), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(count), np.full(C, sub.shape[0]))
+
+
+def test_nchw_channel_axis():
+    """channel_axis=1 (NCHW) gives identical results to NHWC."""
+    x = rand_x(5)
+    y_nchw, _ = ops.batch_norm_train(
+        jnp.asarray(x), None, None, None, None, None, channel_axis=1
+    )
+    y_nhwc, _ = ops.batch_norm_train(to_nhwc(x), None, None, None, None, None)
+    np.testing.assert_allclose(
+        np.asarray(y_nchw), from_nhwc(y_nhwc), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_bf16_input_f32_accumulation():
+    x = rand_x(6).astype(np.float32)
+    xbf = to_nhwc(x).astype(jnp.bfloat16)
+    y, _ = ops.batch_norm_train(xbf, None, None, None, None, None)
+    assert y.dtype == jnp.bfloat16
+    yf, _ = ops.batch_norm_train(to_nhwc(x), None, None, None, None, None)
+    np.testing.assert_allclose(
+        np.asarray(y, dtype=np.float32), np.asarray(yf), rtol=0.1, atol=0.1
+    )

@@ -1,1 +1,22 @@
-"""placeholder"""
+"""Numerical ops: functional batch-norm kernels (XLA-fused reference path;
+Pallas TPU fast path in pallas_bn)."""
+
+from tpu_syncbn.ops.batch_norm import (
+    batch_norm_stats,
+    moments_from_stats,
+    sync_moments,
+    batch_norm_elemt,
+    update_running_stats,
+    batch_norm_train,
+    batch_norm_inference,
+)
+
+__all__ = [
+    "batch_norm_stats",
+    "moments_from_stats",
+    "sync_moments",
+    "batch_norm_elemt",
+    "update_running_stats",
+    "batch_norm_train",
+    "batch_norm_inference",
+]

@@ -1,1 +1,32 @@
-"""placeholder"""
+"""Parallelism layer: collectives over mesh axes and data-parallel training
+utilities (the reference's L2+L3: NCCL process group + DDP wrapper)."""
+
+from tpu_syncbn.parallel.collectives import (
+    axis_index,
+    axis_size,
+    psum,
+    pmean,
+    pmax,
+    pmin,
+    all_gather,
+    broadcast,
+    ppermute,
+    all_to_all,
+    reduce_scatter,
+    reduce_moments,
+)
+
+__all__ = [
+    "axis_index",
+    "axis_size",
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "all_gather",
+    "broadcast",
+    "ppermute",
+    "all_to_all",
+    "reduce_scatter",
+    "reduce_moments",
+]

@@ -1,0 +1,191 @@
+"""Collective communication over mesh axes — the TPU-native replacement for
+the reference stack's NCCL process-group layer.
+
+The reference recipe's collectives (reference ``README.md:29-35`` selects the
+``'nccl'`` backend; the ops its stack actually issues are pinned in SURVEY §5.8):
+
+* ``all_gather(_single)`` — SyncBN forward stats exchange
+  (``[torch] nn/modules/_functions.py:74-86``)
+* ``all_reduce(SUM)`` — SyncBN backward (``:160-165``) + DDP gradient buckets
+* ``broadcast`` — DDP init-time parameter sync
+  (``[torch] nn/parallel/distributed.py:1066-1072``)
+
+Here each op is a thin wrapper over ``jax.lax`` named-axis collectives, legal
+inside any ``shard_map``/``pmap``-traced function over a mesh axis. XLA lowers
+them to AllReduce/AllGather/CollectivePermute HLOs scheduled over ICI/DCN —
+compiler-scheduled rather than runtime-issued, which subsumes NCCL stream
+management and DDP's bucketing/overlap machinery (the latency-hiding
+scheduler overlaps them with compute automatically).
+
+Also hosts :func:`reduce_moments` — the count-weighted cross-replica moment
+reduction that is the numerical core of SyncBatchNorm (the TPU-native
+equivalent of ``batch_norm_gather_stats_with_counts``,
+``[torch] nn/modules/_functions.py:106-115``): replicas contribute
+(sum, sumsq, count) and receive exact global (mean, biased var, count),
+correct for uneven/empty shards.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_syncbn.runtime.distributed import DATA_AXIS
+
+Pytree = Any
+
+
+def axis_size(axis_name: str = DATA_AXIS) -> int:
+    """World size along a mesh axis — the reference's ``world_size``
+    (``README.md:33``), available inside the compiled step."""
+    return lax.axis_size(axis_name)
+
+
+def axis_index(axis_name: str = DATA_AXIS) -> jax.Array:
+    """This replica's index along a mesh axis — the reference's ``rank``
+    (``README.md:34``), as a traced scalar."""
+    return lax.axis_index(axis_name)
+
+
+def psum(tree: Pytree, axis_name: str = DATA_AXIS) -> Pytree:
+    """Sum every leaf across the axis: ``dist.all_reduce(SUM)``
+    (as used by SyncBN backward, ``[torch] nn/modules/_functions.py:160-165``)."""
+    return lax.psum(tree, axis_name)
+
+
+def pmean(tree: Pytree, axis_name: str = DATA_AXIS) -> Pytree:
+    """Mean every leaf across the axis — all_reduce followed by the divide
+    DDP's reducer applies to gradients (``[torch] nn/parallel/distributed.py``
+    Reducer grad averaging)."""
+    return lax.pmean(tree, axis_name)
+
+
+def pmax(tree: Pytree, axis_name: str = DATA_AXIS) -> Pytree:
+    """Elementwise max across the axis (all_reduce(MAX))."""
+    return lax.pmax(tree, axis_name)
+
+
+def pmin(tree: Pytree, axis_name: str = DATA_AXIS) -> Pytree:
+    """Elementwise min across the axis (all_reduce(MIN))."""
+    return lax.pmin(tree, axis_name)
+
+
+def all_gather(
+    tree: Pytree,
+    axis_name: str = DATA_AXIS,
+    *,
+    axis: int = 0,
+    tiled: bool = False,
+) -> Pytree:
+    """Gather every replica's leaf along a new (or tiled) leading axis:
+    ``dist.all_gather_into_tensor`` (SyncBN forward stats exchange,
+    ``[torch] nn/modules/_functions.py:74-77``)."""
+    return lax.all_gather(tree, axis_name, axis=axis, tiled=tiled)
+
+
+def broadcast(tree: Pytree, src: int = 0, axis_name: str = DATA_AXIS) -> Pytree:
+    """Every replica receives replica ``src``'s value: ``dist.broadcast``
+    (DDP init-time param/buffer sync from rank 0,
+    ``[torch] nn/parallel/distributed.py:1066-1072``).
+
+    SPMD formulation: gather all replicas' values and select ``src``'s.
+    XLA folds the gather+index; for the init-time use the cost is a one-off.
+    """
+    size = lax.axis_size(axis_name)  # static at trace time
+    if not -size <= src < size:
+        raise ValueError(
+            f"broadcast src={src} out of range for axis {axis_name!r} of size {size}"
+        )
+    src = src % size
+    # psum of the masked value: no world_size× gather buffer, one AllReduce.
+    is_src = lax.axis_index(axis_name) == src
+
+    def one(x):
+        return lax.psum(jnp.where(is_src, x, jnp.zeros_like(x)), axis_name)
+
+    return jax.tree_util.tree_map(one, tree)
+
+
+def ppermute(
+    tree: Pytree, perm: list[tuple[int, int]], axis_name: str = DATA_AXIS
+) -> Pytree:
+    """Point-to-point ring/permutation sends (CollectivePermute over ICI).
+    No reference analogue in the recipe; exposed for ring-style algorithms."""
+    return lax.ppermute(tree, axis_name, perm)
+
+
+def all_to_all(
+    tree: Pytree,
+    axis_name: str = DATA_AXIS,
+    *,
+    split_axis: int = 0,
+    concat_axis: int = 0,
+    tiled: bool = True,
+) -> Pytree:
+    """All-to-all resharding (sequence/expert-parallel building block).
+    Not used by the reference recipe; exposed as the mesh-ready extension
+    point SURVEY §2 calls for."""
+    return lax.all_to_all(
+        tree, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled
+    )
+
+
+def reduce_scatter(
+    x: jax.Array, axis_name: str = DATA_AXIS, *, scatter_dimension: int = 0
+) -> jax.Array:
+    """Sum across the axis, then shard the result along ``scatter_dimension``
+    (ReduceScatter HLO). The building block for ZeRO-style sharded optimizer
+    states (out of reference scope, SURVEY §2, but mesh-ready)."""
+    return lax.psum_scatter(
+        x, axis_name, scatter_dimension=scatter_dimension, tiled=True
+    )
+
+
+def reduce_moments(
+    local_sum: jax.Array,
+    local_sumsq: jax.Array,
+    local_count: jax.Array,
+    axis_name: str = DATA_AXIS,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Count-weighted global moments from per-replica partial sums.
+
+    The numerical heart of SyncBatchNorm. The reference all_gathers per-rank
+    ``[mean, invstd, count]`` and recombines with
+    ``batch_norm_gather_stats_with_counts``
+    (``[torch] nn/modules/_functions.py:41-115``) precisely because shards
+    may be uneven or empty (``:50-57``). Summing raw (sum, sumsq, count)
+    with a single fused ``psum`` is algebraically identical, needs one
+    collective instead of an all_gather + recombine, and is exact for
+    empty shards (they contribute zeros, matching ``:195-205``).
+
+    Args:
+      local_sum:   per-channel sum of x over this replica's local elements.
+      local_sumsq: per-channel sum of x² over this replica's local elements.
+      local_count: scalar (or per-channel) number of local elements.
+
+    Returns:
+      (global_mean, global_biased_var, global_count). Variance is the
+      *biased* (1/N) variance — what BN normalizes with; the unbiased
+      running-var correction is the caller's job (see ops.batch_norm).
+    """
+    total_sum, total_sumsq, total_count = lax.psum(
+        (local_sum, local_sumsq, local_count), axis_name
+    )
+    mean, var = moments_from_stats(total_sum, total_sumsq, total_count)
+    return mean, var, total_count
+
+
+def moments_from_stats(
+    s: jax.Array, sq: jax.Array, count: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """(mean, biased var) from raw partial sums; safe for count==0, and
+    clamps the tiny negative values that cancellation in ``sumsq - n·mean²``
+    can produce. Single home for this math — both the local path
+    (ops.batch_norm) and the cross-replica path above use it."""
+    safe = jnp.maximum(count, 1.0)
+    mean = s / safe
+    var = jnp.maximum(sq / safe - mean * mean, 0.0)
+    return mean, var

@@ -101,18 +101,37 @@ def initialize(config: DistributedConfig | None = None) -> None:
         config = DistributedConfig.from_env()
     # A coordinator address alone (e.g. a stale MASTER_ADDR export from an
     # old GPU script) must not force the multi-host path: require an actual
-    # world size > 1.
-    multi_host = (config.num_processes or 1) > 1 or (
+    # world size > 1, or TPU slice metadata advertising multiple workers
+    # (in which case jax.distributed.initialize autodetects everything).
+    explicit_multi = (config.num_processes or 1) > 1 or (
         os.environ.get("TPU_SYNCBN_FORCE_DIST") == "1"
     )
-    if multi_host:
+    slice_multi = _tpu_slice_is_multihost()
+    if explicit_multi:
         jax.distributed.initialize(
             coordinator_address=config.coordinator_address,
             num_processes=config.num_processes,
             process_id=config.process_id,
         )
         _jax_distributed_active = True
+    elif slice_multi:
+        # Argless: every parameter is discovered from slice metadata — the
+        # TPU-native replacement for env:// rendezvous (README.md:32-35).
+        jax.distributed.initialize()
+        _jax_distributed_active = True
     _initialized = True
+
+
+def _tpu_slice_is_multihost() -> bool:
+    """True when TPU slice metadata in the environment advertises more than
+    one worker host (the case where ``jax.distributed.initialize`` must run
+    before any computation)."""
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if "," in hostnames:
+        return True
+    if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+        return True
+    return False
 
 
 def is_initialized() -> bool:
@@ -196,7 +215,8 @@ def get_logger(name: str = "tpu_syncbn") -> logging.Logger:
             )
             logger.addHandler(handler)
         logger.setLevel(logging.INFO)
-        logger.addFilter(_MasterOnlyFilter())
+        if not any(isinstance(f, _MasterOnlyFilter) for f in logger.filters):
+            logger.addFilter(_MasterOnlyFilter())
         logger.propagate = False
         _loggers[name] = logger
     return _loggers[name]
