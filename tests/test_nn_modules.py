@@ -1,0 +1,210 @@
+"""Module-level tests: BatchNorm/SyncBatchNorm nnx modules and the
+convert_sync_batchnorm tree rewrite (drop-in contract of
+[torch] nn/modules/batchnorm.py:889-951)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+from flax import nnx
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from tpu_syncbn import nn as tnn
+from tpu_syncbn import runtime
+
+N, B, C, H, W = 8, 2, 4, 3, 3
+
+
+def rand_x(seed=0, n=N * B):
+    return np.random.RandomState(seed).randn(n, H, W, C).astype(np.float32)
+
+
+def test_batchnorm_module_matches_torch():
+    bn = tnn.BatchNorm2d(C)
+    tbn = torch.nn.BatchNorm2d(C)
+    x = rand_x()
+    for step in range(2):
+        x = rand_x(step)
+        y = bn(jnp.asarray(x))
+        yt = tbn(torch.from_numpy(np.transpose(x, (0, 3, 1, 2))))
+        np.testing.assert_allclose(
+            np.asarray(y), np.transpose(yt.detach().numpy(), (0, 2, 3, 1)),
+            rtol=1e-4, atol=1e-5,
+        )
+    np.testing.assert_allclose(
+        np.asarray(bn.running_var[...]), tbn.running_var.numpy(), rtol=1e-5, atol=1e-6
+    )
+    assert int(bn.num_batches_tracked[...]) == 2
+
+
+def test_eval_mode_via_nnx_eval():
+    bn = tnn.BatchNorm2d(C)
+    x = jnp.asarray(rand_x())
+    bn(x)  # one train step
+    bn.eval()
+    assert bn.use_running_average
+    y1 = bn(x)
+    y2 = bn(x)  # eval must not mutate stats
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+    assert int(bn.num_batches_tracked[...]) == 1
+    bn.train()
+    assert not bn.use_running_average
+
+
+def test_syncbn_outside_mesh_falls_back_to_local():
+    """SyncBatchNorm outside shard_map == plain BN (world-size-1 fallback,
+    [torch] nn/modules/batchnorm.py:837-873)."""
+    sbn = tnn.SyncBatchNorm(C)
+    bn = tnn.BatchNorm2d(C)
+    x = jnp.asarray(rand_x(3))
+    np.testing.assert_allclose(np.asarray(sbn(x)), np.asarray(bn(x)), rtol=1e-6)
+
+
+class _Tower(nnx.Module):
+    """Nested module tree with BN in attr, list, and dict containers."""
+
+    def __init__(self):
+        self.conv = nnx.Conv(C, C, (1, 1), rngs=nnx.Rngs(0))
+        self.bn = tnn.BatchNorm2d(C)
+        self.blocks = nnx.List([tnn.BatchNorm2d(C), tnn.BatchNorm2d(C)])
+        self.named = nnx.Dict({"head": tnn.BatchNorm1d(C)})
+
+    def __call__(self, x):
+        x = self.conv(x)
+        x = self.bn(x)
+        for b in self.blocks:
+            x = b(x)
+        return x
+
+
+def test_convert_sync_batchnorm_tree_rewrite():
+    m = _Tower()
+    # move state so we can check it is carried over by reference
+    m.bn.running_mean[...] = jnp.full((C,), 2.5)
+    m.bn.weight[...] = jnp.full((C,), 1.5)
+    m.eval()
+    old_weight_var = m.bn.weight
+    old_rm_var = m.bn.running_mean
+
+    out = tnn.convert_sync_batchnorm(m)
+    assert out is m
+    assert isinstance(m.bn, tnn.SyncBatchNorm)
+    assert all(isinstance(b, tnn.SyncBatchNorm) for b in m.blocks)
+    assert isinstance(m.named["head"], tnn.SyncBatchNorm)
+    assert not isinstance(m.conv, tnn.SyncBatchNorm)
+    # variables shared by reference, config and mode preserved
+    assert m.bn.weight is old_weight_var
+    assert m.bn.running_mean is old_rm_var
+    np.testing.assert_allclose(np.asarray(m.bn.running_mean[...]), 2.5)
+    assert m.bn.use_running_average  # eval flag carried
+    assert m.bn.axis_name == "data"
+
+
+def test_convert_root_batchnorm():
+    bn = tnn.BatchNorm2d(C, momentum=0.3, eps=1e-4)
+    out = tnn.convert_sync_batchnorm(bn, axis_name="replica")
+    assert isinstance(out, tnn.SyncBatchNorm)
+    assert out.momentum == 0.3 and out.eps == 1e-4 and out.axis_name == "replica"
+
+
+def test_convert_idempotent():
+    m = _Tower()
+    tnn.convert_sync_batchnorm(m)
+    first = m.bn
+    tnn.convert_sync_batchnorm(m)
+    assert m.bn is first  # already-sync modules untouched
+
+
+def test_syncbn_module_golden_inside_shard_map():
+    """Module-level golden test: converted model over 8 replicas ==
+    unconverted model on the full batch."""
+    mesh = runtime.data_parallel_mesh()
+    x = rand_x(7)
+
+    ref = _Tower()
+    y_ref = ref(jnp.asarray(x))
+
+    m = _Tower()
+    tnn.convert_sync_batchnorm(m)
+    graphdef, state = nnx.split(m)
+
+    def step(state, xs):
+        model = nnx.merge(graphdef, state)
+        y = model(xs)
+        _, new_state = nnx.split(model)
+        return y, new_state
+
+    f = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P("data")),
+        out_specs=(P("data"), P()),
+    )
+    y_sync, new_state = f(state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y_sync), np.asarray(y_ref), rtol=1e-4, atol=1e-5)
+
+    # running stats after the synced step == big-batch reference stats
+    nnx.update(m, new_state)
+    np.testing.assert_allclose(
+        np.asarray(m.bn.running_mean[...]),
+        np.asarray(ref.bn.running_mean[...]),
+        rtol=1e-5, atol=1e-6,
+    )
+    assert int(m.bn.num_batches_tracked[...]) == 1
+
+
+def test_syncbn_eval_no_tracking_stays_local():
+    """Eval + track_running_stats=False inside shard_map: torch's need_sync
+    requires self.training, so this must use LOCAL batch stats with zero
+    collectives ([torch] nn/modules/batchnorm.py:837-860)."""
+    mesh = runtime.data_parallel_mesh()
+    sbn = tnn.SyncBatchNorm(C, track_running_stats=False)
+    sbn.eval()
+    graphdef, state = nnx.split(sbn)
+
+    f = jax.jit(
+        shard_map(
+            lambda st, xs: nnx.merge(graphdef, st)(xs),
+            mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"),
+        )
+    )
+    x = jnp.asarray(rand_x(13))
+    hlo = f.lower(state, x).compile().as_text()
+    assert "all-reduce" not in hlo and "all-gather" not in hlo
+    # per-replica local stats: differs from whole-batch normalization
+    y = np.asarray(f(state, x))
+    bn_local = tnn.BatchNorm2d(C, track_running_stats=False)
+    per_replica = np.concatenate(
+        [np.asarray(bn_local(jnp.asarray(np.asarray(x)[i * B : (i + 1) * B])))
+         for i in range(N)]
+    )
+    np.testing.assert_allclose(y, per_replica, rtol=1e-4, atol=1e-5)
+
+
+class _Hidden(nnx.Module):
+    def __init__(self):
+        self._bn = tnn.BatchNorm2d(C)  # underscore-named child
+
+
+def test_convert_reaches_underscore_attrs():
+    m = _Hidden()
+    tnn.convert_sync_batchnorm(m)
+    assert isinstance(m._bn, tnn.SyncBatchNorm)
+
+
+def test_wrong_rank_raises():
+    bn = tnn.BatchNorm2d(C)
+    try:
+        bn(jnp.zeros((2, 3, C)))
+        assert False, "expected ValueError"
+    except ValueError as e:
+        assert "4D" in str(e)
+
+
+def test_wrong_channels_raises():
+    bn = tnn.BatchNorm2d(C)
+    try:
+        bn(jnp.zeros((2, 3, 3, C + 1)))
+        assert False, "expected ValueError"
+    except ValueError as e:
+        assert "channels" in str(e)

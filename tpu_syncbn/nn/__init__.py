@@ -1,1 +1,20 @@
-"""placeholder"""
+"""NN modules: BatchNorm family and the SyncBatchNorm conversion transform
+(the reference's L3 model-wrapper layer, README.md:40-72)."""
+
+from tpu_syncbn.nn.normalization import (
+    BatchNorm,
+    BatchNorm1d,
+    BatchNorm2d,
+    BatchNorm3d,
+    SyncBatchNorm,
+)
+from tpu_syncbn.nn.convert import convert_sync_batchnorm
+
+__all__ = [
+    "BatchNorm",
+    "BatchNorm1d",
+    "BatchNorm2d",
+    "BatchNorm3d",
+    "SyncBatchNorm",
+    "convert_sync_batchnorm",
+]

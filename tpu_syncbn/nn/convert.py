@@ -1,0 +1,105 @@
+"""``convert_sync_batchnorm`` — recursive module-tree rewrite.
+
+The TPU-native equivalent of
+``torch.nn.SyncBatchNorm.convert_sync_batchnorm`` (reference
+``README.md:40-45``; implementation
+``[torch] nn/modules/batchnorm.py:889-951``): walk the module tree, replace
+every :class:`~tpu_syncbn.nn.BatchNorm` (and subclasses) with a
+:class:`~tpu_syncbn.nn.SyncBatchNorm` that *shares* the original's
+parameters and running buffers (weight/bias/running_mean/running_var/
+num_batches_tracked are carried over by reference, exactly as torch carries
+them over at ``:927-937``), preserving eps/momentum/affine/track flags and
+the train/eval mode flag.
+
+Because nnx modules are mutable Python objects (like torch modules), this
+is a true drop-in transform: the returned tree is the same object graph
+with BN nodes swapped, so optimizer state keyed on the other parameters is
+untouched.
+"""
+
+from __future__ import annotations
+
+from flax import nnx
+
+from tpu_syncbn.nn.normalization import BatchNorm, SyncBatchNorm
+from tpu_syncbn.runtime.distributed import DATA_AXIS
+
+
+def _convert_one(bn: BatchNorm, axis_name: str) -> SyncBatchNorm:
+    out = SyncBatchNorm(
+        bn.num_features,
+        eps=bn.eps,
+        momentum=bn.momentum,
+        affine=bn.affine,
+        track_running_stats=bn.track_running_stats,
+        channel_axis=bn.channel_axis,
+        axis_name=axis_name,
+    )
+    # Share (not copy) variables — the torch converter moves the same
+    # Parameter/buffer objects onto the new module
+    # ([torch] nn/modules/batchnorm.py:927-937).
+    out.weight = bn.weight
+    out.bias = bn.bias
+    out.running_mean = bn.running_mean
+    out.running_var = bn.running_var
+    out.num_batches_tracked = bn.num_batches_tracked
+    out.use_running_average = bn.use_running_average
+    return out
+
+
+def _swap_in_container(value, axis_name: str):
+    """Swap BN→SyncBN inside ``value``; returns ``value`` itself (same
+    object identity) when nothing needed converting."""
+    if isinstance(value, BatchNorm) and not isinstance(value, SyncBatchNorm):
+        return _convert_one(value, axis_name)
+    if isinstance(value, (list, tuple)):
+        new = [_swap_in_container(v, axis_name) for v in value]
+        if all(a is b for a, b in zip(new, value)):
+            return value
+        return type(value)(new)
+    if isinstance(value, dict):
+        new = {k: _swap_in_container(v, axis_name) for k, v in value.items()}
+        if all(new[k] is value[k] for k in value):
+            return value
+        return new
+    return value
+
+
+def convert_sync_batchnorm(module: nnx.Module, axis_name: str = DATA_AXIS):
+    """Recursively replace BatchNorm modules with SyncBatchNorm.
+
+    Drop-in contract of ``[torch] nn/modules/batchnorm.py:889-951``:
+    parameters and buffers are shared by reference; config and mode flags
+    preserved. Returns the (possibly new) root; inner modules are rewritten
+    in place. ``axis_name`` plays the role of torch's ``process_group``
+    argument — it scopes which mesh axis the statistics sync over.
+    """
+    if isinstance(module, BatchNorm) and not isinstance(module, SyncBatchNorm):
+        return _convert_one(module, axis_name)
+    seen = set()
+    for _path, node in nnx.iter_graph(module):
+        if not isinstance(node, nnx.Module) or id(node) in seen:
+            continue
+        seen.add(id(node))
+        if isinstance(node, nnx.List):
+            for i in range(len(node)):
+                new = _swap_in_container(node[i], axis_name)
+                if new is not node[i]:
+                    node[i] = new
+            continue
+        if isinstance(node, nnx.Dict):
+            for k in list(node):
+                new = _swap_in_container(node[k], axis_name)
+                if new is not node[k]:
+                    node[k] = new
+            continue
+        for attr, value in list(vars(node).items()):
+            # torch's converter replaces every named child regardless of
+            # attribute name ([torch] batchnorm.py:939-941); only nnx's own
+            # bookkeeping attribute is off-limits.
+            if attr == "_object__state":
+                continue
+            new = _swap_in_container(value, axis_name)
+            if new is not value:
+                setattr(node, attr, new)
+    return module
