@@ -208,3 +208,29 @@ def test_wrong_channels_raises():
         assert False, "expected ValueError"
     except ValueError as e:
         assert "channels" in str(e)
+
+
+def test_plain_batchnorm_rejects_axis_name():
+    import pytest
+
+    with pytest.raises(ValueError, match="SyncBatchNorm"):
+        tnn.BatchNorm2d(C, axis_name="data")
+
+
+import collections
+
+_BNPair = collections.namedtuple("_BNPair", "a b")
+
+
+class _WithNamedTuple(nnx.Module):
+    def __init__(self):
+        # nnx requires explicit nnx.data() for module-bearing namedtuples
+        self.pair = nnx.data(_BNPair(tnn.BatchNorm2d(C), tnn.BatchNorm2d(C)))
+
+
+def test_convert_namedtuple_attr():
+    m = _WithNamedTuple()
+    tnn.convert_sync_batchnorm(m)
+    assert isinstance(m.pair, _BNPair)
+    assert isinstance(m.pair.a, tnn.SyncBatchNorm)
+    assert isinstance(m.pair.b, tnn.SyncBatchNorm)

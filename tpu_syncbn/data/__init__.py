@@ -1,1 +1,33 @@
-"""placeholder"""
+"""Data pipeline: samplers, datasets, loaders, device prefetch (the
+reference's L4: DistributedSampler + multi-worker pinned DataLoader,
+README.md:74-92)."""
+
+from tpu_syncbn.data.sampler import (
+    Sampler,
+    SequentialSampler,
+    RandomSampler,
+    DistributedSampler,
+)
+from tpu_syncbn.data.dataset import (
+    Dataset,
+    ArrayDataset,
+    TransformDataset,
+    SyntheticImageDataset,
+    load_cifar10,
+)
+from tpu_syncbn.data.loader import DataLoader, default_collate, device_prefetch
+
+__all__ = [
+    "Sampler",
+    "SequentialSampler",
+    "RandomSampler",
+    "DistributedSampler",
+    "Dataset",
+    "ArrayDataset",
+    "TransformDataset",
+    "SyntheticImageDataset",
+    "load_cifar10",
+    "DataLoader",
+    "default_collate",
+    "device_prefetch",
+]

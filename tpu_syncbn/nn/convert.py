@@ -56,6 +56,8 @@ def _swap_in_container(value, axis_name: str):
         new = [_swap_in_container(v, axis_name) for v in value]
         if all(a is b for a, b in zip(new, value)):
             return value
+        if isinstance(value, tuple) and hasattr(value, "_fields"):  # namedtuple
+            return type(value)(*new)
         return type(value)(new)
     if isinstance(value, dict):
         new = {k: _swap_in_container(v, axis_name) for k, v in value.items()}

@@ -68,6 +68,15 @@ class BatchNorm(nnx.Module):
         dtype: jnp.dtype = jnp.float32,
         rngs: nnx.Rngs | None = None,  # unused; accepted for nnx idiom
     ):
+        if axis_name is not None and not isinstance(self, SyncBatchNorm):
+            # Plain BN never syncs (that per-replica behavior is the bug
+            # the reference exists to fix, README.md:3); accepting the
+            # parameter here and ignoring it would silently reintroduce it.
+            raise ValueError(
+                "plain BatchNorm does not sync across replicas; use "
+                "SyncBatchNorm (or convert_sync_batchnorm) for axis_name="
+                f"{axis_name!r}"
+            )
         self.num_features = num_features
         self.eps = eps
         self.momentum = momentum
