@@ -1,0 +1,80 @@
+"""Headline benchmark: ResNet-50 + SyncBN data-parallel training throughput.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/s/chip", "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md), so vs_baseline is the
+ratio against a fixed placeholder target of 1.0 until a measured reference
+exists; the metric itself (images/sec/chip, BASELINE.json) is the
+tracked quantity.
+"""
+
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from flax import nnx
+
+    from tpu_syncbn import models, nn, parallel, runtime
+
+    runtime.initialize()
+    n_chips = runtime.global_device_count()
+    log(f"backend={jax.default_backend()} chips={n_chips}")
+
+    per_chip_batch = 64
+    global_batch = per_chip_batch * n_chips
+    image = (224, 224, 3)
+
+    model = nn.convert_sync_batchnorm(
+        models.resnet50(num_classes=1000, rngs=nnx.Rngs(0))
+    )
+
+    def loss_fn(m, batch):
+        x, y = batch
+        logits = m(x)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    mesh = runtime.data_parallel_mesh()
+    dp = parallel.DataParallel(
+        model, optax.sgd(0.1, momentum=0.9), loss_fn, mesh=mesh
+    )
+
+    x = jnp.zeros((global_batch, *image), jnp.float32)
+    y = jnp.zeros((global_batch,), jnp.int32)
+    batch = jax.device_put((x, y), dp.batch_sharding)
+
+    log("compiling + warmup...")
+    for _ in range(3):
+        out = dp.train_step(batch)
+    out.loss.block_until_ready()
+
+    steps = 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = dp.train_step(batch)
+    out.loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    img_per_sec = global_batch * steps / dt
+    img_per_sec_per_chip = img_per_sec / n_chips
+    log(f"{img_per_sec:.1f} img/s total, {img_per_sec_per_chip:.1f} img/s/chip")
+
+    print(json.dumps({
+        "metric": "resnet50_syncbn_dp_train_throughput",
+        "value": round(img_per_sec_per_chip, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(img_per_sec_per_chip / 1.0, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

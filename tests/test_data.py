@@ -106,6 +106,25 @@ def test_threaded_loader_matches_sequential(workers):
         np.testing.assert_array_equal(ry, gy)
 
 
+def test_threaded_loader_propagates_sampler_errors():
+    """A sampler raising mid-iteration must surface at the consumer, not
+    hang the loop (dispatcher-thread failure path)."""
+
+    class BadSampler(tdata.Sampler):
+        def __len__(self):
+            return 8
+
+        def __iter__(self):
+            yield 0
+            yield 1
+            raise RuntimeError("sampler exploded")
+
+    ds = tdata.ArrayDataset(np.arange(8))
+    dl = tdata.DataLoader(ds, batch_size=1, sampler=BadSampler(), num_workers=2)
+    with pytest.raises(RuntimeError, match="sampler exploded"):
+        list(dl)
+
+
 def test_threaded_loader_propagates_worker_errors():
     class Bad(tdata.Dataset):
         def __len__(self):

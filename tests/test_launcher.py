@@ -1,0 +1,46 @@
+"""Launcher tests: `python -m tpu_syncbn.launch` (the reference's step 6,
+README.md:94-103) driving the full example script on simulated chips."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_launch(args, timeout=600):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_syncbn.launch", *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
+
+
+@pytest.mark.slow
+def test_launch_example_simulated_chips():
+    res = run_launch(
+        [
+            "--simulate-chips", "4",
+            "examples/distributed_train.py", "--",
+            "--epochs", "1", "--batch-size", "32", "--dataset-size", "128",
+        ]
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert "world: 4 chip(s)" in res.stdout
+    assert "done:" in res.stdout
+    assert "loss" in res.stdout
+
+
+def test_launch_bad_simulate_chips():
+    res = run_launch(["--simulate-chips", "0", "examples/distributed_train.py"])
+    assert res.returncode != 0
+    assert "--simulate-chips must be >= 1" in res.stderr
+
+
+def test_launch_missing_script():
+    res = run_launch(["--simulate-chips", "1", "no_such_script.py"])
+    assert res.returncode != 0

@@ -1,0 +1,67 @@
+"""Model zoo tests: architecture fidelity (param counts vs torchvision's
+published numbers), feature pyramids, SyncBN conversion end-to-end."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import nnx
+
+from tpu_syncbn import models, nn as tnn
+
+
+def n_params(model):
+    _, params, _ = nnx.split(model, nnx.Param, ...)
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+# torchvision reference counts (1000 classes)
+TORCHVISION_COUNTS = {
+    "resnet18": 11_689_512,
+    "resnet34": 21_797_672,
+    "resnet50": 25_557_032,
+    "resnet101": 44_549_160,
+}
+
+
+@pytest.mark.parametrize("name", sorted(TORCHVISION_COUNTS))
+def test_param_counts_match_torchvision(name):
+    m = models.RESNETS[name](num_classes=1000, rngs=nnx.Rngs(0))
+    assert n_params(m) == TORCHVISION_COUNTS[name]
+
+
+def test_cifar_stem_shapes():
+    m = models.resnet18(num_classes=10, small_input=True, rngs=nnx.Rngs(0))
+    y = m(jnp.zeros((2, 32, 32, 3)))
+    assert y.shape == (2, 10)
+    feats = m.features(jnp.zeros((2, 32, 32, 3)))
+    assert [f.shape for f in feats] == [
+        (2, 32, 32, 64), (2, 16, 16, 128), (2, 8, 8, 256), (2, 4, 4, 512)
+    ]
+
+
+def test_imagenet_stem_pyramid():
+    m = models.resnet50(rngs=nnx.Rngs(0))
+    feats = m.features(jnp.zeros((1, 224, 224, 3)))
+    assert [f.shape for f in feats] == [
+        (1, 56, 56, 256), (1, 28, 28, 512), (1, 14, 14, 1024), (1, 7, 7, 2048)
+    ]
+
+
+def test_resnet_syncbn_conversion_counts():
+    m = models.resnet50(rngs=nnx.Rngs(0))
+    tnn.convert_sync_batchnorm(m)
+    n_sync = sum(
+        1 for _, node in nnx.iter_graph(m) if isinstance(node, tnn.SyncBatchNorm)
+    )
+    assert n_sync == 53  # ResNet-50 has 53 BN layers (SURVEY §3.4)
+
+
+def test_resnet_train_eval_consistency():
+    m = models.resnet18(num_classes=10, small_input=True, rngs=nnx.Rngs(0))
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 32, 32, 3), jnp.float32)
+    y1 = m(x)  # train mode: batch stats
+    m.eval()
+    y2 = m(x)  # eval: running stats (updated once)
+    assert y1.shape == y2.shape == (4, 10)
+    assert not np.allclose(np.asarray(y1), np.asarray(y2))

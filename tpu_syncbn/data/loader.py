@@ -146,12 +146,21 @@ class DataLoader:
                     continue
             return False
 
+        dispatch_error: list[BaseException] = []
+
         def dispatcher():
             seq = 0
-            for idxs in self._batches_of_indices():
-                if not _put_checking_stop(index_queues[seq % n_workers], (seq, idxs)):
-                    return
-                seq += 1
+            try:
+                for idxs in self._batches_of_indices():
+                    if not _put_checking_stop(
+                        index_queues[seq % n_workers], (seq, idxs)
+                    ):
+                        return
+                    seq += 1
+            except BaseException as e:  # user sampler raised mid-iteration:
+                # surface it to the consumer instead of hanging the loop
+                dispatch_error.append(e)
+                return
             for q in index_queues:
                 if not _put_checking_stop(q, SENTINEL):
                     return
@@ -176,7 +185,12 @@ class DataLoader:
                 if done[wid]:
                     seq += 1
                     continue
-                item = out_queues[wid].get()
+                try:
+                    item = out_queues[wid].get(timeout=0.05)
+                except queue.Empty:
+                    if dispatch_error:
+                        raise dispatch_error[0]
+                    continue
                 if item is SENTINEL:
                     done[wid] = True
                     seq += 1
@@ -216,12 +230,22 @@ def device_prefetch(
     """
     if size < 1:
         raise ValueError("size must be >= 1")
+    multi_host = jax.process_count() > 1
 
     def put(batch):
         if not to_device:
             return batch
         if sharding is None:
             return jax.tree_util.tree_map(jax.device_put, batch)
+        if multi_host:
+            # each host feeds its shard of the global batch (the
+            # DistributedSampler gave it a disjoint index shard); assemble
+            # the logically-global array from per-process local data —
+            # jax.device_put can't target non-addressable devices
+            return jax.tree_util.tree_map(
+                lambda a: jax.make_array_from_process_local_data(sharding, a),
+                batch,
+            )
         return jax.tree_util.tree_map(
             lambda a: jax.device_put(a, sharding), batch
         )

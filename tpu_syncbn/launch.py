@@ -1,0 +1,7 @@
+"""``python -m tpu_syncbn.launch`` entry point (reference ``README.md:96``:
+``python -m torch.distributed.launch``)."""
+
+from tpu_syncbn.runtime.launcher import main
+
+if __name__ == "__main__":
+    main()

@@ -1,1 +1,26 @@
-"""placeholder"""
+"""Model zoo: the architectures named by the reference's capability configs
+(ResNet-18/50, RetinaNet-R50-FPN, DCGAN/SNGAN — BASELINE.json)."""
+
+from tpu_syncbn.models.resnet import (
+    ResNet,
+    BasicBlock,
+    Bottleneck,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+    RESNETS,
+)
+
+__all__ = [
+    "ResNet",
+    "BasicBlock",
+    "Bottleneck",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "resnet101",
+    "resnet152",
+    "RESNETS",
+]

@@ -1,6 +1,7 @@
 """Parallelism layer: collectives over mesh axes and data-parallel training
 utilities (the reference's L2+L3: NCCL process group + DDP wrapper)."""
 
+from tpu_syncbn.parallel.trainer import DataParallel, StepOutput, sync_module_states
 from tpu_syncbn.parallel.collectives import (
     axis_index,
     axis_size,
@@ -17,6 +18,9 @@ from tpu_syncbn.parallel.collectives import (
 )
 
 __all__ = [
+    "DataParallel",
+    "StepOutput",
+    "sync_module_states",
     "axis_index",
     "axis_size",
     "psum",

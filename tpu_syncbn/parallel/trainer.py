@@ -1,0 +1,322 @@
+"""Data-parallel trainer — the TPU-native replacement for
+``DistributedDataParallel`` (reference ``README.md:62-72``; implementation
+``[torch] nn/parallel/distributed.py:466-2666``).
+
+DDP's machinery maps onto the compiled step as follows (SURVEY §7):
+
+=====================================================  ======================
+DDP mechanism                                          here
+=====================================================  ======================
+init-time param/buffer broadcast from rank 0           :func:`sync_module_states`
+(``_sync_module_states``, ``distributed.py:1066``)     (+ identical-by-
+                                                       construction init)
+autograd-hook bucketing + overlapped all_reduce        ``lax.pmean`` of grads
+(C++ Reducer, ``distributed.py:1437``; 25 MiB           inside the jitted
+buckets ``:31``)                                       step; XLA's latency-
+                                                       hiding scheduler
+                                                       overlaps it with the
+                                                       backward automatically
+gradient averaging by world size                       ``pmean`` (sum/world)
+per-forward buffer broadcast                           rank-0 buffer
+(``forward_sync_buffers``, ``:793``)                   broadcast of BatchStats
+                                                       inside the step
+``no_sync()`` gradient accumulation (``:1659``)        ``accum_steps`` —
+                                                       lax.scan microbatches,
+                                                       one pmean at the end
+``find_unused_parameters`` (``:719``)                  unnecessary: autodiff
+                                                       yields zero grads for
+                                                       unused params, every
+                                                       replica identically
+=====================================================  ======================
+
+The key structural difference: DDP is a runtime wrapper issuing collectives
+from autograd hooks; here the *compiler* sees the whole step (forward,
+backward, stat sync, grad sync, optimizer) as one XLA program and schedules
+the collectives over ICI itself — which is what subsumes bucketing/overlap
+tuning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import nnx
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_syncbn.parallel import collectives
+from tpu_syncbn.runtime import distributed as dist
+from tpu_syncbn.runtime.distributed import DATA_AXIS
+
+
+def sync_module_states(model: nnx.Module, src: int = 0) -> None:
+    """Broadcast parameters and buffers from host ``src`` to all hosts —
+    DDP's init-time ``_sync_module_states``
+    (``[torch] nn/parallel/distributed.py:1066-1072``).
+
+    In single-program SPMD, replicas created from the same PRNG key are
+    identical by construction, so this matters only for multi-host jobs
+    where hosts may have diverged (e.g. loaded different checkpoints).
+    Single-host: no-op.
+    """
+    if dist.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    graphdef, state = nnx.split(model)
+    state = multihost_utils.broadcast_one_to_all(
+        state, is_source=dist.process_index() == src
+    )
+    nnx.update(model, state)
+
+
+@dataclasses.dataclass
+class StepOutput:
+    """What a compiled train step returns to the host."""
+
+    loss: jax.Array
+    metrics: dict[str, jax.Array]
+
+
+class DataParallel:
+    """Compiled data-parallel training for an nnx model over the ``data``
+    mesh axis — the reference's step 4
+    (``ddp_net = nn.parallel.DistributedDataParallel(net, ...)``,
+    ``README.md:67-71``) as a step-factory.
+
+    Usage (the recipe's loop, ``README.md:57-60``)::
+
+        model = convert_sync_batchnorm(Net(rngs))
+        dp = DataParallel(model, optax.sgd(1e-2), loss_fn)
+        for epoch in range(E):
+            sampler.set_epoch(epoch)
+            for batch in device_prefetch(iter(loader), sharding=dp.batch_sharding):
+                out = dp.train_step(batch)       # loss already pmean'd
+        dp.sync_to_model()                        # pull state back into `model`
+
+    ``loss_fn(model, batch)`` returns a scalar local-mean loss or
+    ``(loss, metrics_dict)``. Gradients are ``pmean``'d across replicas, so
+    with equal shards (``drop_last=True``, ``README.md:90``) the update
+    equals single-device large-batch SGD — DDP's contract.
+
+    ``accum_steps > 1`` reproduces DDP's ``no_sync()`` pattern: the local
+    batch is split into microbatches scanned sequentially with local grad
+    accumulation and ONE cross-replica grad reduction at the end
+    (``[torch] nn/parallel/distributed.py:1659``).
+
+    ``broadcast_buffers`` (default True, DDP's default ``:793``): BatchStat
+    buffers are broadcast from replica 0 inside the step, keeping plain-BN
+    buffers replicated exactly as DDP does per forward. With SyncBN the
+    stats are already identical, and XLA folds the no-op broadcast.
+    """
+
+    def __init__(
+        self,
+        model: nnx.Module,
+        optimizer: optax.GradientTransformation,
+        loss_fn: Callable[[nnx.Module, Any], Any],
+        *,
+        mesh: Mesh | None = None,
+        axis_name: str = DATA_AXIS,
+        broadcast_buffers: bool = True,
+        accum_steps: int = 1,
+        donate: bool = True,
+    ):
+        if accum_steps < 1:
+            raise ValueError("accum_steps must be >= 1")
+        self._model = model
+        self.mesh = mesh if mesh is not None else dist.data_parallel_mesh()
+        self.axis_name = axis_name
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.accum_steps = accum_steps
+        self.broadcast_buffers = broadcast_buffers
+
+        self.graphdef, params, rest = nnx.split(model, nnx.Param, ...)
+        self.params = params
+        self.rest = rest  # BatchStats + any other non-Param state
+        self.opt_state = optimizer.init(params)
+
+        self.batch_sharding = NamedSharding(self.mesh, P(axis_name))
+        self._replicated = NamedSharding(self.mesh, P())
+        self._per_replica = NamedSharding(self.mesh, P(axis_name))
+        self.world = int(self.mesh.shape[axis_name])
+
+        # put state on the mesh once. Params/opt replicated; buffers
+        # replicated when broadcast_buffers keeps them in sync, otherwise
+        # stored honestly per-replica ((world, ...) sharded on the data
+        # axis) — torch's broadcast_buffers=False keeps local buffers per
+        # replica, and declaring divergent buffers "replicated" would let
+        # any host read return an arbitrary replica's stats.
+        self.params = jax.device_put(self.params, self._replicated)
+        self.opt_state = jax.device_put(self.opt_state, self._replicated)
+        if broadcast_buffers:
+            self.rest = jax.device_put(self.rest, self._replicated)
+        else:
+            self.rest = jax.device_put(
+                jax.tree_util.tree_map(
+                    lambda x: jnp.broadcast_to(x[None], (self.world,) + x.shape),
+                    self.rest,
+                ),
+                self._per_replica,
+            )
+        self._rest_spec = P() if broadcast_buffers else P(axis_name)
+
+        self._train_step = self._build_train_step(donate)
+        self._eval_step = self._build_eval_step()
+
+    # -- step builders ----------------------------------------------------
+
+    def _microbatch_grads(self, params, rest, batch):
+        """value_and_grad over one microbatch; returns (loss, metrics,
+        new_rest, grads)."""
+
+        def lossed(p, r, b):
+            # copy=True: fresh trace-local Variables, so BN's BatchStat
+            # mutation happens at this trace level (nnx 0.12 merge
+            # otherwise aliases the original module's variables)
+            model = nnx.merge(self.graphdef, p, r, copy=True)
+            model.train()
+            out = self.loss_fn(model, b)
+            loss, metrics = out if isinstance(out, tuple) else (out, {})
+            _, _, new_r = nnx.split(model, nnx.Param, ...)
+            return loss, (metrics, new_r)
+
+        (loss, (metrics, new_rest)), grads = jax.value_and_grad(
+            lossed, has_aux=True
+        )(params, rest, batch)
+        return loss, metrics, new_rest, grads
+
+    def _build_train_step(self, donate: bool):
+        axis = self.axis_name
+
+        def step(params, rest, opt_state, batch):
+            if not self.broadcast_buffers:
+                # per-replica storage: strip the local leading axis of 1
+                rest = jax.tree_util.tree_map(lambda x: x[0], rest)
+            if self.accum_steps == 1:
+                loss, metrics, rest, grads = self._microbatch_grads(
+                    params, rest, batch
+                )
+            else:
+                # no_sync() pattern: scan microbatches, accumulate local
+                # grads, single cross-replica reduction afterwards
+                local_bs = jax.tree_util.tree_leaves(batch)[0].shape[0]
+                if local_bs % self.accum_steps:
+                    raise ValueError(
+                        f"per-replica batch size {local_bs} is not divisible "
+                        f"by accum_steps={self.accum_steps}"
+                    )
+                micro = jax.tree_util.tree_map(
+                    lambda x: x.reshape(
+                        (self.accum_steps, x.shape[0] // self.accum_steps)
+                        + x.shape[1:]
+                    ),
+                    batch,
+                )
+
+                def body(carry, mb):
+                    rest, acc = carry
+                    loss, metrics, rest, grads = self._microbatch_grads(
+                        params, rest, mb
+                    )
+                    acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+                    return (rest, acc), (loss, metrics)
+
+                zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+                (rest, grads), (losses, metricses) = jax.lax.scan(
+                    body, (rest, zero), micro
+                )
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / self.accum_steps, grads
+                )
+                loss = jnp.mean(losses)
+                metrics = jax.tree_util.tree_map(jnp.mean, metricses)
+
+            # DDP gradient averaging: one compiler-scheduled all-reduce
+            grads = collectives.pmean(grads, axis)
+            loss = collectives.pmean(loss, axis)
+            metrics = collectives.pmean(metrics, axis)
+
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+
+            if self.broadcast_buffers:
+                # per-step buffer broadcast (DDP forward_sync_buffers :793)
+                rest = collectives.broadcast(rest, src=0, axis_name=axis)
+            else:
+                # re-stack for honest per-replica storage
+                rest = jax.tree_util.tree_map(lambda x: x[None], rest)
+            return params, rest, opt_state, loss, metrics
+
+        sharded = shard_map(
+            step,
+            mesh=self.mesh,
+            in_specs=(P(), self._rest_spec, P(), P(self.axis_name)),
+            out_specs=(P(), self._rest_spec, P(), P(), P()),
+            # check_vma=False: enabling the VMA checker changes psum/pmean
+            # AD transpose semantics inside the step and produced BN-param
+            # grads that disagree with the verified big-batch oracle (8x
+            # off); output replication is instead guaranteed structurally —
+            # buffers are either broadcast from replica 0 or stored
+            # per-replica under P(axis).
+            check_vma=False,
+        )
+        donate_argnums = (0, 1, 2) if donate else ()
+        return jax.jit(sharded, donate_argnums=donate_argnums)
+
+    def _build_eval_step(self):
+        def step(params, rest, batch):
+            if not self.broadcast_buffers:
+                rest = jax.tree_util.tree_map(lambda x: x[0], rest)
+            model = nnx.merge(self.graphdef, params, rest, copy=True)
+            model.eval()
+            out = self.loss_fn(model, batch)
+            loss, metrics = out if isinstance(out, tuple) else (out, {})
+            loss = collectives.pmean(loss, self.axis_name)
+            metrics = collectives.pmean(metrics, self.axis_name)
+            return loss, metrics
+
+        sharded = shard_map(
+            step,
+            mesh=self.mesh,
+            in_specs=(P(), self._rest_spec, P(self.axis_name)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded)
+
+    # -- public API -------------------------------------------------------
+
+    def train_step(self, batch) -> StepOutput:
+        """One optimizer step on a *global* batch (sharded or shardable
+        along axis 0 across the mesh)."""
+        self.params, self.rest, self.opt_state, loss, metrics = self._train_step(
+            self.params, self.rest, self.opt_state, batch
+        )
+        return StepOutput(loss=loss, metrics=metrics)
+
+    def eval_step(self, batch) -> StepOutput:
+        loss, metrics = self._eval_step(self.params, self.rest, batch)
+        return StepOutput(loss=loss, metrics=metrics)
+
+    def sync_to_model(self) -> nnx.Module:
+        """Write the trained state back into the wrapped nnx model (the
+        object the user built and may want to eval/save directly) and
+        return it. With per-replica buffers (broadcast_buffers=False),
+        replica 0's buffers win — matching torch's rank-0 checkpoint
+        convention."""
+        rest = self.rest
+        if not self.broadcast_buffers:
+            rest = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], rest)
+        nnx.update(self._model, self.params, rest)
+        return self._model
+
+    @property
+    def model(self) -> nnx.Module:
+        return self.sync_to_model()
