@@ -1,0 +1,179 @@
+"""Pallas BN kernels vs the XLA-fusion path (and therefore vs torch, which
+the XLA path is parity-tested against). Run in interpret mode on the CPU
+mesh — same kernel code as TPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from tpu_syncbn import runtime
+from tpu_syncbn.ops import batch_norm as xla_ops
+from tpu_syncbn.ops import pallas_bn
+
+B, H, W, C = 4, 5, 3, 6
+
+
+def rand(seed=0, shape=(B, H, W, C)):
+    return jnp.asarray(
+        np.random.RandomState(seed).randn(*shape).astype(np.float32) * 1.5 + 0.2
+    )
+
+
+def test_bn_stats_matches_xla():
+    x = rand(0)
+    s_p, sq_p, n_p = pallas_bn.bn_stats(x)
+    s_x, sq_x, n_x = xla_ops.batch_norm_stats(x)
+    np.testing.assert_allclose(np.asarray(s_p), np.asarray(s_x), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(sq_p), np.asarray(sq_x), rtol=1e-5)
+    assert float(n_p) == float(n_x) == B * H * W
+
+
+def test_bn_stats_nonaligned_rows():
+    """M=60 rows is not a multiple of the 256-row block: padding must not
+    perturb the sums."""
+    x = rand(1, shape=(1, 60, 1, C))
+    s_p, sq_p, n_p = pallas_bn.bn_stats(x)
+    xf = np.asarray(x).reshape(-1, C)
+    np.testing.assert_allclose(np.asarray(s_p), xf.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(sq_p), (xf * xf).sum(0), rtol=1e-5)
+    assert float(n_p) == 60
+
+
+def test_bn_stats_large_multiblock():
+    """M > block size exercises the cross-step accumulator."""
+    x = rand(2, shape=(8, 16, 16, C))  # M = 2048 = 8 blocks
+    s_p, sq_p, _ = pallas_bn.bn_stats(x)
+    xf = np.asarray(x).reshape(-1, C)
+    np.testing.assert_allclose(np.asarray(s_p), xf.sum(0), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(sq_p), (xf * xf).sum(0), rtol=1e-4)
+
+
+def test_bn_normalize_matches_xla():
+    x = rand(3)
+    mean = jnp.asarray(np.random.RandomState(4).randn(C), jnp.float32)
+    var = jnp.asarray(np.random.RandomState(5).uniform(0.5, 2, C), jnp.float32)
+    w = jnp.asarray(np.random.RandomState(6).uniform(0.5, 1.5, C), jnp.float32)
+    b = jnp.asarray(np.random.RandomState(7).randn(C), jnp.float32)
+    y_p = pallas_bn.bn_normalize(x, mean, var, w, b, 1e-5)
+    y_x = xla_ops.batch_norm_elemt(x, mean, var, w, b, 1e-5)
+    np.testing.assert_allclose(np.asarray(y_p), np.asarray(y_x), rtol=1e-5, atol=1e-6)
+
+
+def test_bn_normalize_no_affine_bf16():
+    x = rand(8).astype(jnp.bfloat16)
+    mean = jnp.zeros(C)
+    var = jnp.ones(C)
+    y = pallas_bn.bn_normalize(x, mean, var, None, None, 1e-5)
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(x, np.float32), rtol=0.02, atol=0.02
+    )
+
+
+def test_fused_batch_norm_forward_and_grads_match_xla():
+    x = rand(9)
+    w = jnp.asarray(np.random.RandomState(10).uniform(0.5, 1.5, C), jnp.float32)
+    b = jnp.asarray(np.random.RandomState(11).randn(C), jnp.float32)
+    coeff = rand(12)
+
+    def loss_pallas(x, w, b):
+        y, _, _, _ = pallas_bn.fused_batch_norm(x, w, b, 1e-5, None)
+        return jnp.sum(y * coeff)
+
+    def loss_xla(x, w, b):
+        y, _ = xla_ops.batch_norm_train(x, None, None, None, w, b, eps=1e-5)
+        return jnp.sum(y * coeff)
+
+    lp, gp = jax.value_and_grad(loss_pallas, argnums=(0, 1, 2))(x, w, b), None
+    lx = jax.value_and_grad(loss_xla, argnums=(0, 1, 2))(x, w, b)
+    np.testing.assert_allclose(float(lp[0]), float(lx[0]), rtol=1e-5)
+    for a, c in zip(lp[1], lx[1]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c), rtol=1e-3, atol=1e-4)
+
+
+def test_fused_batch_norm_synced_golden():
+    """Pallas fused BN over 8 replicas == big-batch XLA BN (fwd + dx)."""
+    mesh = runtime.data_parallel_mesh()
+    x = rand(13, shape=(16, H, W, C))
+    w = jnp.asarray(np.random.RandomState(14).uniform(0.5, 1.5, C), jnp.float32)
+    b = jnp.zeros(C)
+    coeff = rand(15, shape=(16, H, W, C))
+
+    def local(xs, cs, ws):
+        y, mean, var, count = pallas_bn.fused_batch_norm(xs, ws, b, 1e-5, "data")
+        return jax.lax.psum(jnp.sum(y * cs), "data")
+
+    f = shard_map(
+        local, mesh=mesh,
+        in_specs=(P("data"), P("data"), P()),
+        out_specs=P(),
+        check_vma=False,  # pallas_call outputs carry no vma annotation
+    )
+    loss_s, (gx_s, gw_s) = jax.value_and_grad(
+        lambda xx, ww: f(xx, coeff, ww), argnums=(0, 1)
+    )(x, w)
+
+    def big(xx, ww):
+        y, _ = xla_ops.batch_norm_train(xx, None, None, None, ww, b, eps=1e-5)
+        return jnp.sum(y * coeff)
+
+    loss_r, (gx_r, gw_r) = jax.value_and_grad(big, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(float(loss_s), float(loss_r), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gx_s), np.asarray(gx_r), rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw_s), np.asarray(gw_r), rtol=1e-3, atol=1e-4)
+
+
+def test_bn_backward_reduce_values():
+    x = rand(16)
+    dy = rand(17)
+    mean = jnp.asarray(np.asarray(x).reshape(-1, C).mean(0))
+    var = jnp.asarray(np.asarray(x).reshape(-1, C).var(0))
+    invstd = jax.lax.rsqrt(var + 1e-5)
+    sdy, sdyx = pallas_bn.bn_backward_reduce(dy, x, mean, invstd)
+    dyf = np.asarray(dy).reshape(-1, C)
+    xhat = (np.asarray(x).reshape(-1, C) - np.asarray(mean)) * np.asarray(invstd)
+    np.testing.assert_allclose(np.asarray(sdy), dyf.sum(0), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(sdyx), (dyf * xhat).sum(0), rtol=1e-4)
+
+
+def test_module_bn_with_pallas_mode_on():
+    """BatchNorm module end-to-end with pallas forced on == pallas off."""
+    from tpu_syncbn import nn as tnn
+    from tpu_syncbn import ops
+
+    x = rand(20)
+    outs = {}
+    for mode in ("off", "on"):
+        ops.set_pallas_mode(mode)
+        try:
+            bn = tnn.BatchNorm2d(C)
+            y = bn(x)
+            outs[mode] = (np.asarray(y), np.asarray(bn.running_var[...]))
+        finally:
+            ops.set_pallas_mode("auto")
+    np.testing.assert_allclose(outs["on"][0], outs["off"][0], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs["on"][1], outs["off"][1], rtol=1e-5, atol=1e-6)
+
+
+def test_fused_bn_bias_only_grad():
+    """Regression: bias-only affine (weight=None, bias given) must produce a
+    real bias gradient on the Pallas path, matching the XLA path."""
+    x = rand(21)
+    b = jnp.asarray(np.random.RandomState(22).randn(C), jnp.float32)
+    coeff = rand(23)
+
+    def loss_p(b):
+        y, _, _, _ = pallas_bn.fused_batch_norm(x, None, b, 1e-5, None)
+        return jnp.sum(y * coeff)
+
+    def loss_x(b):
+        y, _ = xla_ops.batch_norm_train(x, None, None, None, None, b, eps=1e-5)
+        return jnp.sum(y * coeff)
+
+    gb_p = jax.grad(loss_p)(b)
+    gb_x = jax.grad(loss_x)(b)
+    assert float(jnp.abs(gb_p).max()) > 0
+    np.testing.assert_allclose(np.asarray(gb_p), np.asarray(gb_x), rtol=1e-4, atol=1e-5)

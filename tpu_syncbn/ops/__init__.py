@@ -2,6 +2,7 @@
 Pallas TPU fast path in pallas_bn)."""
 
 from tpu_syncbn.ops.batch_norm import (
+    set_pallas_mode,
     batch_norm_stats,
     moments_from_stats,
     sync_moments,
@@ -12,6 +13,7 @@ from tpu_syncbn.ops.batch_norm import (
 )
 
 __all__ = [
+    "set_pallas_mode",
     "batch_norm_stats",
     "moments_from_stats",
     "sync_moments",

@@ -31,11 +31,38 @@ Layout: channel-last (NHWC / N...C) by default — the TPU-friendly layout
 from __future__ import annotations
 
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 
 from tpu_syncbn.parallel.collectives import moments_from_stats, reduce_moments
+
+def set_pallas_mode(mode: str) -> None:
+    """Select the BN kernel backend: 'auto' (Pallas on TPU, XLA fusion
+    elsewhere), 'on' (always Pallas; interpret mode off-TPU), 'off'
+    (always the XLA-fusion path).
+
+    Read at *trace* time: steps already jit-compiled keep the backend they
+    were traced with — call this before building the trainer / first call,
+    or clear jax caches to re-trace.
+    """
+    global _PALLAS_MODE
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"pallas mode must be auto/on/off, got {mode!r}")
+    _PALLAS_MODE = mode
+
+
+_PALLAS_MODE = "auto"
+set_pallas_mode(os.environ.get("TPU_SYNCBN_PALLAS", "auto"))
+
+
+def _use_pallas() -> bool:
+    if _PALLAS_MODE == "on":
+        return True
+    if _PALLAS_MODE == "off":
+        return False
+    return jax.default_backend() == "tpu"
 
 
 def _reduction_axes(ndim: int, channel_axis: int) -> tuple[int, ...]:
@@ -106,6 +133,24 @@ def sync_moments(
     return mean, var, count
 
 
+def fold_scale_shift(
+    mean: jax.Array,
+    var: jax.Array,
+    weight: jax.Array | None,
+    bias: jax.Array | None,
+    eps: float,
+) -> tuple[jax.Array, jax.Array]:
+    """Fold (mean, var, γ, β, eps) into per-channel (scale, shift) so the
+    normalize is one FMA per element: ``y = x·scale + shift``. Single home
+    for this folding — used by both the XLA and Pallas paths."""
+    invstd = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+    scale = invstd if weight is None else invstd * weight.astype(jnp.float32)
+    shift = -mean.astype(jnp.float32) * scale
+    if bias is not None:
+        shift = shift + bias.astype(jnp.float32)
+    return scale, shift
+
+
 def batch_norm_elemt(
     x: jax.Array,
     mean: jax.Array,
@@ -120,13 +165,7 @@ def batch_norm_elemt(
     (``[torch] nn/modules/_functions.py:122``). Computes in f32, returns in
     x.dtype; XLA fuses the whole expression into neighbors."""
     shape = _shape_for_channel(x.ndim, channel_axis, mean.shape[0])
-    invstd = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
-    scale = invstd if weight is None else invstd * weight.astype(jnp.float32)
-    shift = (
-        -mean.astype(jnp.float32) * scale
-        if bias is None
-        else bias.astype(jnp.float32) - mean.astype(jnp.float32) * scale
-    )
+    scale, shift = fold_scale_shift(mean, var, weight, bias, eps)
     y = x.astype(jnp.float32) * scale.reshape(shape) + shift.reshape(shape)
     return y.astype(x.dtype)
 
@@ -193,10 +232,23 @@ def batch_norm_train(
     ``[sum_dy, sum_dy_xmu]`` exactly as the reference does by hand
     (``_functions.py:160-165``).
     """
-    mean, var, count = sync_moments(
-        x, channel_axis=channel_axis, axis_name=axis_name, mask=mask
-    )
-    y = batch_norm_elemt(x, mean, var, weight, bias, eps, channel_axis=channel_axis)
+    channel_last = channel_axis in (-1, x.ndim - 1)
+    if _use_pallas() and channel_last and mask is None:
+        # fused Pallas fast path (ops.pallas_bn): one-pass stats kernel,
+        # folded normalize, hand-derived backward issuing the reference's
+        # exact collectives
+        from tpu_syncbn.ops import pallas_bn
+
+        y, mean, var, count = pallas_bn.fused_batch_norm(
+            x, weight, bias, eps, axis_name
+        )
+    else:
+        mean, var, count = sync_moments(
+            x, channel_axis=channel_axis, axis_name=axis_name, mask=mask
+        )
+        y = batch_norm_elemt(
+            x, mean, var, weight, bias, eps, channel_axis=channel_axis
+        )
     if running_mean is None:
         return y, (None, None, None)
     # Buffers do not participate in autodiff (torch updates them in-place,

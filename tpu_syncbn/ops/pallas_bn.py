@@ -1,0 +1,306 @@
+"""Pallas TPU kernels for the BatchNorm hot ops.
+
+TPU-native equivalents of the ATen CUDA kernels the reference's SyncBN calls
+(``batch_norm_stats`` / ``batch_norm_elemt`` / ``batch_norm_backward_reduce``
+/ ``batch_norm_backward_elemt``, ``aten/src/ATen/native/cuda/
+Normalization.cu``, invoked from ``[torch] nn/modules/_functions.py:39,122,
+145,171`` — SURVEY §2 C9, a mandated native-equivalent component).
+
+Three fused single-pass kernels over a channel-last view ``(M, C)`` where
+``M = N·H·W``:
+
+* :func:`bn_stats`            — per-channel ``(Σx, Σx²)`` in one read of x.
+* :func:`bn_normalize`        — ``y = x·scale + shift`` (scale/shift folded
+                                from mean/var/γ/β on the host side of the
+                                kernel, so the inner loop is one FMA).
+* :func:`bn_backward_reduce`  — per-channel ``(Σdy, Σdy·x̂)`` in one fused
+                                read of (dy, x) — these are exactly the two
+                                tensors the reference all_reduces in its
+                                backward (``_functions.py:160-165``).
+
+All kernels accumulate in float32 VMEM scratch regardless of input dtype
+(bf16-safe), tile ``M`` on the sublane axis with channels on the lane axis
+(the natural TPU layout), and run under ``interpret=True`` off-TPU so the
+CPU test mesh exercises the same code path.
+
+``fused_batch_norm`` wires them into a ``jax.custom_vjp`` whose forward and
+backward issue the identical cross-replica psums as the XLA-fusion path in
+``ops.batch_norm`` — kernels swap in under the same numerical contract
+(golden-tested against both torch and the XLA path).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_syncbn.parallel.collectives import moments_from_stats
+
+# rows per grid step (sublane-aligned); channels ride the 128-wide lane axis
+_BLOCK_M = 256
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _as_2d(x: jax.Array) -> tuple[jax.Array, int]:
+    """Collapse all non-channel axes of a channel-last array into rows."""
+    c = x.shape[-1]
+    return x.reshape(-1, c), c
+
+
+def _pad_rows(x2: jax.Array, block: int) -> tuple[jax.Array, int]:
+    m = x2.shape[0]
+    padded = pl.cdiv(m, block) * block
+    if padded != m:
+        x2 = jnp.pad(x2, ((0, padded - m), (0, 0)))
+    return x2, m
+
+
+# -- stats kernel ---------------------------------------------------------
+
+
+def _stats_kernel(x_ref, sum_ref, sumsq_ref, acc_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    xf = x_ref[...].astype(jnp.float32)
+    acc_ref[0, :] += jnp.sum(xf, axis=0)
+    acc_ref[1, :] += jnp.sum(xf * xf, axis=0)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _done():
+        sum_ref[...] = acc_ref[0, :]
+        sumsq_ref[...] = acc_ref[1, :]
+
+
+def bn_stats(x: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused per-channel (sum, sumsq, count) — one pass over x.
+
+    Same contract as ``ops.batch_norm.batch_norm_stats`` (the XLA path);
+    the reference's ``batch_norm_stats`` CUDA kernel returns (mean, invstd)
+    but raw sums compose across replicas with a single psum (SURVEY §7).
+    """
+    x2, c = _as_2d(x)
+    x2, m = _pad_rows(x2, _BLOCK_M)  # zero rows contribute 0 to both sums
+    s, sq = _stats_2d(x2, c)
+    return s, sq, jnp.float32(m)
+
+
+def _stats_2d(x2: jax.Array, c: int) -> tuple[jax.Array, jax.Array]:
+    """Stats kernel over an already-padded (M', C) view."""
+    grid = (x2.shape[0] // _BLOCK_M,)
+    return pl.pallas_call(
+        _stats_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BLOCK_M, c), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        ],
+        out_specs=[
+            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((c,), jnp.float32),
+            jax.ShapeDtypeStruct((c,), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((2, c), jnp.float32)],
+        interpret=_interpret(),
+    )(x2)
+
+
+# -- normalize kernel -----------------------------------------------------
+
+
+def _normalize_kernel(x_ref, scale_ref, shift_ref, y_ref):
+    xf = x_ref[...].astype(jnp.float32)
+    y = xf * scale_ref[...] + shift_ref[...]
+    y_ref[...] = y.astype(y_ref.dtype)
+
+
+def bn_normalize(
+    x: jax.Array,
+    mean: jax.Array,
+    var: jax.Array,
+    weight: jax.Array | None,
+    bias: jax.Array | None,
+    eps: float,
+) -> jax.Array:
+    """Fused elementwise normalize+affine (``batch_norm_elemt``,
+    ``[torch] nn/modules/_functions.py:122``): scale/shift are folded to
+    one FMA per element (shared folding in ops.batch_norm)."""
+    from tpu_syncbn.ops.batch_norm import fold_scale_shift
+
+    scale, shift = fold_scale_shift(mean, var, weight, bias, eps)
+    x2, c = _as_2d(x)
+    x2p, m = _pad_rows(x2, _BLOCK_M)
+    y = _normalize_2d(x2p, scale, shift, c, x.dtype)
+    return y[:m].reshape(x.shape)
+
+
+def _normalize_2d(x2p, scale, shift, c, out_dtype):
+    """Normalize kernel over an already-padded (M', C) view."""
+    grid = (x2p.shape[0] // _BLOCK_M,)
+    return pl.pallas_call(
+        _normalize_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BLOCK_M, c), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (_BLOCK_M, c), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct(x2p.shape, out_dtype),
+        interpret=_interpret(),
+    )(x2p, scale, shift)
+
+
+# -- backward reduce kernel ----------------------------------------------
+
+
+def _bwd_reduce_kernel(dy_ref, x_ref, mean_ref, invstd_ref, sdy_ref, sdyx_ref, acc_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    dyf = dy_ref[...].astype(jnp.float32)
+    xf = x_ref[...].astype(jnp.float32)
+    xhat = (xf - mean_ref[...]) * invstd_ref[...]
+    acc_ref[0, :] += jnp.sum(dyf, axis=0)
+    acc_ref[1, :] += jnp.sum(dyf * xhat, axis=0)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _done():
+        sdy_ref[...] = acc_ref[0, :]
+        sdyx_ref[...] = acc_ref[1, :]
+
+
+def bn_backward_reduce(
+    dy: jax.Array, x: jax.Array, mean: jax.Array, invstd: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Fused per-channel (Σdy, Σdy·x̂) — the ``batch_norm_backward_reduce``
+    kernel (``[torch] nn/modules/_functions.py:145-154``); Σdy·x̂ relates to
+    torch's ``sum_dy_xmu`` by the invstd factor. Zero-padded rows contribute
+    dy=0, so the sums are exact."""
+    dy2, c = _as_2d(dy)
+    x2, _ = _as_2d(x)
+    dy2, m = _pad_rows(dy2, _BLOCK_M)
+    x2, _ = _pad_rows(x2, _BLOCK_M)
+    grid = (dy2.shape[0] // _BLOCK_M,)
+    sdy, sdyx = pl.pallas_call(
+        _bwd_reduce_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BLOCK_M, c), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_BLOCK_M, c), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((c,), jnp.float32),
+            jax.ShapeDtypeStruct((c,), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((2, c), jnp.float32)],
+        interpret=_interpret(),
+    )(dy2, x2, mean, invstd)
+    return sdy, sdyx
+
+
+# -- fused custom-vjp batch norm -----------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_batch_norm(x, weight, bias, eps: float, axis_name: str | None):
+    """Training-mode BN forward via Pallas kernels, with the hand-derived
+    backward of the reference (``[torch] nn/modules/_functions.py:128-180``):
+    forward psums (Σx, Σx², n); backward psums (Σdy, Σdy·x̂) — byte-for-byte
+    the reference's two collectives, fused kernels in between.
+
+    Returns ``(y, mean, var, count)`` (stats needed for the running-stat
+    update, which stays outside the differentiable path)."""
+    y, mean, var, count, _ = _fbn_fwd_impl(x, weight, bias, eps, axis_name)
+    return y, mean, var, count
+
+
+def _fbn_fwd_impl(x, weight, bias, eps, axis_name):
+    from tpu_syncbn.ops.batch_norm import fold_scale_shift
+
+    # pad the (M, C) view ONCE; both kernels share it
+    x2, c = _as_2d(x)
+    x2p, m = _pad_rows(x2, _BLOCK_M)
+    s, sq = _stats_2d(x2p, c)
+    count = jnp.float32(m)
+    if axis_name is not None:
+        s, sq, count = jax.lax.psum((s, sq, count), axis_name)
+    mean, var = moments_from_stats(s, sq, count)
+    scale, shift = fold_scale_shift(mean, var, weight, bias, eps)
+    y = _normalize_2d(x2p, scale, shift, c, x.dtype)[:m].reshape(x.shape)
+    invstd = jax.lax.rsqrt(var + eps)
+    return y, mean, var, count, invstd
+
+
+def _fbn_fwd(x, weight, bias, eps, axis_name):
+    y, mean, var, count, invstd = _fbn_fwd_impl(x, weight, bias, eps, axis_name)
+    return (y, mean, var, count), (x, weight, bias, mean, invstd, count)
+
+
+def _fbn_bwd(eps, axis_name, res, cts):
+    x, weight, bias, mean, invstd, count = res
+    dy = cts[0]  # cotangents for mean/var/count are ignored: stats feed the
+    # (stop-gradient) running buffers only, as in the reference where the
+    # buffer update happens inside a no-grad kernel
+
+    sum_dy, sum_dy_xhat = bn_backward_reduce(dy, x, mean, invstd)
+
+    # grad wrt weight/bias use the LOCAL per-replica sums: the reference
+    # computes them from the local backward_reduce (_functions.py:145-158)
+    # and lets DDP's gradient all-reduce aggregate across replicas — here
+    # the outer grad aggregation (shard_map transpose / trainer pmean)
+    # plays that role. Using the psum'd sums would double-count by world.
+    grad_weight = None if weight is None else sum_dy_xhat
+    grad_bias = None if bias is None else sum_dy
+
+    if axis_name is not None:
+        # the reference's backward all_reduce(SUM) of [sum_dy, sum_dy_xmu]
+        # (_functions.py:160-165) — feeds dx only
+        sum_dy, sum_dy_xhat = jax.lax.psum((sum_dy, sum_dy_xhat), axis_name)
+
+    # batch_norm_backward_elemt: dx = (dy - Σdy/n - x̂·Σdy·x̂/n)·invstd·γ
+    c = x.shape[-1]
+    w = jnp.ones((c,), jnp.float32) if weight is None else weight.astype(jnp.float32)
+    mean_dy = sum_dy / count
+    mean_dy_xhat = sum_dy_xhat / count
+
+    def dx_fn(xv, dyv):
+        xhat = (xv.astype(jnp.float32) - mean) * invstd
+        dxv = (
+            (dyv.astype(jnp.float32) - mean_dy - xhat * mean_dy_xhat)
+            * invstd
+            * w
+        )
+        return dxv.astype(xv.dtype)
+
+    dx = dx_fn(x, dy)
+    gw = None if weight is None else grad_weight.astype(weight.dtype)
+    gb = None if bias is None else grad_bias.astype(bias.dtype)
+    return dx, gw, gb
+
+
+fused_batch_norm.defvjp(_fbn_fwd, _fbn_bwd)
