@@ -1,0 +1,130 @@
+// Distributed-sampler index generation — native equivalent of the
+// reference's DistributedSampler index arithmetic
+// ([torch] utils/data/distributed.py:107-134), which torch runs in Python
+// per epoch. Implements the exact MT19937 + bounded-rejection Fisher-Yates
+// permutation of numpy's legacy RandomState, so the Python sampler
+// (tpu_syncbn/data/sampler.py) and this native path produce bit-identical
+// index streams — parity is enforced by tests/test_native.py.
+//
+// Exposed via C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// ---- numpy legacy MT19937 (rk_state equivalent) -------------------------
+
+struct MT19937 {
+  uint32_t key[624];
+  int pos;
+
+  explicit MT19937(uint32_t seed) {
+    // numpy mt19937_seed: init_genrand
+    key[0] = seed;
+    for (int i = 1; i < 624; ++i) {
+      key[i] = 1812433253u * (key[i - 1] ^ (key[i - 1] >> 30)) + i;
+    }
+    pos = 624;
+  }
+
+  uint32_t next32() {
+    if (pos >= 624) {
+      // generate 624 words at once (mt19937_gen)
+      for (int i = 0; i < 624 - 397; ++i) {
+        uint32_t y = (key[i] & 0x80000000u) | (key[i + 1] & 0x7fffffffu);
+        key[i] = key[i + 397] ^ (y >> 1) ^ (-(int32_t)(y & 1) & 0x9908b0dfu);
+      }
+      for (int i = 624 - 397; i < 623; ++i) {
+        uint32_t y = (key[i] & 0x80000000u) | (key[i + 1] & 0x7fffffffu);
+        key[i] = key[i + (397 - 624)] ^ (y >> 1) ^
+                 (-(int32_t)(y & 1) & 0x9908b0dfu);
+      }
+      uint32_t y = (key[623] & 0x80000000u) | (key[0] & 0x7fffffffu);
+      key[623] = key[396] ^ (y >> 1) ^ (-(int32_t)(y & 1) & 0x9908b0dfu);
+      pos = 0;
+    }
+    uint32_t y = key[pos++];
+    // tempering
+    y ^= y >> 11;
+    y ^= (y << 7) & 0x9d2c5680u;
+    y ^= (y << 15) & 0xefc60000u;
+    y ^= y >> 18;
+    return y;
+  }
+
+  // numpy rk_interval / mt19937_interval: uniform integer in [0, max]
+  // via masked rejection, 32-bit path for max <= 0xffffffff.
+  uint64_t interval(uint64_t max) {
+    if (max == 0) return 0;
+    uint64_t mask = max;
+    mask |= mask >> 1;
+    mask |= mask >> 2;
+    mask |= mask >> 4;
+    mask |= mask >> 8;
+    mask |= mask >> 16;
+    mask |= mask >> 32;
+    uint64_t value;
+    if (max <= 0xffffffffull) {
+      while ((value = (next32() & mask)) > max) {
+      }
+    } else {
+      while ((value = (((uint64_t)next32() << 32 | next32()) & mask)) > max) {
+      }
+    }
+    return value;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// numpy RandomState(seed).permutation(n) — Fisher-Yates from the tail with
+// rk_interval draws, identical bit stream to numpy's legacy generator.
+void tsb_permutation(uint32_t seed, int64_t n, int64_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = i;
+  MT19937 rng(seed);
+  for (int64_t i = n - 1; i > 0; --i) {
+    int64_t j = (int64_t)rng.interval((uint64_t)i);
+    int64_t t = out[i];
+    out[i] = out[j];
+    out[j] = t;
+  }
+}
+
+// Full DistributedSampler epoch shard: permutation (or arange), pad/truncate,
+// strided subsample ([torch] utils/data/distributed.py:107-134 semantics).
+// `out` must hold num_samples entries where
+//   num_samples = drop_last ? length/world : ceil(length/world).
+// Returns the number of entries written, or -1 on invalid arguments.
+int64_t tsb_sampler_indices(int64_t length, int32_t world, int32_t rank,
+                            uint32_t seed, int64_t epoch, int32_t shuffle,
+                            int32_t drop_last, int64_t* out) {
+  if (length < 0 || world < 1 || rank < 0 || rank >= world) return -1;
+  std::vector<int64_t> indices(length);
+  if (shuffle) {
+    tsb_permutation((uint32_t)(seed + epoch), length, indices.data());
+  } else {
+    for (int64_t i = 0; i < length; ++i) indices[i] = i;
+  }
+
+  int64_t num_samples =
+      drop_last ? length / world : (length + world - 1) / world;
+  int64_t total = num_samples * world;
+
+  if (!drop_last && total > length && length > 0) {
+    int64_t pad = total - length;
+    indices.reserve(total);
+    for (int64_t i = 0; i < pad; ++i) indices.push_back(indices[i % length]);
+  } else {
+    indices.resize(total);
+  }
+
+  int64_t w = 0;
+  for (int64_t i = rank; i < total; i += world) out[w++] = indices[i];
+  return w;
+}
+
+}  // extern "C"

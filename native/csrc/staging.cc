@@ -1,0 +1,125 @@
+// Staging ring buffer — native equivalent of the reference DataLoader's
+// pinned-memory staging (pin_memory=True spawns a thread that copies each
+// batch into page-locked host memory so the device DMA is async;
+// reference README.md:88, [torch] utils/data/dataloader.py pin thread +
+// CachingHostAllocator). TPUs DMA from ordinary aligned host pages, so the
+// equivalent is a pool of 64-byte-aligned, madvise-friendly slots reused
+// across steps: no per-batch malloc/free, stable addresses for zero-copy
+// numpy views, producer/consumer handoff without the GIL.
+//
+// C ABI for ctypes. One ring per loader; slots hold one staged batch each.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Slot {
+  void* data = nullptr;
+  int64_t size = 0;   // payload bytes committed
+  int state = 0;      // 0 = free, 1 = filling, 2 = ready (mutex-guarded)
+};
+
+struct Ring {
+  std::vector<Slot> slots;
+  int64_t slot_bytes = 0;
+  std::mutex mu;
+  std::condition_variable cv_free;
+  std::condition_variable cv_ready;
+  int64_t head = 0;  // next slot to consume
+  int64_t tail = 0;  // next slot to fill
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tsb_ring_create(int32_t n_slots, int64_t slot_bytes) {
+  if (n_slots < 1 || slot_bytes < 1) return nullptr;
+  Ring* r = new Ring();
+  r->slots.resize(n_slots);
+  r->slot_bytes = slot_bytes;
+  for (auto& s : r->slots) {
+    if (posix_memalign(&s.data, 64, (size_t)slot_bytes) != 0) {
+      for (auto& t : r->slots)
+        if (t.data) free(t.data);
+      delete r;
+      return nullptr;
+    }
+  }
+  return r;
+}
+
+void tsb_ring_destroy(void* ring) {
+  Ring* r = (Ring*)ring;
+  if (!r) return;
+  for (auto& s : r->slots)
+    if (s.data) free(s.data);
+  delete r;
+}
+
+// Producer: block until a free slot, return its buffer (capacity
+// slot_bytes). Returns slot id >= 0, or -1 if ring is null.
+int64_t tsb_ring_acquire(void* ring, void** buf_out) {
+  Ring* r = (Ring*)ring;
+  if (!r) return -1;
+  std::unique_lock<std::mutex> lk(r->mu);
+  // recompute the target slot from the CURRENT tail inside the predicate:
+  // two producers waiting concurrently must not latch the same stale index
+  r->cv_free.wait(lk, [&] {
+    return r->slots[r->tail % (int64_t)r->slots.size()].state == 0;
+  });
+  int64_t idx = r->tail % (int64_t)r->slots.size();
+  r->slots[idx].state = 1;
+  r->tail++;
+  *buf_out = r->slots[idx].data;
+  return idx;
+}
+
+// Producer: mark the acquired slot ready with `size` payload bytes.
+void tsb_ring_commit(void* ring, int64_t slot, int64_t size) {
+  Ring* r = (Ring*)ring;
+  std::lock_guard<std::mutex> lk(r->mu);
+  r->slots[slot].size = size;
+  r->slots[slot].state = 2;
+  r->cv_ready.notify_all();
+}
+
+// Consumer: block until the next slot (FIFO) is ready; returns its buffer
+// and payload size. Returns slot id, or -1 on null ring.
+int64_t tsb_ring_consume(void* ring, void** buf_out, int64_t* size_out) {
+  Ring* r = (Ring*)ring;
+  if (!r) return -1;
+  std::unique_lock<std::mutex> lk(r->mu);
+  int64_t idx = r->head % (int64_t)r->slots.size();
+  r->cv_ready.wait(lk, [&] { return r->slots[idx].state == 2; });
+  r->head++;
+  *buf_out = r->slots[idx].data;
+  *size_out = r->slots[idx].size;
+  return idx;
+}
+
+// Consumer: release a consumed slot back to the free pool.
+void tsb_ring_release(void* ring, int64_t slot) {
+  Ring* r = (Ring*)ring;
+  std::lock_guard<std::mutex> lk(r->mu);
+  r->slots[slot].state = 0;
+  r->cv_free.notify_all();
+}
+
+int64_t tsb_ring_slot_bytes(void* ring) {
+  Ring* r = (Ring*)ring;
+  return r ? r->slot_bytes : -1;
+}
+
+// Parallel memcpy into a staging buffer (the fill side of the pin thread).
+void tsb_memcpy(void* dst, const void* src, int64_t bytes) {
+  memcpy(dst, src, (size_t)bytes);
+}
+
+}  // extern "C"

@@ -1,0 +1,278 @@
+// TCP key-value store — native equivalent of torch's C++ TCPStore, the
+// rendezvous backend behind init_method='env://' (reference README.md:32;
+// [torch] distributed/distributed_c10d.py:1889 builds a TCPStore from
+// MASTER_ADDR/MASTER_PORT). On TPU slices jax.distributed's coordination
+// service replaces this, but the capability — a standalone bootstrap
+// store + barrier usable off-slice (CPU clusters, tests) — is part of the
+// reference surface (SURVEY §2 C4).
+//
+// Protocol (binary, length-prefixed):
+//   SET  't' u32 klen key u32 vlen val        -> 'k'
+//   GET  'g' u32 klen key                     -> 'v' u32 vlen val   (blocks
+//                                                until the key exists)
+//   ADD  'a' u32 klen key i64 delta           -> 'i' i64 newval
+//   WAIT is GET's blocking behavior; BARRIER = ADD + GET on a counter.
+//
+// C ABI for ctypes; server runs a thread per connection (worlds are small:
+// one connection per host process).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::map<std::string, std::string> kv;
+  std::map<std::string, int64_t> counters;
+  std::mutex mu;
+  std::condition_variable cv;
+};
+
+struct Server {
+  int listen_fd = -1;
+  uint16_t port = 0;
+  Store store;
+  std::vector<std::thread> threads;
+  std::vector<int> conn_fds;      // guarded by conn_mu
+  std::mutex conn_mu;
+  std::thread accept_thread;
+  std::atomic<bool> stopping{false};
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = (char*)buf;
+  while (n) {
+    ssize_t k = recv(fd, p, n, 0);
+    if (k <= 0) return false;
+    p += k;
+    n -= (size_t)k;
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = (const char*)buf;
+  while (n) {
+    ssize_t k = send(fd, p, n, MSG_NOSIGNAL);
+    if (k <= 0) return false;
+    p += k;
+    n -= (size_t)k;
+  }
+  return true;
+}
+
+bool read_str(int fd, std::string* out) {
+  uint32_t len;
+  if (!read_full(fd, &len, 4)) return false;
+  out->resize(len);
+  return len == 0 || read_full(fd, &(*out)[0], len);
+}
+
+bool write_str(int fd, const std::string& s) {
+  uint32_t len = (uint32_t)s.size();
+  return write_full(fd, &len, 4) &&
+         (len == 0 || write_full(fd, s.data(), len));
+}
+
+void serve_conn(Server* srv, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    char op;
+    if (!read_full(fd, &op, 1)) break;
+    if (op == 't') {  // SET
+      std::string key, val;
+      if (!read_str(fd, &key) || !read_str(fd, &val)) break;
+      {
+        std::lock_guard<std::mutex> lk(srv->store.mu);
+        srv->store.kv[key] = val;
+      }
+      srv->store.cv.notify_all();
+      char ok = 'k';
+      if (!write_full(fd, &ok, 1)) break;
+    } else if (op == 'g') {  // GET (blocking)
+      std::string key, val;
+      if (!read_str(fd, &key)) break;
+      {
+        std::unique_lock<std::mutex> lk(srv->store.mu);
+        srv->store.cv.wait(lk, [&] {
+          return srv->stopping.load() ||
+                 srv->store.kv.count(key) > 0;
+        });
+        if (srv->stopping.load()) break;
+        val = srv->store.kv[key];
+      }
+      char tag = 'v';
+      if (!write_full(fd, &tag, 1) || !write_str(fd, val)) break;
+    } else if (op == 'a') {  // ADD
+      std::string key;
+      int64_t delta, result;
+      if (!read_str(fd, &key) || !read_full(fd, &delta, 8)) break;
+      {
+        std::lock_guard<std::mutex> lk(srv->store.mu);
+        result = (srv->store.counters[key] += delta);
+        // mirror into kv so GET can wait on counters
+        srv->store.kv[key] = std::to_string(result);
+      }
+      srv->store.cv.notify_all();
+      char tag = 'i';
+      if (!write_full(fd, &tag, 1) || !write_full(fd, &result, 8)) break;
+    } else {
+      break;  // unknown op: drop connection
+    }
+  }
+  {
+    // prune before close: stop() must never shutdown() a reused fd number
+    std::lock_guard<std::mutex> lk(srv->conn_mu);
+    for (auto it = srv->conn_fds.begin(); it != srv->conn_fds.end(); ++it) {
+      if (*it == fd) {
+        srv->conn_fds.erase(it);
+        break;
+      }
+    }
+  }
+  close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start a store server on `port` (0 = ephemeral). Returns opaque handle or
+// null; *port_out receives the bound port.
+void* tsb_store_server_start(uint16_t port, uint16_t* port_out) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 || listen(fd, 128) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (sockaddr*)&addr, &alen);
+
+  Server* srv = new Server();
+  srv->listen_fd = fd;
+  srv->port = ntohs(addr.sin_port);
+  if (port_out) *port_out = srv->port;
+
+  srv->accept_thread = std::thread([srv] {
+    for (;;) {
+      int cfd = accept(srv->listen_fd, nullptr, nullptr);
+      if (cfd < 0) break;  // listen_fd closed -> shutdown
+      if (srv->stopping.load()) {
+        close(cfd);
+        break;
+      }
+      {
+        std::lock_guard<std::mutex> lk(srv->conn_mu);
+        srv->conn_fds.push_back(cfd);
+      }
+      srv->threads.emplace_back(serve_conn, srv, cfd);
+    }
+  });
+  return srv;
+}
+
+void tsb_store_server_stop(void* handle) {
+  Server* srv = (Server*)handle;
+  if (!srv) return;
+  srv->stopping.store(true);
+  srv->store.cv.notify_all();     // release blocked GETs
+  {
+    // unblock per-connection threads stuck in recv() on live connections
+    std::lock_guard<std::mutex> lk(srv->conn_mu);
+    for (int fd : srv->conn_fds) shutdown(fd, SHUT_RDWR);
+  }
+  shutdown(srv->listen_fd, SHUT_RDWR);
+  close(srv->listen_fd);          // unblocks accept()
+  if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  for (auto& t : srv->threads)
+    if (t.joinable()) t.join();
+  delete srv;
+}
+
+// ---- client ------------------------------------------------------------
+
+// Connect to host:port. Returns fd >= 0 or -1.
+int32_t tsb_store_connect(const char* host, uint16_t port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(fd);
+    return -1;
+  }
+  if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+void tsb_store_close(int32_t fd) {
+  if (fd >= 0) close(fd);
+}
+
+int32_t tsb_store_set(int32_t fd, const char* key, const uint8_t* val,
+                      uint32_t vlen) {
+  char op = 't';
+  std::string k(key), v((const char*)val, vlen);
+  if (!write_full(fd, &op, 1) || !write_str(fd, k) || !write_str(fd, v))
+    return -1;
+  char resp;
+  return read_full(fd, &resp, 1) && resp == 'k' ? 0 : -1;
+}
+
+// Blocking get. Caller provides buf of cap bytes; returns value length (may
+// exceed cap — then only cap bytes are written) or -1.
+int64_t tsb_store_get(int32_t fd, const char* key, uint8_t* buf,
+                      int64_t cap) {
+  char op = 'g';
+  std::string k(key);
+  if (!write_full(fd, &op, 1) || !write_str(fd, k)) return -1;
+  char tag;
+  if (!read_full(fd, &tag, 1) || tag != 'v') return -1;
+  std::string v;
+  if (!read_str(fd, &v)) return -1;
+  int64_t n = (int64_t)v.size() < cap ? (int64_t)v.size() : cap;
+  memcpy(buf, v.data(), (size_t)n);
+  return (int64_t)v.size();
+}
+
+int64_t tsb_store_add(int32_t fd, const char* key, int64_t delta) {
+  char op = 'a';
+  std::string k(key);
+  if (!write_full(fd, &op, 1) || !write_str(fd, k) ||
+      !write_full(fd, &delta, 8))
+    return INT64_MIN;
+  char tag;
+  int64_t result;
+  if (!read_full(fd, &tag, 1) || tag != 'i' || !read_full(fd, &result, 8))
+    return INT64_MIN;
+  return result;
+}
+
+}  // extern "C"

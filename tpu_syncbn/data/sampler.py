@@ -114,8 +114,23 @@ class DistributedSampler(Sampler):
         self.epoch = epoch
 
     def __iter__(self):
+        from tpu_syncbn.runtime import native
+
+        if native.available():
+            # native C++ path: bit-identical to the numpy code below
+            # (MT19937 parity enforced by tests/test_native.py)
+            shard = native.sampler_indices(
+                self.dataset_length, self.num_replicas, self.rank,
+                self.seed, self.epoch, self.shuffle, self.drop_last,
+            )
+            if shard is not None:
+                assert len(shard) == self.num_samples
+                return iter(shard.tolist())
+
         if self.shuffle:
-            rng = np.random.RandomState(self.seed + self.epoch)  # :110-112
+            # wrap to the 32-bit seed domain so the python and native paths
+            # agree for seed+epoch >= 2**32 (numpy would raise otherwise)
+            rng = np.random.RandomState((self.seed + self.epoch) % 2**32)  # :110-112
             indices = rng.permutation(self.dataset_length)
         else:
             indices = np.arange(self.dataset_length)  # :113-114

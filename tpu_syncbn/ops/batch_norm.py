@@ -54,7 +54,21 @@ def set_pallas_mode(mode: str) -> None:
 
 
 _PALLAS_MODE = "auto"
-set_pallas_mode(os.environ.get("TPU_SYNCBN_PALLAS", "auto"))
+_ENV_ALIASES = {
+    "1": "on", "true": "on", "yes": "on", "on": "on",
+    "0": "off", "false": "off", "no": "off", "off": "off",
+    "auto": "auto", "": "auto",
+}
+_env_mode = os.environ.get("TPU_SYNCBN_PALLAS", "auto").strip().lower()
+if _env_mode in _ENV_ALIASES:
+    set_pallas_mode(_ENV_ALIASES[_env_mode])
+else:
+    import warnings
+
+    warnings.warn(
+        f"ignoring unrecognized TPU_SYNCBN_PALLAS={_env_mode!r} "
+        "(expected on/off/auto or 1/0/true/false); using 'auto'"
+    )
 
 
 def _use_pallas() -> bool:
