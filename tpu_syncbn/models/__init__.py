@@ -1,6 +1,8 @@
 """Model zoo: the architectures named by the reference's capability configs
 (ResNet-18/50, RetinaNet-R50-FPN, DCGAN/SNGAN — BASELINE.json)."""
 
+from tpu_syncbn.models import detection
+from tpu_syncbn.models.retinanet import RetinaNet, FPN, RetinaHead, retinanet_r50_fpn
 from tpu_syncbn.models.resnet import (
     ResNet,
     BasicBlock,
@@ -14,6 +16,11 @@ from tpu_syncbn.models.resnet import (
 )
 
 __all__ = [
+    "detection",
+    "RetinaNet",
+    "FPN",
+    "RetinaHead",
+    "retinanet_r50_fpn",
     "ResNet",
     "BasicBlock",
     "Bottleneck",

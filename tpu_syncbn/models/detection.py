@@ -1,0 +1,188 @@
+"""Detection building blocks: anchors, box coding, IoU matching, losses.
+
+Object detection is the reference's flagship SyncBN use case ("this
+performance drop is known to happen for object detection models",
+reference ``README.md:3``; RetinaNet-R50-FPN at per-chip batch=2 is the
+capability config in BASELINE.json). All ops are static-shape and
+jit-friendly: ground truth arrives padded to a fixed ``max_boxes`` with a
+validity mask, matching is a dense IoU argmax, and losses mask invalid
+entries — no data-dependent shapes anywhere (XLA requirement).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+# -- anchors --------------------------------------------------------------
+
+
+def generate_level_anchors(
+    feat_h: int,
+    feat_w: int,
+    stride: int,
+    sizes: Sequence[float],
+    ratios: Sequence[float] = (0.5, 1.0, 2.0),
+) -> jnp.ndarray:
+    """Anchors for one FPN level, (H*W*A, 4) as (x1, y1, x2, y2), centered
+    on the stride grid (torchvision AnchorGenerator semantics)."""
+    base = []
+    for size in sizes:
+        area = float(size) ** 2
+        for r in ratios:
+            w = math.sqrt(area / r)
+            h = w * r
+            base.append([-w / 2, -h / 2, w / 2, h / 2])
+    base_a = jnp.asarray(base, jnp.float32)  # (A, 4)
+
+    cx = (jnp.arange(feat_w, dtype=jnp.float32) + 0.5) * stride
+    cy = (jnp.arange(feat_h, dtype=jnp.float32) + 0.5) * stride
+    cxg, cyg = jnp.meshgrid(cx, cy, indexing="xy")
+    centers = jnp.stack([cxg, cyg, cxg, cyg], axis=-1).reshape(-1, 1, 4)
+    return (centers + base_a[None]).reshape(-1, 4)
+
+
+def retinanet_anchors(
+    image_size: tuple[int, int],
+    strides: Sequence[int] = (8, 16, 32, 64, 128),
+    anchor_scale: float = 4.0,
+) -> jnp.ndarray:
+    """All-level RetinaNet anchors concatenated: per level, 3 octave scales
+    (2^0, 2^1/3, 2^2/3) × 3 ratios, base size ``anchor_scale × stride``."""
+    h, w = image_size
+    out = []
+    for stride in strides:
+        sizes = [anchor_scale * stride * (2 ** (o / 3)) for o in range(3)]
+        out.append(
+            generate_level_anchors(
+                math.ceil(h / stride), math.ceil(w / stride), stride, sizes
+            )
+        )
+    return jnp.concatenate(out, axis=0)
+
+
+# -- box coding -----------------------------------------------------------
+
+
+def box_encode(boxes: jnp.ndarray, anchors: jnp.ndarray) -> jnp.ndarray:
+    """(x1y1x2y2 boxes, anchors) → (dx, dy, dw, dh) regression targets
+    (Faster-R-CNN coding, weights 1)."""
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    ax = anchors[..., 0] + 0.5 * aw
+    ay = anchors[..., 1] + 0.5 * ah
+    bw = jnp.maximum(boxes[..., 2] - boxes[..., 0], 1e-6)
+    bh = jnp.maximum(boxes[..., 3] - boxes[..., 1], 1e-6)
+    bx = boxes[..., 0] + 0.5 * bw
+    by = boxes[..., 1] + 0.5 * bh
+    return jnp.stack(
+        [(bx - ax) / aw, (by - ay) / ah, jnp.log(bw / aw), jnp.log(bh / ah)],
+        axis=-1,
+    )
+
+
+def box_decode(deltas: jnp.ndarray, anchors: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`box_encode`; clamps dw/dh like torchvision
+    (log(1000/16) ≈ 4.135) for numerical safety."""
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    ax = anchors[..., 0] + 0.5 * aw
+    ay = anchors[..., 1] + 0.5 * ah
+    clamp = math.log(1000.0 / 16)
+    dx, dy = deltas[..., 0], deltas[..., 1]
+    dw = jnp.clip(deltas[..., 2], -clamp, clamp)
+    dh = jnp.clip(deltas[..., 3], -clamp, clamp)
+    cx = dx * aw + ax
+    cy = dy * ah + ay
+    w = jnp.exp(dw) * aw
+    h = jnp.exp(dh) * ah
+    return jnp.stack(
+        [cx - 0.5 * w, cy - 0.5 * h, cx + 0.5 * w, cy + 0.5 * h], axis=-1
+    )
+
+
+# -- IoU + matching -------------------------------------------------------
+
+
+def box_iou(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise IoU: (N, 4) × (M, 4) → (N, M)."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0) * jnp.clip(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0) * jnp.clip(b[:, 3] - b[:, 1], 0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def match_anchors(
+    anchors: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    gt_valid: jnp.ndarray,
+    *,
+    high: float = 0.5,
+    low: float = 0.4,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Max-IoU assigner (torchvision Matcher semantics with
+    allow_low_quality_matches): per anchor, the best valid GT index or
+    -1 (background) / -2 (ignore, between thresholds). Anchors that are the
+    argmax for some GT are force-matched to it.
+
+    Returns (matched_idx (N,), max_iou (N,)).
+    """
+    iou = box_iou(anchors, gt_boxes)  # (N, M)
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=1)
+    best_iou = jnp.max(iou, axis=1)
+    matched = jnp.where(
+        best_iou >= high, best_gt, jnp.where(best_iou < low, -1, -2)
+    )
+    # low-quality promotion: for each valid GT, every anchor achieving that
+    # GT's best IoU is force-matched to it. Dense formulation (no scatter:
+    # padded invalid GTs must not clobber valid promotions — their masked
+    # IoU columns argmax to anchor 0). When an anchor ties as best for
+    # several GTs, the highest GT index wins, matching torch's sequential
+    # overwrite ([torch] Matcher.set_low_quality_matches_).
+    gt_best_iou = jnp.max(iou, axis=0)  # (M,)
+    ok = gt_valid & (gt_best_iou > 0)
+    is_best = (iou >= gt_best_iou[None, :]) & ok[None, :]  # (N, M)
+    m = gt_boxes.shape[0]
+    rev = is_best[:, ::-1]
+    promote_to = (m - 1 - jnp.argmax(rev, axis=1)).astype(jnp.int32)
+    has_promo = jnp.any(is_best, axis=1)
+    matched = jnp.where(has_promo, promote_to, matched)
+    return matched, best_iou
+
+
+# -- losses ---------------------------------------------------------------
+
+
+def sigmoid_focal_loss(
+    logits: jnp.ndarray,
+    targets: jnp.ndarray,
+    *,
+    alpha: float = 0.25,
+    gamma: float = 2.0,
+) -> jnp.ndarray:
+    """Elementwise sigmoid focal loss (RetinaNet paper; torchvision
+    ``sigmoid_focal_loss`` semantics, reduction='none')."""
+    import optax
+
+    p = jax.nn.sigmoid(logits)
+    ce = optax.sigmoid_binary_cross_entropy(logits, targets)
+    p_t = p * targets + (1 - p) * (1 - targets)
+    loss = ce * (1 - p_t) ** gamma
+    if alpha >= 0:
+        alpha_t = alpha * targets + (1 - alpha) * (1 - targets)
+        loss = alpha_t * loss
+    return loss
+
+
+def smooth_l1(pred: jnp.ndarray, target: jnp.ndarray, beta: float = 0.1111) -> jnp.ndarray:
+    d = jnp.abs(pred - target)
+    return jnp.where(d < beta, 0.5 * d * d / beta, d - 0.5 * beta)
