@@ -1,0 +1,194 @@
+"""DCGAN/SNGAN tests: shapes, spectral norm correctness, GAN trainer with
+SyncBN in G and D, torch-faithful running-stat update ordering."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import nnx
+
+from tpu_syncbn import nn as tnn, parallel
+from tpu_syncbn.models import gan
+from tpu_syncbn.parallel.gan_trainer import GANTrainer
+
+LATENT = 32
+
+
+def small_gan(seed=0, use_bn_in_d=True, sn=False):
+    g = gan.DCGANGenerator(latent_dim=LATENT, width=32, rngs=nnx.Rngs(seed))
+    if sn:
+        d = gan.SNGANDiscriminator(width=16, use_bn=use_bn_in_d, rngs=nnx.Rngs(seed + 1))
+    else:
+        d = gan.DCGANDiscriminator(width=16, rngs=nnx.Rngs(seed + 1))
+    return g, d
+
+
+def test_generator_output_shape_and_range():
+    g, _ = small_gan()
+    z = jnp.asarray(np.random.RandomState(0).randn(4, LATENT), jnp.float32)
+    img = g(z)
+    assert img.shape == (4, 32, 32, 3)
+    assert float(jnp.abs(img).max()) <= 1.0
+
+
+def test_discriminator_logit_shape():
+    _, d = small_gan()
+    x = jnp.zeros((4, 32, 32, 3))
+    assert d(x).shape == (4,)
+
+
+def test_snconv_normalizes_spectral_norm():
+    """After SN, the effective kernel's top singular value ≈ 1."""
+    conv = gan.SNConv(3, 8, (3, 3), (1, 1), nnx.Rngs(0))
+    # scale the kernel up so sigma is clearly > 1 pre-normalization
+    conv.conv.kernel[...] = conv.conv.kernel[...] * 10.0
+    x = jnp.zeros((1, 8, 8, 3))
+    for _ in range(30):  # power iteration converges across forwards
+        conv(x)
+    k = np.asarray(conv.conv.kernel[...]).reshape(-1, 8)
+    true_sigma = np.linalg.svd(k, compute_uv=False)[0]
+    u = np.asarray(conv.u[...])
+    v = k @ u
+    v /= np.linalg.norm(v) + 1e-12
+    u2 = k.T @ v
+    u2 /= np.linalg.norm(u2) + 1e-12
+    est = v @ k @ u2
+    np.testing.assert_allclose(est, true_sigma, rtol=1e-3)
+
+
+def test_snconv_eval_freezes_u():
+    conv = gan.SNConv(3, 8, (3, 3), (1, 1), nnx.Rngs(0))
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 8, 8, 3), jnp.float32)
+    conv(x)
+    conv.eval()
+    u_before = np.asarray(conv.u[...])
+    conv(x)
+    np.testing.assert_array_equal(np.asarray(conv.u[...]), u_before)
+
+
+def test_gan_losses_values():
+    real = jnp.asarray([2.0, 2.0])
+    fake = jnp.asarray([-2.0, -2.0])
+    d_bce, g_bce = gan.bce_gan_losses(real, fake)
+    assert float(d_bce) < 0.3      # confident D → small loss
+    assert float(g_bce) > 1.5      # G penalized for fooled=false
+    d_h, g_h = gan.hinge_gan_losses(real, fake)
+    assert float(d_h) == 0.0       # margins satisfied
+    np.testing.assert_allclose(float(g_h), 2.0)
+
+
+def test_running_stat_update_ordering_matches_torch_loop():
+    """Per full iteration: G's BN sees 2 train forwards (D-step fake gen +
+    G-step), D's BN sees 3 (real, detached fake, G-step fake) — torch DCGAN
+    loop semantics (SURVEY §7 'GAN case')."""
+    g, d = small_gan()
+    tnn.convert_sync_batchnorm(g)
+    tnn.convert_sync_batchnorm(d)
+    trainer = GANTrainer(g, d, optax.adam(2e-4), optax.adam(2e-4))
+    B = 16
+    rng = np.random.RandomState(0)
+    real = jnp.asarray(rng.randn(B, 32, 32, 3), jnp.float32)
+    z1 = jnp.asarray(rng.randn(B, LATENT), jnp.float32)
+    z2 = jnp.asarray(rng.randn(B, LATENT), jnp.float32)
+    trainer.train_step(real, z1, z2)
+    G, D = trainer.sync_to_models()
+    assert int(G.bn0.num_batches_tracked[...]) == 2
+    assert int(D.bn2.num_batches_tracked[...]) == 3
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("loss,sn", [("bce", False), ("hinge", True)])
+def test_gan_training_learns_to_discriminate(loss, sn):
+    """A few steps on fixed real data: D(real) should move above D(fake),
+    losses stay finite — both DCGAN/BCE and SNGAN/hinge paths, SyncBN in
+    G and D over 8 replicas."""
+    g, d = small_gan(sn=sn)
+    tnn.convert_sync_batchnorm(g)
+    tnn.convert_sync_batchnorm(d)
+    trainer = GANTrainer(
+        g, d, optax.adam(1e-4, b1=0.5), optax.adam(4e-4, b1=0.5), loss=loss
+    )
+    B = 16
+    rng = np.random.RandomState(1)
+    real = jnp.asarray(np.sign(rng.randn(B, 32, 32, 3)) * 0.8, jnp.float32)
+    out = None
+    for i in range(12):
+        z1 = jnp.asarray(rng.randn(B, LATENT), jnp.float32)
+        z2 = jnp.asarray(rng.randn(B, LATENT), jnp.float32)
+        out = trainer.train_step(real, z1, z2)
+    assert np.isfinite(float(out.d_loss)) and np.isfinite(float(out.g_loss))
+    assert float(out.metrics["d_real"]) > float(out.metrics["d_fake"])
+    img = trainer.generate(jnp.asarray(rng.randn(2, LATENT), jnp.float32))
+    assert img.shape == (2, 32, 32, 3)
+
+
+def test_gan_trainer_rejects_unknown_loss():
+    g, d = small_gan()
+    with pytest.raises(ValueError, match="loss must be"):
+        GANTrainer(g, d, optax.adam(1e-4), optax.adam(1e-4), loss="wasserstein")
+
+
+def test_snconv_eval_propagates_from_parent_module():
+    """Regression: d.eval() on the PARENT must freeze every SNConv's power
+    iteration (mode flag rides nnx's use_running_average propagation)."""
+    d = gan.SNGANDiscriminator(width=8, rngs=nnx.Rngs(0))
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 32, 32, 3), jnp.float32)
+    d(x)
+    d.eval()
+    assert d.conv1.use_running_average
+    u_before = np.asarray(d.conv1.u[...])
+    d(x)
+    np.testing.assert_array_equal(np.asarray(d.conv1.u[...]), u_before)
+    d.train()
+    d(x)
+    assert not np.array_equal(np.asarray(d.conv1.u[...]), u_before)
+
+
+def test_snconv_gradient_flows_through_sigma():
+    """torch.nn.utils.spectral_norm detaches only u/v: for a (1,1,2,1)
+    kernel, W_sn = w/|w| so d(c·W_sn)/dw = (I - ŵŵᵀ)c/|w| — in particular
+    grad ⊥ w. A stop-gradient-through-sigma implementation gives c/|w|
+    instead."""
+    conv = gan.SNConv(2, 1, (1, 1), (1, 1), nnx.Rngs(0), padding="VALID")
+    w = np.asarray([3.0, 4.0], np.float32)  # |w| = 5
+    conv.conv.kernel[...] = jnp.asarray(w.reshape(1, 1, 2, 1))
+    # converge power iteration (rank-1: converges immediately)
+    x = jnp.zeros((1, 1, 1, 2))
+    for _ in range(3):
+        conv(x)
+    graphdef, params, rest = nnx.split(conv, nnx.Param, ...)
+    c = np.asarray([1.0, 0.0], np.float32)
+
+    def f(p):
+        m = nnx.merge(graphdef, p, rest, copy=True)
+        m.eval()
+        kernel = m.conv.kernel[...]
+        w2 = kernel.reshape(-1, 1)
+        u = m.u[...]
+        v = jax.lax.stop_gradient(w2) @ u
+        v = v / (jnp.linalg.norm(v) + 1e-12)
+        u2 = jax.lax.stop_gradient(w2).T @ v
+        u2 = u2 / (jnp.linalg.norm(u2) + 1e-12)
+        sigma = v @ w2 @ u2
+        w_sn = (kernel / sigma).reshape(2)
+        return jnp.sum(w_sn * jnp.asarray(c))
+
+    g = jax.grad(f)(params)
+    gk = next(
+        np.asarray(l).reshape(2)
+        for l in jax.tree_util.tree_leaves(g)
+        if np.asarray(l).size == 2
+    )
+    what = w / 5.0
+    expected = (c - what * float(what @ c)) / 5.0  # (I - ŵŵᵀ)c / |w|
+    np.testing.assert_allclose(gk, expected, rtol=1e-4, atol=1e-6)
+
+
+def test_generate_preserves_caller_mode():
+    g, d = small_gan()
+    trainer = GANTrainer(g, d, optax.adam(1e-4), optax.adam(1e-4))
+    g.eval()  # caller sets eval for a checkpoint pass
+    trainer.generate(jnp.zeros((2, LATENT)))
+    # the shared module's mode flags were not flipped back to train
+    assert g.bn0.use_running_average

@@ -1,7 +1,13 @@
 """Model zoo: the architectures named by the reference's capability configs
 (ResNet-18/50, RetinaNet-R50-FPN, DCGAN/SNGAN — BASELINE.json)."""
 
-from tpu_syncbn.models import detection
+from tpu_syncbn.models import detection, gan
+from tpu_syncbn.models.gan import (
+    DCGANGenerator,
+    DCGANDiscriminator,
+    SNGANDiscriminator,
+    SNConv,
+)
 from tpu_syncbn.models.retinanet import RetinaNet, FPN, RetinaHead, retinanet_r50_fpn
 from tpu_syncbn.models.resnet import (
     ResNet,
@@ -16,6 +22,11 @@ from tpu_syncbn.models.resnet import (
 )
 
 __all__ = [
+    "gan",
+    "DCGANGenerator",
+    "DCGANDiscriminator",
+    "SNGANDiscriminator",
+    "SNConv",
     "detection",
     "RetinaNet",
     "FPN",

@@ -1,0 +1,181 @@
+"""DCGAN / SNGAN (nnx, NHWC) — the GAN-stability SyncBN capability config
+(BASELINE.json: "DCGAN / SNGAN CIFAR-10 with SyncBN in G and D"; GANs are
+the second workload the reference's recipe names as needing SyncBN,
+``README.md:3``).
+
+Architectures follow the DCGAN paper / pytorch-examples dcgan layout
+(32×32): generator of stride-2 transposed convs with BN+ReLU and tanh
+output; discriminator of stride-2 convs with BN (SNGAN: spectral-norm
+convs) + LeakyReLU. BatchNorm modules are the framework's own, so
+``convert_sync_batchnorm`` makes both networks sync their statistics
+across replicas — the per-chip GAN batches that motivate SyncBN are tiny.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import nnx
+
+from tpu_syncbn.nn import BatchNorm2d
+
+_g_init = nnx.initializers.normal(0.02)  # DCGAN init
+
+
+class SNConv(nnx.Module):
+    """Conv with spectral normalization (SNGAN): one power-iteration step
+    per training forward, ``u`` carried as framework state with
+    torch.nn.utils.spectral_norm's buffer semantics — updated in train
+    mode, frozen in eval.
+
+    The mode flag is named ``use_running_average`` so nnx's standard
+    ``model.train()``/``model.eval()`` attribute propagation reaches it
+    (the same contract as BatchNorm); ``True`` freezes the power-iteration
+    buffer.
+    """
+
+    def __init__(self, cin, cout, kernel, stride, rngs, *, padding="SAME"):
+        self.conv = nnx.Conv(
+            cin, cout, kernel, strides=stride, padding=padding,
+            kernel_init=_g_init, rngs=rngs,
+        )
+        self.u = nnx.BatchStat(
+            jax.random.normal(rngs.params(), (cout,)) / jnp.sqrt(cout)
+        )
+        self.use_running_average = False
+
+    def __call__(self, x):
+        kernel = self.conv.kernel[...]
+        w2 = kernel.reshape(-1, kernel.shape[-1])  # (kh*kw*cin, cout)
+        # power iteration on a detached view: u and v carry no gradient...
+        w2_sg = jax.lax.stop_gradient(w2)
+        u = self.u[...]
+        v = w2_sg @ u
+        v = v / (jnp.linalg.norm(v) + 1e-12)
+        u_new = w2_sg.T @ v
+        u_new = u_new / (jnp.linalg.norm(u_new) + 1e-12)
+        if not self.use_running_average:
+            self.u[...] = u_new
+        # ...but sigma = v^T W u keeps the gradient path THROUGH W, exactly
+        # torch.nn.utils.spectral_norm (only u/v are detached there)
+        sigma = v @ w2 @ u_new
+        w_sn = kernel / sigma
+        y = jax.lax.conv_general_dilated(
+            x, w_sn,
+            window_strides=self.conv.strides,
+            padding=self.conv.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.conv.use_bias:
+            y = y + self.conv.bias[...]
+        return y
+
+
+class DCGANGenerator(nnx.Module):
+    """latent (B, Z) → image (B, 32, 32, 3) in [-1, 1]."""
+
+    def __init__(self, *, latent_dim=128, width=256, rngs: nnx.Rngs):
+        self.latent_dim = latent_dim
+        self.fc = nnx.Linear(latent_dim, 4 * 4 * width, kernel_init=_g_init, rngs=rngs)
+        self.bn0 = BatchNorm2d(width)
+        self.deconvs = nnx.List([
+            nnx.ConvTranspose(width, width // 2, (4, 4), strides=(2, 2),
+                              padding="SAME", kernel_init=_g_init, rngs=rngs),
+            nnx.ConvTranspose(width // 2, width // 4, (4, 4), strides=(2, 2),
+                              padding="SAME", kernel_init=_g_init, rngs=rngs),
+            nnx.ConvTranspose(width // 4, width // 4, (4, 4), strides=(2, 2),
+                              padding="SAME", kernel_init=_g_init, rngs=rngs),
+        ])
+        self.bns = nnx.List([
+            BatchNorm2d(width // 2),
+            BatchNorm2d(width // 4),
+            BatchNorm2d(width // 4),
+        ])
+        self.out = nnx.Conv(width // 4, 3, (3, 3), padding="SAME",
+                            kernel_init=_g_init, rngs=rngs)
+        self.width = width
+
+    def __call__(self, z):
+        x = self.fc(z).reshape(z.shape[0], 4, 4, self.width)
+        x = nnx.relu(self.bn0(x))
+        for deconv, bn in zip(self.deconvs, self.bns):
+            x = nnx.relu(bn(deconv(x)))
+        return jnp.tanh(self.out(x))
+
+
+class DCGANDiscriminator(nnx.Module):
+    """image (B, 32, 32, 3) → logit (B,). BN on all but the first conv
+    (DCGAN recipe)."""
+
+    def __init__(self, *, width=64, rngs: nnx.Rngs):
+        self.conv1 = nnx.Conv(3, width, (4, 4), strides=(2, 2), padding="SAME",
+                              kernel_init=_g_init, rngs=rngs)
+        self.conv2 = nnx.Conv(width, width * 2, (4, 4), strides=(2, 2),
+                              padding="SAME", kernel_init=_g_init, rngs=rngs)
+        self.bn2 = BatchNorm2d(width * 2)
+        self.conv3 = nnx.Conv(width * 2, width * 4, (4, 4), strides=(2, 2),
+                              padding="SAME", kernel_init=_g_init, rngs=rngs)
+        self.bn3 = BatchNorm2d(width * 4)
+        self.fc = nnx.Linear(width * 4 * 4 * 4, 1, kernel_init=_g_init, rngs=rngs)
+
+    def __call__(self, x):
+        a = 0.2
+        x = nnx.leaky_relu(self.conv1(x), a)
+        x = nnx.leaky_relu(self.bn2(self.conv2(x)), a)
+        x = nnx.leaky_relu(self.bn3(self.conv3(x)), a)
+        return self.fc(x.reshape(x.shape[0], -1))[:, 0]
+
+
+class SNGANDiscriminator(nnx.Module):
+    """Spectral-norm discriminator (SNGAN); BN optional (SNGAN typically
+    drops BN in D — set use_bn=True to exercise SyncBN in D too, matching
+    the capability config's 'SyncBN in G and D')."""
+
+    def __init__(self, *, width=64, use_bn=True, rngs: nnx.Rngs):
+        self.conv1 = SNConv(3, width, (4, 4), (2, 2), rngs)
+        self.conv2 = SNConv(width, width * 2, (4, 4), (2, 2), rngs)
+        self.bn2 = BatchNorm2d(width * 2) if use_bn else None
+        self.conv3 = SNConv(width * 2, width * 4, (4, 4), (2, 2), rngs)
+        self.bn3 = BatchNorm2d(width * 4) if use_bn else None
+        self.fc = nnx.Linear(width * 4 * 4 * 4, 1, kernel_init=_g_init, rngs=rngs)
+
+    def __call__(self, x):
+        a = 0.1
+        x = nnx.leaky_relu(self.conv1(x), a)
+        x = self.conv2(x)
+        if self.bn2 is not None:
+            x = self.bn2(x)
+        x = nnx.leaky_relu(x, a)
+        x = self.conv3(x)
+        if self.bn3 is not None:
+            x = self.bn3(x)
+        x = nnx.leaky_relu(x, a)
+        return self.fc(x.reshape(x.shape[0], -1))[:, 0]
+
+
+# -- losses ---------------------------------------------------------------
+
+
+def bce_gan_losses(real_logits, fake_logits):
+    """DCGAN losses: D maximizes log D(x) + log(1-D(G(z))); G maximizes
+    log D(G(z)) (non-saturating)."""
+    import optax
+
+    ones = jnp.ones_like(real_logits)
+    zeros = jnp.zeros_like(fake_logits)
+    d_loss = (
+        optax.sigmoid_binary_cross_entropy(real_logits, ones).mean()
+        + optax.sigmoid_binary_cross_entropy(fake_logits, zeros).mean()
+    )
+    g_loss = optax.sigmoid_binary_cross_entropy(fake_logits, ones).mean()
+    return d_loss, g_loss
+
+
+def hinge_gan_losses(real_logits, fake_logits):
+    """SNGAN hinge losses."""
+    d_loss = (
+        jnp.maximum(0.0, 1.0 - real_logits).mean()
+        + jnp.maximum(0.0, 1.0 + fake_logits).mean()
+    )
+    g_loss = -fake_logits.mean()
+    return d_loss, g_loss

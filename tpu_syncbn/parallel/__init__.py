@@ -2,6 +2,7 @@
 utilities (the reference's L2+L3: NCCL process group + DDP wrapper)."""
 
 from tpu_syncbn.parallel.trainer import DataParallel, StepOutput, sync_module_states
+from tpu_syncbn.parallel.gan_trainer import GANTrainer, GANStepOutput
 from tpu_syncbn.parallel.collectives import (
     axis_index,
     axis_size,
@@ -18,6 +19,8 @@ from tpu_syncbn.parallel.collectives import (
 )
 
 __all__ = [
+    "GANTrainer",
+    "GANStepOutput",
     "DataParallel",
     "StepOutput",
     "sync_module_states",

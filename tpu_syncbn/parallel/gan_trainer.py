@@ -1,0 +1,185 @@
+"""Data-parallel GAN trainer: alternating D/G optimization with SyncBN in
+both networks — the reference's GAN capability case (``README.md:3``;
+BASELINE.json config 5), where tiny per-chip batches make per-replica BN
+statistics destabilize training.
+
+Faithful to the torch DCGAN training loop's stat semantics (SURVEY §7
+"GAN case" — ordering running-stat updates across the alternating steps):
+
+* D step: ``fake = G(z)`` runs G **in train mode** (G's BN stats update,
+  as in torch where ``netG(noise)`` is a train-mode forward), fake is
+  detached for D's gradients; D sees real and fake as *separate* forwards,
+  so D's BN stats update twice (torch's two ``netD(...)`` calls).
+* G step: ``D(G(z))`` updates both G's and D's stats once more.
+
+Both steps run inside ONE compiled function per iteration; gradients are
+pmean'd per network (DDP parity), BatchStats broadcast from replica 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import nnx
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_syncbn.models.gan import bce_gan_losses, hinge_gan_losses
+from tpu_syncbn.parallel import collectives
+from tpu_syncbn.runtime import distributed as dist
+from tpu_syncbn.runtime.distributed import DATA_AXIS
+
+LOSSES: dict[str, Callable] = {"bce": bce_gan_losses, "hinge": hinge_gan_losses}
+
+
+@dataclasses.dataclass
+class GANStepOutput:
+    d_loss: jax.Array
+    g_loss: jax.Array
+    metrics: dict[str, jax.Array]
+
+
+class GANTrainer:
+    """Two-network, two-optimizer DP trainer.
+
+    ``train_step(real, z_d, z_g)`` takes the real global batch and two
+    latent global batches (one per sub-step, matching the torch loop which
+    draws fresh noise for the G step) and performs one D update then one G
+    update.
+    """
+
+    def __init__(
+        self,
+        generator: nnx.Module,
+        discriminator: nnx.Module,
+        g_optimizer: optax.GradientTransformation,
+        d_optimizer: optax.GradientTransformation,
+        *,
+        loss: str = "bce",
+        mesh: Mesh | None = None,
+        axis_name: str = DATA_AXIS,
+        donate: bool = True,
+    ):
+        if loss not in LOSSES:
+            raise ValueError(f"loss must be one of {sorted(LOSSES)}, got {loss!r}")
+        self._generator = generator
+        self._discriminator = discriminator
+        self.loss_pair = LOSSES[loss]
+        self.mesh = mesh if mesh is not None else dist.data_parallel_mesh()
+        self.axis_name = axis_name
+        self.g_opt = g_optimizer
+        self.d_opt = d_optimizer
+
+        self.g_def, g_params, g_rest = nnx.split(generator, nnx.Param, ...)
+        self.d_def, d_params, d_rest = nnx.split(discriminator, nnx.Param, ...)
+        self.g_opt_state = g_optimizer.init(g_params)
+        self.d_opt_state = d_optimizer.init(d_params)
+
+        replicated = NamedSharding(self.mesh, P())
+        self.batch_sharding = NamedSharding(self.mesh, P(axis_name))
+        put = lambda t: jax.device_put(t, replicated)
+        self.g_params, self.g_rest = put(g_params), put(g_rest)
+        self.d_params, self.d_rest = put(d_params), put(d_rest)
+        self.g_opt_state = put(self.g_opt_state)
+        self.d_opt_state = put(self.d_opt_state)
+
+        self._step = self._build_step(donate)
+
+    def _build_step(self, donate: bool):
+        axis = self.axis_name
+        g_def, d_def = self.g_def, self.d_def
+        loss_pair = self.loss_pair
+
+        def step(gp, gr, dp_, dr, og, od, real, z_d, z_g):
+            # ---- D step ------------------------------------------------
+            def d_loss_fn(dp_in, gr_in, dr_in):
+                G = nnx.merge(g_def, gp, gr_in, copy=True)
+                G.train()
+                fake = G(z_d)  # train-mode forward: G stats update
+                _, _, gr_out = nnx.split(G, nnx.Param, ...)
+                D = nnx.merge(d_def, dp_in, dr_in, copy=True)
+                D.train()
+                real_logits = D(real)
+                fake_logits = D(jax.lax.stop_gradient(fake))
+                _, _, dr_out = nnx.split(D, nnx.Param, ...)
+                d_loss, _ = loss_pair(real_logits, fake_logits)
+                aux = (gr_out, dr_out, real_logits, fake_logits)
+                return d_loss, aux
+
+            (d_loss, (gr, dr, real_logits, fake_logits)), d_grads = (
+                jax.value_and_grad(d_loss_fn, has_aux=True)(dp_, gr, dr)
+            )
+            d_grads = collectives.pmean(d_grads, axis)
+            d_updates, od = self.d_opt.update(d_grads, od, dp_)
+            dp_ = optax.apply_updates(dp_, d_updates)
+
+            # ---- G step ------------------------------------------------
+            def g_loss_fn(gp_in, gr_in, dr_in):
+                G = nnx.merge(g_def, gp_in, gr_in, copy=True)
+                G.train()
+                fake = G(z_g)
+                _, _, gr_out = nnx.split(G, nnx.Param, ...)
+                D = nnx.merge(d_def, dp_, dr_in, copy=True)
+                D.train()
+                fake_logits = D(fake)
+                _, _, dr_out = nnx.split(D, nnx.Param, ...)
+                _, g_loss = loss_pair(jnp.zeros_like(fake_logits), fake_logits)
+                return g_loss, (gr_out, dr_out)
+
+            (g_loss, (gr, dr)), g_grads = jax.value_and_grad(
+                g_loss_fn, has_aux=True
+            )(gp, gr, dr)
+            g_grads = collectives.pmean(g_grads, axis)
+            g_updates, og = self.g_opt.update(g_grads, og, gp)
+            gp = optax.apply_updates(gp, g_updates)
+
+            d_loss = collectives.pmean(d_loss, axis)
+            g_loss = collectives.pmean(g_loss, axis)
+            metrics = collectives.pmean(
+                {
+                    "d_real": jax.nn.sigmoid(real_logits).mean(),
+                    "d_fake": jax.nn.sigmoid(fake_logits).mean(),
+                },
+                axis,
+            )
+            # replica-0 buffer broadcast (DDP forward_sync_buffers parity)
+            gr = collectives.broadcast(gr, src=0, axis_name=axis)
+            dr = collectives.broadcast(dr, src=0, axis_name=axis)
+            return gp, gr, dp_, dr, og, od, d_loss, g_loss, metrics
+
+        sharded = shard_map(
+            step,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(), P(), P(), P(),
+                      P(self.axis_name), P(self.axis_name), P(self.axis_name)),
+            out_specs=(P(),) * 6 + (P(), P(), P()),
+            check_vma=False,
+        )
+        donate_argnums = tuple(range(6)) if donate else ()
+        return jax.jit(sharded, donate_argnums=donate_argnums)
+
+    def train_step(self, real, z_d, z_g) -> GANStepOutput:
+        (
+            self.g_params, self.g_rest, self.d_params, self.d_rest,
+            self.g_opt_state, self.d_opt_state, d_loss, g_loss, metrics,
+        ) = self._step(
+            self.g_params, self.g_rest, self.d_params, self.d_rest,
+            self.g_opt_state, self.d_opt_state, real, z_d, z_g,
+        )
+        return GANStepOutput(d_loss=d_loss, g_loss=g_loss, metrics=metrics)
+
+    def sync_to_models(self) -> tuple[nnx.Module, nnx.Module]:
+        nnx.update(self._generator, self.g_params, self.g_rest)
+        nnx.update(self._discriminator, self.d_params, self.d_rest)
+        return self._generator, self._discriminator
+
+    def generate(self, z) -> jax.Array:
+        """Sample images with the current generator state (eval mode, on a
+        fresh merged copy — the caller's module mode flags are untouched)."""
+        G = nnx.merge(self.g_def, self.g_params, self.g_rest, copy=True)
+        G.eval()
+        return G(z)
