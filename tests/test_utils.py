@@ -1,0 +1,139 @@
+"""Checkpoint/resume and metrics utilities tests."""
+
+import jax
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import nnx
+
+from tpu_syncbn import nn as tnn, parallel, utils
+
+
+class TinyNet(nnx.Module):
+    def __init__(self, rngs):
+        self.fc = nnx.Linear(4, 4, rngs=rngs)
+        self.bn = tnn.BatchNorm1d(4)
+
+    def __call__(self, x):
+        return self.bn(self.fc(x))
+
+
+def loss_fn(m, batch):
+    x, y = batch
+    return ((m(x) - y) ** 2).mean()
+
+
+def make_batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.randn(16, 4), jnp.float32),
+        jnp.asarray(rng.randn(16, 4), jnp.float32),
+    )
+
+
+def test_checkpoint_roundtrip_resume(tmp_path):
+    d = str(tmp_path)
+    model = tnn.convert_sync_batchnorm(TinyNet(nnx.Rngs(0)))
+    dp = parallel.DataParallel(model, optax.adam(1e-2), loss_fn)
+    batch = make_batch()
+    for _ in range(3):
+        dp.train_step(batch)
+    path = utils.save_checkpoint(d, step=3, tree=dp.state_dict())
+    assert path and os.path.exists(path)
+
+    # continue one step, remember the result
+    out_after = dp.train_step(batch)
+
+    # fresh trainer, restore, repeat the same step — identical trajectory
+    model2 = tnn.convert_sync_batchnorm(TinyNet(nnx.Rngs(1)))  # different init
+    dp2 = parallel.DataParallel(model2, optax.adam(1e-2), loss_fn)
+    restored, step = utils.load_checkpoint(d, dp2.state_dict())
+    assert step == 3
+    dp2.load_state_dict(restored)
+    out2 = dp2.train_step(batch)
+    np.testing.assert_allclose(float(out2.loss), float(out_after.loss), rtol=1e-6)
+
+
+def test_checkpoint_pruning(tmp_path):
+    d = str(tmp_path)
+    for s in range(5):
+        utils.save_checkpoint(d, step=s, tree={"x": jnp.ones(2)}, keep=2)
+    assert utils.available_steps(d) == [3, 4]
+
+
+def test_checkpoint_specific_step_and_missing(tmp_path):
+    d = str(tmp_path)
+    utils.save_checkpoint(d, step=1, tree={"x": jnp.ones(2)})
+    utils.save_checkpoint(d, step=7, tree={"x": jnp.full((2,), 7.0)})
+    tree, step = utils.load_checkpoint(d, {"x": jnp.zeros(2)}, step=1)
+    assert step == 1
+    np.testing.assert_allclose(np.asarray(tree["x"]), 1.0)
+    with pytest.raises(FileNotFoundError):
+        utils.load_checkpoint(d, {"x": jnp.zeros(2)}, step=5)
+    with pytest.raises(FileNotFoundError):
+        utils.load_checkpoint(str(tmp_path / "empty"), {"x": jnp.zeros(2)})
+
+
+def test_gan_trainer_state_roundtrip(tmp_path):
+    """Restore into a FRESH differently-initialized trainer must reproduce
+    the original trainer's exact next-step trajectory."""
+    from tpu_syncbn.models import gan
+
+    def build(seed):
+        g = gan.DCGANGenerator(latent_dim=8, width=16, rngs=nnx.Rngs(seed))
+        d_ = gan.DCGANDiscriminator(width=8, rngs=nnx.Rngs(seed + 1))
+        return parallel.GANTrainer(g, d_, optax.adam(1e-4), optax.adam(1e-4))
+
+    tr = build(0)
+    rng = np.random.RandomState(0)
+    real = jnp.asarray(rng.randn(8, 32, 32, 3), jnp.float32)
+    z1 = jnp.asarray(rng.randn(8, 8), jnp.float32)
+    z2 = jnp.asarray(rng.randn(8, 8), jnp.float32)
+    tr.train_step(real, z1, z2)
+    utils.save_checkpoint(str(tmp_path), 1, tr.state_dict())
+    out_next = tr.train_step(real, z1, z2)
+
+    tr2 = build(42)  # different init
+    restored, _ = utils.load_checkpoint(str(tmp_path), tr2.state_dict())
+    tr2.load_state_dict(restored)
+    out2 = tr2.train_step(real, z1, z2)
+    np.testing.assert_allclose(float(out2.d_loss), float(out_next.d_loss), rtol=1e-6)
+    np.testing.assert_allclose(float(out2.g_loss), float(out_next.g_loss), rtol=1e-6)
+
+
+def test_state_dict_survives_donation():
+    """Regression: state_dict must copy — snapshotting then stepping (with
+    default donate=True) must leave the snapshot readable."""
+    model = tnn.convert_sync_batchnorm(TinyNet(nnx.Rngs(0)))
+    dp = parallel.DataParallel(model, optax.adam(1e-2), loss_fn)
+    batch = make_batch()
+    dp.train_step(batch)
+    sd = dp.state_dict()
+    dp.train_step(batch)  # donates the live buffers
+    # snapshot still materializable
+    leaves = jax.tree_util.tree_leaves(sd)
+    assert all(np.all(np.isfinite(np.asarray(l))) for l in leaves)
+
+
+def test_meters():
+    m = utils.AverageMeter("loss")
+    m.update(2.0, n=2)
+    m.update(4.0)
+    np.testing.assert_allclose(m.avg, 8.0 / 3)
+    t = utils.ThroughputMeter(window=5)
+    assert t.samples_per_sec == 0.0
+    import time
+
+    t.tick(10)
+    time.sleep(0.01)
+    t.tick(10)
+    assert t.samples_per_sec > 0
+
+
+def test_step_timer():
+    with utils.step_timer() as t:
+        pass
+    assert t["seconds"] >= 0

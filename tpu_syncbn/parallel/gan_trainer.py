@@ -177,6 +177,24 @@ class GANTrainer:
         nnx.update(self._discriminator, self.d_params, self.d_rest)
         return self._generator, self._discriminator
 
+    def state_dict(self) -> dict:
+        # copies: donated buffers are invalidated by the next train_step
+        return jax.tree_util.tree_map(
+            jnp.copy,
+            {
+                "g_params": self.g_params, "g_rest": self.g_rest,
+                "d_params": self.d_params, "d_rest": self.d_rest,
+                "g_opt_state": self.g_opt_state, "d_opt_state": self.d_opt_state,
+            },
+        )
+
+    def load_state_dict(self, state: dict) -> None:
+        put = lambda t: jax.device_put(t, NamedSharding(self.mesh, P()))
+        self.g_params, self.g_rest = put(state["g_params"]), put(state["g_rest"])
+        self.d_params, self.d_rest = put(state["d_params"]), put(state["d_rest"])
+        self.g_opt_state = put(state["g_opt_state"])
+        self.d_opt_state = put(state["d_opt_state"])
+
     def generate(self, z) -> jax.Array:
         """Sample images with the current generator state (eval mode, on a
         fresh merged copy — the caller's module mode flags are untouched)."""

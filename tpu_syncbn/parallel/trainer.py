@@ -320,3 +320,31 @@ class DataParallel:
     @property
     def model(self) -> nnx.Module:
         return self.sync_to_model()
+
+    # -- checkpointing ----------------------------------------------------
+
+    def state_dict(self) -> dict:
+        """Full training state as a pytree (params, buffers, optimizer) —
+        feed to utils.checkpoint.save_checkpoint on the master host.
+
+        Returns *copies*: with ``donate=True`` (the default) the live
+        buffers are invalidated by the next train_step, so a snapshot that
+        merely referenced them would be unreadable afterwards."""
+        return jax.tree_util.tree_map(
+            jnp.copy,
+            {
+                "params": self.params,
+                "rest": self.rest,
+                "opt_state": self.opt_state,
+            },
+        )
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore a pytree produced by :meth:`state_dict` (or deserialized
+        into its structure), re-placing it on the mesh."""
+        self.params = jax.device_put(state["params"], self._replicated)
+        rest_sharding = (
+            self._replicated if self.broadcast_buffers else self._per_replica
+        )
+        self.rest = jax.device_put(state["rest"], rest_sharding)
+        self.opt_state = jax.device_put(state["opt_state"], self._replicated)

@@ -1,1 +1,23 @@
-"""placeholder"""
+"""Utilities: checkpoint/resume (rank-0 writes), meters, profiler hooks."""
+
+from tpu_syncbn.utils.checkpoint import (
+    save_checkpoint,
+    load_checkpoint,
+    available_steps,
+)
+from tpu_syncbn.utils.metrics import (
+    AverageMeter,
+    ThroughputMeter,
+    profiler_trace,
+    step_timer,
+)
+
+__all__ = [
+    "save_checkpoint",
+    "load_checkpoint",
+    "available_steps",
+    "AverageMeter",
+    "ThroughputMeter",
+    "profiler_trace",
+    "step_timer",
+]

@@ -1,0 +1,148 @@
+"""Checkpoint / resume.
+
+The reference has no checkpointing (SURVEY §5.4) — but evaluating top-1
+parity targets requires persisting params + BN running stats, and the
+torch-world convention the recipe implies is "rank 0 writes" (the same
+master-only convention as logging, reference ``README.md:9``). This module
+provides exactly that: master-host-only atomic writes of any pytree
+(params, BatchStats, optimizer state), with numbered steps and pruning.
+
+Serialization is ``flax.serialization`` msgpack — pure pytree bytes, no
+pickle execution risk, stable across processes.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+from typing import Any
+
+import jax
+from flax import serialization
+
+from tpu_syncbn.runtime import distributed as dist
+
+_CKPT_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
+
+
+def _purify(tree: Any) -> Any:
+    """Recursively convert nnx State nodes (not msgpack-serializable) to
+    pure nested dicts; leaves other structures alone."""
+    from flax import nnx
+
+    if isinstance(tree, nnx.State):
+        return nnx.to_pure_dict(tree)
+    if isinstance(tree, dict):
+        return {k: _purify(v) for k, v in tree.items()}
+    if isinstance(tree, tuple) and hasattr(tree, "_fields"):  # namedtuple
+        return type(tree)(*(_purify(v) for v in tree))
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_purify(v) for v in tree)
+    return tree
+
+
+def _unpurify(template: Any, pure: Any) -> Any:
+    """Inverse of :func:`_purify`: rebuild State nodes from pure dicts
+    using ``template``'s structure."""
+    from flax import nnx
+
+    if isinstance(template, nnx.State):
+        state = jax.tree_util.tree_map(lambda x: x, template)  # copy
+        nnx.replace_by_pure_dict(state, pure)
+        return state
+    if isinstance(template, dict):
+        return {k: _unpurify(template[k], pure[k]) for k in template}
+    if isinstance(template, tuple) and hasattr(template, "_fields"):
+        return type(template)(
+            *(_unpurify(t, p) for t, p in zip(template, pure))
+        )
+    if isinstance(template, (list, tuple)):
+        return type(template)(
+            _unpurify(t, p) for t, p in zip(template, pure)
+        )
+    return pure
+
+
+def _path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"ckpt_{step}.msgpack")
+
+
+def available_steps(directory: str) -> list[int]:
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for name in os.listdir(directory):
+        m = _CKPT_RE.match(name)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def save_checkpoint(
+    directory: str,
+    step: int,
+    tree: Any,
+    *,
+    keep: int = 3,
+) -> str | None:
+    """Write ``tree`` as ``ckpt_{step}.msgpack`` — master host only (other
+    hosts return None immediately); atomic via tmp+rename; prunes to the
+    newest ``keep`` checkpoints."""
+    if not dist.is_master():
+        return None
+    os.makedirs(directory, exist_ok=True)
+    # nnx State → pure dicts, then one batched device→host fetch
+    host_tree = jax.device_get(_purify(tree))
+    data = serialization.to_bytes(host_tree)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, _path(directory, step))
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    if keep > 0:
+        for old in available_steps(directory)[:-keep]:
+            os.unlink(_path(directory, old))
+    return _path(directory, step)
+
+
+def load_checkpoint(directory: str, target: Any, *, step: int | None = None):
+    """Restore the latest (or a specific) checkpoint into the structure of
+    ``target`` (a pytree template, e.g. ``dp.state_dict()``). Returns
+    ``(tree, step)``. Raises FileNotFoundError when nothing exists.
+
+    Multi-host (shared filesystem): hosts first synchronize, then agree on
+    the step by taking the *master host's* latest — listing independently
+    could race the master's in-flight write/prune and restore different
+    steps per host, breaking the replicas-identical invariant.
+    """
+    if dist.process_count() > 1:
+        dist.barrier("ckpt-load")
+        if step is None:
+            from jax.experimental import multihost_utils
+            import numpy as np
+
+            local = available_steps(directory)
+            mine = np.asarray(local[-1] if local else -1, dtype=np.int32)
+            agreed = int(
+                multihost_utils.broadcast_one_to_all(
+                    mine, is_source=dist.is_master()
+                )
+            )
+            step = agreed if agreed >= 0 else None
+    steps = available_steps(directory)
+    if not steps or (step is not None and step not in steps):
+        raise FileNotFoundError(
+            f"step {step} not in {steps}" if steps
+            else f"no checkpoints in {directory!r}"
+        )
+    if step is None:
+        step = steps[-1]
+    with open(_path(directory, step), "rb") as f:
+        data = f.read()
+    pure_target = _purify(target)
+    pure = serialization.from_bytes(pure_target, data)
+    return _unpurify(target, pure), step

@@ -1,0 +1,90 @@
+"""Training observability: meters, step timing, profiler hooks.
+
+The reference's entire observability story is rank-0 console printing
+(``README.md:9``); these utilities keep that contract (all emit via the
+master-gated logger) and add the cheap idiomatic extras SURVEY §5.1 notes:
+``jax.profiler`` traces and per-step throughput timing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+class AverageMeter:
+    """Running average of a scalar (loss, accuracy)."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.reset()
+
+    def reset(self):
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, value: float, n: int = 1):
+        self.sum += float(value) * n
+        self.count += n
+
+    @property
+    def avg(self) -> float:
+        return self.sum / max(self.count, 1)
+
+    def __str__(self):
+        return f"{self.name} {self.avg:.4f}"
+
+
+class ThroughputMeter:
+    """Samples/sec over a sliding window of steps; call ``tick(batch)``
+    once per step *after* blocking on the step result."""
+
+    def __init__(self, window: int = 20):
+        self.window = window
+        self._times: list[float] = []
+        self._counts: list[int] = []
+
+    def tick(self, n_samples: int) -> None:
+        self._times.append(time.perf_counter())
+        self._counts.append(n_samples)
+        if len(self._times) > self.window + 1:
+            self._times.pop(0)
+            self._counts.pop(0)
+
+    @property
+    def samples_per_sec(self) -> float:
+        if len(self._times) < 2:
+            return 0.0
+        dt = self._times[-1] - self._times[0]
+        n = sum(self._counts[1:])  # first tick only anchors the clock
+        return n / dt if dt > 0 else 0.0
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str, *, enabled: bool = True):
+    """``jax.profiler`` trace around a code region (view in TensorBoard /
+    Perfetto). Master host only; no-op when disabled."""
+    from tpu_syncbn.runtime import distributed as dist
+
+    if not enabled or not dist.is_master():
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def step_timer():
+    """Times a block (including device sync if the caller blocks): yields a
+    dict filled with ``seconds`` on exit."""
+    out: dict = {}
+    t0 = time.perf_counter()
+    try:
+        yield out
+    finally:
+        out["seconds"] = time.perf_counter() - t0
