@@ -34,13 +34,14 @@ def main():
     global_batch = per_chip_batch * n_chips
     image = (224, 224, 3)
 
+    # bfloat16 compute (MXU fast path); params f32, BN accumulates f32
     model = nn.convert_sync_batchnorm(
-        models.resnet50(num_classes=1000, rngs=nnx.Rngs(0))
+        models.resnet50(num_classes=1000, dtype=jnp.bfloat16, rngs=nnx.Rngs(0))
     )
 
     def loss_fn(m, batch):
         x, y = batch
-        logits = m(x)
+        logits = m(x).astype(jnp.float32)  # CE in f32
         return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
     mesh = runtime.data_parallel_mesh()

@@ -1,0 +1,122 @@
+"""DCGAN / SNGAN on CIFAR-10 with SyncBN in G and D — the reference's GAN
+capability config (BASELINE.json config 5).
+
+    python -m tpu_syncbn.launch examples/gan_train.py -- --iters 200
+    python -m tpu_syncbn.launch --simulate-chips 8 examples/gan_train.py -- \
+        --iters 20 --arch sngan
+
+Falls back to synthetic CIFAR-shaped data without --data-root.
+"""
+
+import argparse
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import nnx
+
+from tpu_syncbn import data as tdata
+from tpu_syncbn import models, nn, parallel, runtime, utils
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=64, help="global")
+    p.add_argument("--latent-dim", type=int, default=128)
+    p.add_argument("--arch", choices=["dcgan", "sngan"], default="dcgan")
+    p.add_argument("--g-lr", type=float, default=2e-4)
+    p.add_argument("--d-lr", type=float, default=2e-4)
+    p.add_argument("--data-root", default=None)
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    runtime.initialize()
+    log = runtime.get_logger("gan")
+    log.info("world: %d chips", runtime.global_device_count())
+
+    G = models.DCGANGenerator(latent_dim=args.latent_dim, rngs=nnx.Rngs(args.seed))
+    if args.arch == "sngan":
+        D = models.SNGANDiscriminator(rngs=nnx.Rngs(args.seed + 1))
+        loss = "hinge"
+    else:
+        D = models.DCGANDiscriminator(rngs=nnx.Rngs(args.seed + 1))
+        loss = "bce"
+    # SyncBN in both G and D (README.md:3's GAN case)
+    nn.convert_sync_batchnorm(G)
+    nn.convert_sync_batchnorm(D)
+
+    trainer = parallel.GANTrainer(
+        G, D,
+        optax.adam(args.g_lr, b1=0.5, b2=0.999),
+        optax.adam(args.d_lr, b1=0.5, b2=0.999),
+        loss=loss,
+    )
+
+    ds = None
+    if args.data_root:
+        ds = tdata.load_cifar10(args.data_root, train=True)
+    if ds is None:
+        ds = tdata.SyntheticImageDataset(length=2048, shape=(32, 32, 3))
+    sampler = tdata.DistributedSampler(
+        len(ds), num_replicas=runtime.process_count(),
+        rank=runtime.process_index(), shuffle=True, seed=args.seed,
+    )
+    per_host = args.batch_size // runtime.process_count()
+    loader = tdata.DataLoader(ds, batch_size=per_host, sampler=sampler,
+                              num_workers=4, drop_last=True)
+
+    rng = np.random.RandomState(args.seed + runtime.process_index())
+
+    def z():
+        # draw this host's shard of the latent batch and assemble it the
+        # same way real batches are (multi-host: per-process local data →
+        # one global array; single host: plain sharded put)
+        import jax
+
+        local = jnp.asarray(
+            rng.randn(per_host, args.latent_dim), jnp.float32
+        )
+        if runtime.process_count() > 1:
+            return jax.make_array_from_process_local_data(
+                trainer.batch_sharding, local
+            )
+        return jax.device_put(local, trainer.batch_sharding)
+
+    it = 0
+    d_meter, g_meter = utils.AverageMeter("d"), utils.AverageMeter("g")
+    while it < args.iters:
+        sampler.set_epoch(it)  # reshuffle per pass
+        for batch in tdata.device_prefetch(iter(loader),
+                                           sharding=trainer.batch_sharding):
+            real = batch[0] if isinstance(batch, (tuple, list)) else batch
+            out = trainer.train_step(real, z(), z())
+            d_meter.update(float(out.d_loss))
+            g_meter.update(float(out.g_loss))
+            it += 1
+            if it % 20 == 0:
+                runtime.master_print(
+                    f"iter {it}: d {d_meter.avg:.4f} g {g_meter.avg:.4f} "
+                    f"D(real) {float(out.metrics['d_real']):.3f} "
+                    f"D(fake) {float(out.metrics['d_fake']):.3f}"
+                )
+                d_meter.reset(), g_meter.reset()
+            if it >= args.iters:
+                break
+    if args.ckpt_dir:
+        utils.save_checkpoint(args.ckpt_dir, it, trainer.state_dict())
+    samples = trainer.generate(
+        jnp.asarray(rng.randn(16, args.latent_dim), jnp.float32)
+    )
+    runtime.master_print(
+        f"done: {it} iters; sample range "
+        f"[{float(samples.min()):.3f}, {float(samples.max()):.3f}]"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,149 @@
+"""ResNet-50 + SyncBN + DP + DistributedSampler — the reference's 8-chip
+ImageNet capability config (BASELINE.json config 3), with everything the
+full framework offers wired in: bf16 compute, gradient accumulation,
+checkpoint/resume, eval (top-1), throughput metering, profiler.
+
+    python -m tpu_syncbn.launch examples/imagenet_resnet50.py -- \
+        --epochs 1 --batch-size 256 [--dtype bf16] [--ckpt-dir /tmp/r50]
+    python -m tpu_syncbn.launch --simulate-chips 8 \
+        examples/imagenet_resnet50.py -- --image-size 64 --dataset-size 512
+
+Without --data-root (no dataset on disk in a zero-egress environment) a
+deterministic synthetic ImageNet-shaped dataset stands in; the pipeline,
+sharding, and step math are identical.
+"""
+
+import argparse
+
+import jax.numpy as jnp
+import optax
+from flax import nnx
+
+from tpu_syncbn import data as tdata
+from tpu_syncbn import models, nn, parallel, runtime, utils
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=256, help="global")
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--dataset-size", type=int, default=2048)
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--dtype", choices=["f32", "bf16"], default="bf16")
+    p.add_argument("--accum-steps", type=int, default=1)
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--eval-every", type=int, default=0,
+                   help="eval every N epochs (0 = only at the end)")
+    p.add_argument("--profile-dir", default=None)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    runtime.initialize()
+    mesh = runtime.data_parallel_mesh()
+    log = runtime.get_logger("imagenet")
+    log.info("world: %d chips / %d hosts", runtime.global_device_count(),
+             runtime.process_count())
+
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else None
+    model = nn.convert_sync_batchnorm(
+        models.resnet50(num_classes=args.num_classes, dtype=dtype,
+                        rngs=nnx.Rngs(0))
+    )
+    parallel.sync_module_states(model)  # DDP init-broadcast parity
+
+    def loss_fn(m, batch):
+        x, y = batch
+        logits = m(x).astype(jnp.float32)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        return loss, {"top1": (logits.argmax(-1) == y).mean()}
+
+    steps_per_epoch = args.dataset_size // args.batch_size
+    schedule = optax.cosine_decay_schedule(
+        args.lr, max(args.epochs * steps_per_epoch, 1)
+    )
+    opt = optax.chain(
+        optax.add_decayed_weights(1e-4),
+        optax.sgd(schedule, momentum=0.9, nesterov=True),
+    )
+    dp = parallel.DataParallel(
+        model, opt, loss_fn, mesh=mesh, accum_steps=args.accum_steps
+    )
+
+    start_epoch = 0
+    if args.ckpt_dir and args.resume:
+        try:
+            restored, step = utils.load_checkpoint(args.ckpt_dir, dp.state_dict())
+            dp.load_state_dict(restored)
+            start_epoch = step
+            log.info("resumed from epoch %d", step)
+        except FileNotFoundError:
+            log.info("no checkpoint found; starting fresh")
+
+    shape = (args.image_size, args.image_size, 3)
+    train_ds = tdata.SyntheticImageDataset(
+        length=args.dataset_size, shape=shape, num_classes=args.num_classes,
+        seed=0,
+    )
+    val_ds = tdata.SyntheticImageDataset(
+        length=max(args.batch_size, args.dataset_size // 8), shape=shape,
+        num_classes=args.num_classes, seed=1,
+    )
+    sampler = tdata.DistributedSampler(
+        len(train_ds), num_replicas=runtime.process_count(),
+        rank=runtime.process_index(), shuffle=True, seed=0,
+    )
+    per_host = args.batch_size // runtime.process_count()
+    loader = tdata.DataLoader(train_ds, batch_size=per_host, sampler=sampler,
+                              num_workers=8, drop_last=True)
+
+    def run_eval():
+        # shard the val set per host like the train path
+        val_sampler = tdata.DistributedSampler(
+            len(val_ds), num_replicas=runtime.process_count(),
+            rank=runtime.process_index(), shuffle=False,
+        )
+        eval_loader = tdata.DataLoader(val_ds, batch_size=per_host,
+                                       sampler=val_sampler, drop_last=True)
+        meter = utils.AverageMeter("top1")
+        for batch in tdata.device_prefetch(iter(eval_loader),
+                                           sharding=dp.batch_sharding):
+            out = dp.eval_step(batch)
+            meter.update(float(out.metrics["top1"]), n=args.batch_size)
+        return meter.avg
+
+    tput = utils.ThroughputMeter()
+    step = 0
+    with utils.profiler_trace(args.profile_dir or "",
+                              enabled=bool(args.profile_dir)):
+        for epoch in range(start_epoch, args.epochs):
+            sampler.set_epoch(epoch)
+            for batch in tdata.device_prefetch(iter(loader),
+                                               sharding=dp.batch_sharding):
+                out = dp.train_step(batch)
+                step += 1
+                out.loss.block_until_ready()
+                tput.tick(args.batch_size)
+                if step % 10 == 0:
+                    runtime.master_print(
+                        f"e{epoch} s{step}: loss {float(out.loss):.4f} "
+                        f"top1 {float(out.metrics['top1']):.3f} "
+                        f"{tput.samples_per_sec:.0f} img/s"
+                    )
+            if args.ckpt_dir:
+                utils.save_checkpoint(args.ckpt_dir, epoch + 1, dp.state_dict())
+            if args.eval_every and (epoch + 1) % args.eval_every == 0:
+                runtime.master_print(f"epoch {epoch}: val top1 {run_eval():.4f}")
+
+    runtime.master_print(
+        f"done: {step} steps, final val top1 {run_eval():.4f}, "
+        f"throughput {tput.samples_per_sec:.0f} img/s"
+    )
+
+
+if __name__ == "__main__":
+    main()

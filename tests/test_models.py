@@ -65,3 +65,19 @@ def test_resnet_train_eval_consistency():
     y2 = m(x)  # eval: running stats (updated once)
     assert y1.shape == y2.shape == (4, 10)
     assert not np.allclose(np.asarray(y1), np.asarray(y2))
+
+
+def test_resnet_bf16_compute_f32_params():
+    m = models.resnet18(
+        num_classes=10, small_input=True, dtype=jnp.bfloat16, rngs=nnx.Rngs(0)
+    )
+    y = m(jnp.zeros((2, 32, 32, 3)))
+    assert y.dtype == jnp.bfloat16
+    _, params, _ = nnx.split(m, nnx.Param, ...)
+    assert {str(x.dtype) for x in jax.tree_util.tree_leaves(params)} == {"float32"}
+    # numerics close to f32 model with same init
+    mf = models.resnet18(num_classes=10, small_input=True, rngs=nnx.Rngs(0))
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(m(x), np.float32), np.asarray(mf(x)), rtol=0.1, atol=0.15
+    )

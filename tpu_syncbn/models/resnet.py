@@ -28,23 +28,24 @@ from tpu_syncbn.nn import BatchNorm2d
 _conv_init = nnx.initializers.variance_scaling(2.0, "fan_out", "truncated_normal")
 
 
-def _conv(cin, cout, kernel, stride, rngs, *, padding="SAME"):
+def _conv(cin, cout, kernel, stride, rngs, *, padding="SAME", dtype=None):
     return nnx.Conv(
         cin, cout, (kernel, kernel), strides=(stride, stride),
-        padding=padding, use_bias=False, kernel_init=_conv_init, rngs=rngs,
+        padding=padding, use_bias=False, kernel_init=_conv_init,
+        dtype=dtype, param_dtype=jnp.float32, rngs=rngs,
     )
 
 
 class BasicBlock(nnx.Module):
     expansion = 1
 
-    def __init__(self, cin, planes, stride, norm, rngs):
-        self.conv1 = _conv(cin, planes, 3, stride, rngs)
+    def __init__(self, cin, planes, stride, norm, rngs, dtype=None):
+        self.conv1 = _conv(cin, planes, 3, stride, rngs, dtype=dtype)
         self.bn1 = norm(planes)
-        self.conv2 = _conv(planes, planes, 3, 1, rngs)
+        self.conv2 = _conv(planes, planes, 3, 1, rngs, dtype=dtype)
         self.bn2 = norm(planes)
         if stride != 1 or cin != planes * self.expansion:
-            self.down_conv = _conv(cin, planes * self.expansion, 1, stride, rngs)
+            self.down_conv = _conv(cin, planes * self.expansion, 1, stride, rngs, dtype=dtype)
             self.down_bn = norm(planes * self.expansion)
         else:
             self.down_conv = None
@@ -62,16 +63,16 @@ class BasicBlock(nnx.Module):
 class Bottleneck(nnx.Module):
     expansion = 4
 
-    def __init__(self, cin, planes, stride, norm, rngs):
-        self.conv1 = _conv(cin, planes, 1, 1, rngs)
+    def __init__(self, cin, planes, stride, norm, rngs, dtype=None):
+        self.conv1 = _conv(cin, planes, 1, 1, rngs, dtype=dtype)
         self.bn1 = norm(planes)
         # torchvision places the stride on the 3x3 (resnet v1.5)
-        self.conv2 = _conv(planes, planes, 3, stride, rngs)
+        self.conv2 = _conv(planes, planes, 3, stride, rngs, dtype=dtype)
         self.bn2 = norm(planes)
-        self.conv3 = _conv(planes, planes * self.expansion, 1, 1, rngs)
+        self.conv3 = _conv(planes, planes * self.expansion, 1, 1, rngs, dtype=dtype)
         self.bn3 = norm(planes * self.expansion)
         if stride != 1 or cin != planes * self.expansion:
-            self.down_conv = _conv(cin, planes * self.expansion, 1, stride, rngs)
+            self.down_conv = _conv(cin, planes * self.expansion, 1, stride, rngs, dtype=dtype)
             self.down_bn = norm(planes * self.expansion)
         else:
             self.down_conv = None
@@ -104,14 +105,19 @@ class ResNet(nnx.Module):
         small_input: bool = False,
         norm: Callable[[int], nnx.Module] | None = None,
         width: int = 64,
+        dtype: jnp.dtype | None = None,
         rngs: nnx.Rngs,
     ):
+        """``dtype``: compute dtype for convs/matmuls (e.g. jnp.bfloat16
+        for the TPU MXU fast path); params stay float32 and BN accumulates
+        in float32 regardless."""
         norm = norm if norm is not None else BatchNorm2d
         self.small_input = small_input
+        self.dtype = dtype
         if small_input:
-            self.stem_conv = _conv(3, width, 3, 1, rngs)
+            self.stem_conv = _conv(3, width, 3, 1, rngs, dtype=dtype)
         else:
-            self.stem_conv = _conv(3, width, 7, 2, rngs)
+            self.stem_conv = _conv(3, width, 7, 2, rngs, dtype=dtype)
         self.stem_bn = norm(width)
 
         cin = width
@@ -122,19 +128,23 @@ class ResNet(nnx.Module):
             blocks = []
             for b in range(n_blocks):
                 blocks.append(
-                    block(cin, planes, stride if b == 0 else 1, norm, rngs)
+                    block(cin, planes, stride if b == 0 else 1, norm, rngs,
+                          dtype=dtype)
                 )
                 cin = planes * block.expansion
             stages.append(nnx.List(blocks))
         self.stages = nnx.List(stages)
         self.fc = nnx.Linear(
             cin, num_classes,
-            kernel_init=nnx.initializers.normal(0.01), rngs=rngs,
+            kernel_init=nnx.initializers.normal(0.01),
+            dtype=dtype, param_dtype=jnp.float32, rngs=rngs,
         )
         self.feature_dim = cin
 
     def features(self, x: jax.Array) -> list[jax.Array]:
         """Per-stage feature maps (C2..C5) — consumed by FPN (RetinaNet)."""
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
         x = nnx.relu(self.stem_bn(self.stem_conv(x)))
         if not self.small_input:
             x = nnx.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
