@@ -118,6 +118,7 @@ def main():
 
     tput = utils.ThroughputMeter()
     step = 0
+    last_eval = None
     with utils.profiler_trace(args.profile_dir or "",
                               enabled=bool(args.profile_dir)):
         for epoch in range(start_epoch, args.epochs):
@@ -137,10 +138,14 @@ def main():
             if args.ckpt_dir:
                 utils.save_checkpoint(args.ckpt_dir, epoch + 1, dp.state_dict())
             if args.eval_every and (epoch + 1) % args.eval_every == 0:
-                runtime.master_print(f"epoch {epoch}: val top1 {run_eval():.4f}")
+                last_eval = run_eval()
+                runtime.master_print(f"epoch {epoch}: val top1 {last_eval:.4f}")
+            else:
+                last_eval = None  # model changed since the last eval
 
+    final_top1 = last_eval if last_eval is not None else run_eval()
     runtime.master_print(
-        f"done: {step} steps, final val top1 {run_eval():.4f}, "
+        f"done: {step} steps, final val top1 {final_top1:.4f}, "
         f"throughput {tput.samples_per_sec:.0f} img/s"
     )
 

@@ -1,0 +1,81 @@
+"""Worker script for the real multi-process tests (spawned by
+test_multihost.py with the reference-style env contract). Each process
+owns 2 forced host devices; JAX's coordination service + gloo provide the
+cross-process collectives — the CPU stand-in for NCCL/ICI (SURVEY §4's
+gloo-backend test strategy, done multi-process for real)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from tpu_syncbn import runtime
+
+# initialize from the env contract (TPU_SYNCBN_COORDINATOR/NUM_PROCESSES/
+# PROCESS_ID set by the test) — exercises runtime.initialize's multi-host
+# path, not a direct jax.distributed call
+runtime.initialize()
+
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_syncbn import nn as tnn, parallel
+from tpu_syncbn.ops import batch_norm as ops
+
+pid = runtime.process_index()
+world_dev = runtime.global_device_count()
+print(f"[{pid}] procs={runtime.process_count()} devices={world_dev}", flush=True)
+
+mesh = runtime.data_parallel_mesh()
+sharding = NamedSharding(mesh, P("data"))
+
+# --- collective identity across processes --------------------------------
+local = jnp.full((jax.local_device_count(), 2), float(pid + 1))
+garr = jax.make_array_from_process_local_data(sharding, local)
+out = jax.jit(
+    shard_map(lambda a: parallel.psum(a, "data"), mesh=mesh,
+              in_specs=(P("data"),), out_specs=P("data"))
+)(garr)
+got = float(np.asarray(out.addressable_shards[0].data)[0, 0])
+expected = sum(2 * (p + 1) for p in range(runtime.process_count()))
+assert got == expected, f"psum {got} != {expected}"
+print(f"[{pid}] psum ok ({got})", flush=True)
+
+# --- SyncBN across processes == big-batch BN -----------------------------
+C = 4
+rng = np.random.RandomState(0)  # same on every process: full global view
+x_global = rng.randn(world_dev * 2, 3, 3, C).astype(np.float32)
+per_proc = x_global.reshape(runtime.process_count(), -1, 3, 3, C)[pid]
+gx = jax.make_array_from_process_local_data(sharding, jnp.asarray(per_proc))
+
+def bn_step(xs):
+    y, _ = ops.batch_norm_train(xs, None, None, None, None, None,
+                                axis_name="data")
+    return y
+
+y_sync = jax.jit(
+    shard_map(bn_step, mesh=mesh, in_specs=(P("data"),), out_specs=P("data"))
+)(gx)
+y_ref, _ = ops.batch_norm_train(
+    jnp.asarray(x_global), None, None, None, None, None
+)
+ref_local = np.asarray(y_ref).reshape(
+    runtime.process_count(), -1, 3, 3, C
+)[pid]
+got_local = np.concatenate(
+    [np.asarray(s.data) for s in y_sync.addressable_shards]
+)
+np.testing.assert_allclose(got_local, ref_local, rtol=1e-4, atol=1e-5)
+print(f"[{pid}] syncbn-golden ok", flush=True)
+
+# --- master convention ---------------------------------------------------
+runtime.master_print(f"MASTER-ONLY-LINE from {pid}")
+runtime.barrier("end")
+print(f"[{pid}] done", flush=True)

@@ -1,0 +1,56 @@
+"""REAL multi-process distributed tests: two OS processes, each with 2
+forced host devices, joined through runtime.initialize()'s env contract
+into one 4-device world — cross-process collectives (gloo under JAX's
+coordination service), SyncBN across process boundaries, master-only
+logging. The CPU equivalent of the reference's multi-node NCCL path."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_world():
+    port = _free_port()
+    nproc = 2
+    procs = []
+    for pid in range(nproc):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["TPU_SYNCBN_COORDINATOR"] = f"127.0.0.1:{port}"
+        env["TPU_SYNCBN_NUM_PROCESSES"] = str(nproc)
+        env["TPU_SYNCBN_PROCESS_ID"] = str(pid)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, os.path.join(REPO, "tests", "multihost_worker.py")],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
+        assert f"[{pid}] psum ok" in out
+        assert f"[{pid}] syncbn-golden ok" in out
+        assert f"[{pid}] done" in out
+    # master convention: the rank-0 line appears ONLY in process 0's output
+    assert "MASTER-ONLY-LINE from 0" in outs[0]
+    assert "MASTER-ONLY-LINE" not in outs[1]

@@ -227,3 +227,21 @@ def test_accum_validation():
         parallel.DataParallel(
             SmallCNN(nnx.Rngs(0)), optax.sgd(0.1), ce_loss, accum_steps=0
         )
+
+
+def test_remat_matches_standard_step():
+    """jax.checkpoint must not change step numerics, only memory/FLOPs."""
+    batch = make_batch(21)
+    outs = {}
+    for remat in (False, True):
+        m = tnn.convert_sync_batchnorm(SmallCNN(nnx.Rngs(4)))
+        dp = parallel.DataParallel(m, optax.sgd(0.05), ce_loss, remat=remat)
+        out = dp.train_step(batch)
+        outs[remat] = (float(out.loss), dp.params)
+    assert outs[False][0] == pytest.approx(outs[True][0], rel=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        ),
+        outs[False][1], outs[True][1],
+    )

@@ -196,8 +196,40 @@ class GANTrainer:
         self.d_opt_state = put(state["d_opt_state"])
 
     def generate(self, z) -> jax.Array:
-        """Sample images with the current generator state (eval mode, on a
-        fresh merged copy — the caller's module mode flags are untouched)."""
-        G = nnx.merge(self.g_def, self.g_params, self.g_rest, copy=True)
-        G.eval()
-        return G(z)
+        """Sample images with the current generator state (eval mode; the
+        caller's module mode flags are untouched).
+
+        Runs as a compiled sharded forward over the mesh, so it works on
+        multi-host worlds where the replicated params are not fully
+        addressable and eager computation would be rejected. ``z`` may be
+        host-local (its rows are treated as this host's shard of the
+        global latent batch) or an already-global sharded array.
+        """
+        if getattr(self, "_gen_step", None) is None:
+            def gen(gp, gr, zs):
+                G = nnx.merge(self.g_def, gp, gr, copy=True)
+                G.eval()
+                return G(zs)
+
+            self._gen_step = jax.jit(
+                shard_map(
+                    gen, mesh=self.mesh,
+                    in_specs=(P(), P(), P(self.axis_name)),
+                    out_specs=P(self.axis_name),
+                    check_vma=False,
+                )
+            )
+        world = int(self.mesh.shape[self.axis_name])
+        n = None
+        if not (hasattr(z, "sharding") and getattr(z, "is_fully_addressable", True) is False):
+            z = jnp.asarray(z)
+            n = z.shape[0]
+            pad = (-n) % world  # shard axis must divide the world size
+            if pad:
+                z = jnp.concatenate([z, jnp.zeros((pad,) + z.shape[1:], z.dtype)])
+            if dist.process_count() > 1:
+                z = jax.make_array_from_process_local_data(self.batch_sharding, z)
+            else:
+                z = jax.device_put(z, self.batch_sharding)
+        out = self._gen_step(self.g_params, self.g_rest, z)
+        return out[:n] if n is not None else out

@@ -126,9 +126,15 @@ class DataParallel:
         broadcast_buffers: bool = True,
         accum_steps: int = 1,
         donate: bool = True,
+        remat: bool = False,
     ):
+        """``remat=True`` rematerializes the forward during backward
+        (``jax.checkpoint``) — trades ~1/3 more FLOPs for activation
+        memory, the standard HBM-pressure lever on TPU; step numerics are
+        unchanged (tested)."""
         if accum_steps < 1:
             raise ValueError("accum_steps must be >= 1")
+        self.remat = remat
         self._model = model
         self.mesh = mesh if mesh is not None else dist.data_parallel_mesh()
         self.axis_name = axis_name
@@ -187,6 +193,8 @@ class DataParallel:
             _, _, new_r = nnx.split(model, nnx.Param, ...)
             return loss, (metrics, new_r)
 
+        if self.remat:
+            lossed = jax.checkpoint(lossed)
         (loss, (metrics, new_rest)), grads = jax.value_and_grad(
             lossed, has_aux=True
         )(params, rest, batch)
