@@ -30,7 +30,10 @@ def main():
     n_chips = runtime.global_device_count()
     log(f"backend={jax.default_backend()} chips={n_chips}")
 
-    per_chip_batch = 64
+    import os
+
+    per_chip_batch = int(os.environ.get("BENCH_PER_CHIP_BATCH", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
     global_batch = per_chip_batch * n_chips
     image = (224, 224, 3)
 
@@ -54,11 +57,12 @@ def main():
     batch = jax.device_put((x, y), dp.batch_sharding)
 
     log("compiling + warmup...")
+    t_c = time.perf_counter()
     for _ in range(3):
         out = dp.train_step(batch)
     out.loss.block_until_ready()
+    log(f"compile+warmup took {time.perf_counter()-t_c:.1f}s")
 
-    steps = 10
     t0 = time.perf_counter()
     for _ in range(steps):
         out = dp.train_step(batch)

@@ -1,0 +1,107 @@
+"""Host-side transform tests."""
+
+import numpy as np
+
+from tpu_syncbn.data import transforms as T
+
+
+def test_random_crop_shape_and_determinism():
+    x = np.arange(32 * 32 * 3, dtype=np.float32).reshape(32, 32, 3)
+    t1 = T.RandomCrop(32, padding=4, seed=0)
+    t2 = T.RandomCrop(32, padding=4, seed=0)  # same seed -> same crops
+    a, b = t1(x), t2(x)
+    assert a.shape == (32, 32, 3)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_random_flip_probability():
+    x = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
+    t = T.RandomHorizontalFlip(p=1.0)
+    np.testing.assert_array_equal(t(x), x[:, ::-1])
+    t0 = T.RandomHorizontalFlip(p=0.0)
+    np.testing.assert_array_equal(t0(x), x)
+
+
+def test_random_resized_crop_shape():
+    x = np.random.RandomState(0).rand(64, 48, 3).astype(np.float32)
+    out = T.RandomResizedCrop(32, seed=1)(x)
+    assert out.shape == (32, 32, 3)
+
+
+def test_center_crop_and_normalize_and_tofloat():
+    x = (np.random.RandomState(0).rand(40, 40, 3) * 255).astype(np.uint8)
+    pipe = T.Compose([
+        T.ToFloat(),
+        T.CenterCrop(32),
+        T.Normalize(mean=(0.5, 0.5, 0.5), std=(0.25, 0.25, 0.25)),
+    ])
+    out = pipe(x)
+    assert out.shape == (32, 32, 3)
+    assert out.dtype == np.float32
+    assert -2.1 <= out.min() and out.max() <= 2.1
+
+
+def test_transform_dataset_integration():
+    from tpu_syncbn import data as tdata
+
+    base = tdata.SyntheticImageDataset(length=8, shape=(40, 40, 3))
+    ds = tdata.TransformDataset(
+        base, lambda s: (T.CenterCrop(32)(s[0]), s[1])
+    )
+    x, y = ds[0]
+    assert x.shape == (32, 32, 3)
+
+
+def test_syncbn_classmethod_spelling():
+    """torch-parity spelling: nn.SyncBatchNorm.convert_sync_batchnorm(net)."""
+    from flax import nnx
+
+    from tpu_syncbn import nn as tnn
+
+    class M(nnx.Module):
+        def __init__(self):
+            self.bn = tnn.BatchNorm2d(3)
+
+    m = M()
+    tnn.SyncBatchNorm.convert_sync_batchnorm(m)
+    assert isinstance(m.bn, tnn.SyncBatchNorm)
+
+
+def test_random_crop_zero_padding_default():
+    x = np.ones((32, 32, 3), np.float32)
+    t = T.RandomCrop(40, padding=4, seed=0)  # crop larger forces border use
+    out = t(x)
+    assert out.shape == (40, 40, 3)
+    assert out.min() == 0.0  # zero-fill borders (torchvision default)
+
+
+def test_crop_validation_errors():
+    import pytest
+
+    with pytest.raises(ValueError, match="larger than padded"):
+        T.RandomCrop(64, padding=2, seed=0)(np.zeros((32, 32, 3), np.float32))
+    with pytest.raises(ValueError, match="CenterCrop"):
+        T.CenterCrop(32)(np.zeros((30, 30, 3), np.float32))
+
+
+def test_shared_rng_injection():
+    rng = np.random.RandomState(7)
+    t = T.RandomHorizontalFlip(rng=rng)
+    ref = np.random.RandomState(7)
+    x = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
+    out = t(x)
+    flipped = ref.rand() < 0.5
+    np.testing.assert_array_equal(out, x[:, ::-1] if flipped else x)
+
+
+def test_threaded_loader_with_random_transforms_no_crash():
+    from tpu_syncbn import data as tdata
+
+    aug = T.Compose([T.RandomCrop(32, padding=4, seed=0),
+                     T.RandomHorizontalFlip(seed=1)])
+    base = tdata.SyntheticImageDataset(length=64, shape=(32, 32, 3))
+    ds = tdata.TransformDataset(base, lambda s: (aug(s[0]), s[1]))
+    dl = tdata.DataLoader(ds, batch_size=8, num_workers=8, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 8
+    assert all(b[0].shape == (8, 32, 32, 3) for b in batches)

@@ -16,8 +16,10 @@ from tpu_syncbn.data.dataset import (
     load_cifar10,
 )
 from tpu_syncbn.data.loader import DataLoader, default_collate, device_prefetch
+from tpu_syncbn.data import transforms
 
 __all__ = [
+    "transforms",
     "Sampler",
     "SequentialSampler",
     "RandomSampler",

@@ -1,0 +1,166 @@
+"""Host-side image transforms (numpy) — the augmentation work the
+reference's 8 DataLoader workers do per sample (``README.md:87``).
+torchvision-transform-style API so the typical recipe user's pipeline
+ports directly; all operate on HWC numpy arrays.
+
+Randomness contract: each random transform draws from its own generator —
+pass ``rng=`` (a shared ``np.random.RandomState`` you manage) or ``seed=``
+(int) for reproducibility; by default a fresh entropy-seeded generator is
+used, so composed transforms are independent. Draws are lock-protected,
+so transforms are safe under the threaded DataLoader; with
+``num_workers=0`` a seeded pipeline is bit-reproducible run to run, with
+worker threads the *batch order* stays deterministic but the augmentation
+draw order follows thread scheduling (same tradeoff as torch's workers
+without per-worker seeding).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Sequence
+
+import numpy as np
+
+
+class _Draws:
+    """Lock-protected RandomState shared safely across loader threads."""
+
+    def __init__(self, rng: np.random.RandomState | None, seed: int | None):
+        if rng is not None:
+            self._rng = rng
+        else:
+            self._rng = np.random.RandomState(seed)  # None → OS entropy
+        self._lock = threading.Lock()
+
+    def rand(self) -> float:
+        with self._lock:
+            return float(self._rng.rand())
+
+    def randint(self, n: int) -> int:
+        with self._lock:
+            return int(self._rng.randint(n))
+
+    def uniform(self, lo: float, hi: float) -> float:
+        with self._lock:
+            return float(self._rng.uniform(lo, hi))
+
+
+class Compose:
+    def __init__(self, transforms: Sequence[Callable]):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class RandomHorizontalFlip:
+    def __init__(self, p: float = 0.5, *, rng=None, seed: int | None = None):
+        self.p = p
+        self._draws = _Draws(rng, seed)
+
+    def __call__(self, x):
+        if self._draws.rand() < self.p:
+            return np.ascontiguousarray(x[:, ::-1])
+        return x
+
+
+class RandomCrop:
+    """Pad-then-crop (the CIFAR recipe: pad 4, crop 32). Default padding is
+    zero-fill, matching torchvision's ``RandomCrop(32, padding=4)``;
+    ``padding_mode="reflect"`` opts into reflect padding."""
+
+    def __init__(self, size: int, padding: int = 4, *,
+                 padding_mode: str = "constant",
+                 rng=None, seed: int | None = None):
+        self.size = size
+        self.padding = padding
+        self.padding_mode = padding_mode
+        self._draws = _Draws(rng, seed)
+
+    def __call__(self, x):
+        p = self.padding
+        kw = {"mode": self.padding_mode}
+        if self.padding_mode == "constant":
+            kw["constant_values"] = 0
+        padded = np.pad(x, ((p, p), (p, p), (0, 0)), **kw)
+        if padded.shape[0] < self.size or padded.shape[1] < self.size:
+            raise ValueError(
+                f"crop size {self.size} larger than padded input "
+                f"{padded.shape[:2]}"
+            )
+        i = self._draws.randint(padded.shape[0] - self.size + 1)
+        j = self._draws.randint(padded.shape[1] - self.size + 1)
+        return padded[i : i + self.size, j : j + self.size]
+
+
+class RandomResizedCrop:
+    """ImageNet-style scale/aspect jitter crop + nearest resize."""
+
+    def __init__(self, size: int, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 *, rng=None, seed: int | None = None):
+        self.size = size
+        self.scale = scale
+        self.ratio = ratio
+        self._draws = _Draws(rng, seed)
+
+    def __call__(self, x):
+        h, w = x.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = self._draws.uniform(*self.scale) * area
+            ar = np.exp(
+                self._draws.uniform(np.log(self.ratio[0]), np.log(self.ratio[1]))
+            )
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = self._draws.randint(h - ch + 1)
+                j = self._draws.randint(w - cw + 1)
+                crop = x[i : i + ch, j : j + cw]
+                return _resize_nearest(crop, self.size)
+        side = min(h, w)  # fallback: center crop
+        i, j = (h - side) // 2, (w - side) // 2
+        return _resize_nearest(x[i : i + side, j : j + side], self.size)
+
+
+def _resize_nearest(x: np.ndarray, size: int) -> np.ndarray:
+    h, w = x.shape[:2]
+    ri = (np.arange(size) * h // size).clip(0, h - 1)
+    rj = (np.arange(size) * w // size).clip(0, w - 1)
+    return x[ri][:, rj]
+
+
+class CenterCrop:
+    def __init__(self, size: int):
+        self.size = size
+
+    def __call__(self, x):
+        h, w = x.shape[:2]
+        if h < self.size or w < self.size:
+            raise ValueError(
+                f"CenterCrop({self.size}) on smaller input {(h, w)}"
+            )
+        i, j = (h - self.size) // 2, (w - self.size) // 2
+        return x[i : i + self.size, j : j + self.size]
+
+
+class Normalize:
+    """(x - mean) / std per channel (expects float input)."""
+
+    def __init__(self, mean, std):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def __call__(self, x):
+        return (np.asarray(x, np.float32) - self.mean) / self.std
+
+
+class ToFloat:
+    """uint8 [0,255] → float32 [0,1]."""
+
+    def __call__(self, x):
+        if x.dtype == np.uint8:
+            return x.astype(np.float32) / 255.0
+        return np.asarray(x, np.float32)

@@ -199,6 +199,16 @@ class SyncBatchNorm(BatchNorm):
     def __init__(self, num_features: int, *, axis_name: str = DATA_AXIS, **kw):
         super().__init__(num_features, axis_name=axis_name, **kw)
 
+    @classmethod
+    def convert_sync_batchnorm(cls, module, axis_name: str = DATA_AXIS):
+        """Drop-in spelling parity with
+        ``torch.nn.SyncBatchNorm.convert_sync_batchnorm(module)``
+        (``[torch] nn/modules/batchnorm.py:889``); delegates to
+        :func:`tpu_syncbn.nn.convert_sync_batchnorm`."""
+        from tpu_syncbn.nn.convert import convert_sync_batchnorm
+
+        return convert_sync_batchnorm(module, axis_name)
+
     def _sync_axis(self) -> str | None:
         # torch's need_sync requires self.training ([torch] nn/modules/
         # batchnorm.py:837-860): eval mode never syncs, even when
