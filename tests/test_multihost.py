@@ -54,3 +54,41 @@ def test_two_process_world():
     # master convention: the rank-0 line appears ONLY in process 0's output
     assert "MASTER-ONLY-LINE from 0" in outs[0]
     assert "MASTER-ONLY-LINE" not in outs[1]
+
+
+@pytest.mark.slow
+def test_two_process_launcher_example():
+    """Full multi-host run THROUGH THE LAUNCHER: two hosts × 2 simulated
+    chips each train the imagenet example on one 4-chip world."""
+    port = _free_port()
+    nproc = 2
+    procs = []
+    for pid in range(nproc):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "tpu_syncbn.launch",
+                 "--coordinator", f"127.0.0.1:{port}",
+                 "--num-processes", str(nproc),
+                 "--process-id", str(pid),
+                 "examples/imagenet_resnet50.py", "--",
+                 "--image-size", "32", "--dataset-size", "64",
+                 "--batch-size", "16", "--epochs", "1", "--dtype", "f32"],
+                env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out[-4000:]}"
+    assert "world: 4 chips / 2 hosts" in outs[0]
+    assert "done:" in outs[0]
+    # master-only logging: host 1 prints neither the world line nor done
+    assert "done:" not in outs[1]

@@ -15,10 +15,11 @@ from tpu_syncbn.data.dataset import (
     SyntheticImageDataset,
     load_cifar10,
 )
-from tpu_syncbn.data.loader import DataLoader, default_collate, device_prefetch
+from tpu_syncbn.data.loader import DataLoader, default_collate, device_prefetch, staged_iter
 from tpu_syncbn.data import transforms
 
 __all__ = [
+    "staged_iter",
     "transforms",
     "Sampler",
     "SequentialSampler",

@@ -210,6 +210,111 @@ class DataLoader:
                     q.get_nowait()
 
 
+def staged_iter(iterator, *, slots: int = 3, slot_mb: int = 64):
+    """Route host batches through the native C++ staging ring
+    (``native/csrc/staging.cc``) — the pinned-memory staging thread of the
+    reference's ``pin_memory=True`` loader (``README.md:88``): a producer
+    thread serializes each batch into a reusable 64-byte-aligned slot
+    while the consumer devours the previous one, so collation/copy overlap
+    the training step without per-batch allocation.
+
+    Batches must be pytrees of numpy arrays (the loader's output). Falls
+    back to passing batches through unchanged when the native library is
+    unavailable or a batch exceeds ``slot_mb``.
+    """
+    from tpu_syncbn.runtime import native
+
+    if not native.available():
+        yield from iterator
+        return
+
+    ring = native.StagingRing(slots, slot_mb << 20)
+    SENTINEL = object()
+    ERROR = object()
+    meta_q: queue.Queue = queue.Queue(maxsize=slots)
+    stop = threading.Event()
+    # Python-side permit per ring slot: the producer only enters the C++
+    # acquire when a slot is guaranteed free, so it can never block inside
+    # native code where stop/teardown couldn't reach it (the consumer
+    # releases a permit after ring.release).
+    free_slots = threading.Semaphore(slots)
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                meta_q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def pack(batch):
+        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        total = sum(l.nbytes for l in leaves)
+        if total > (slot_mb << 20):
+            return None  # too big for a slot: bypass
+        while not free_slots.acquire(timeout=0.05):
+            if stop.is_set():
+                return False
+        slot, addr = ring.acquire()  # guaranteed non-blocking: permit held
+        view = ring.view(addr, total)
+        offset = 0
+        metas = []
+        for l in leaves:
+            arr = np.ascontiguousarray(l)
+            view[offset : offset + arr.nbytes] = arr.view(np.uint8).ravel()
+            metas.append((arr.dtype.str, arr.shape, offset, arr.nbytes))
+            offset += arr.nbytes
+        ring.commit(slot, total)
+        return treedef, metas
+
+    def producer():
+        try:
+            for batch in iterator:
+                packed = pack(batch)
+                if packed is False:  # stop requested
+                    return
+                item = ("bypass", batch) if packed is None else ("slot", packed)
+                if not _put(item):
+                    return
+        except BaseException as e:  # surface at the consumer, don't truncate
+            _put((ERROR, e))
+            return
+        _put((SENTINEL, None))
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            kind, payload = meta_q.get()
+            if kind is SENTINEL:
+                break
+            if kind is ERROR:
+                raise payload
+            if kind == "bypass":
+                yield payload
+                continue
+            treedef, metas = payload
+            slot, addr, size = ring.consume()
+            leaves = []
+            full = ring.view(addr, size)
+            for dtype, shape, offset, nbytes in metas:
+                raw = full[offset : offset + nbytes]
+                # one copy out of the slot (writable, like every other
+                # loader path) so the slot can be recycled immediately
+                leaves.append(
+                    raw.copy().view(np.dtype(dtype)).reshape(shape)
+                )
+            ring.release(slot)
+            free_slots.release()
+            yield jax.tree_util.tree_unflatten(treedef, leaves)
+    finally:
+        stop.set()
+        t.join(timeout=5)  # producer can always observe stop (never blocks
+        # in native code), so this join terminates before the ring dies
+        ring.close()
+
+
 def device_prefetch(
     iterator,
     *,

@@ -46,6 +46,16 @@ def build_parser() -> argparse.ArgumentParser:
         "TPU hardware; sets --xla_force_host_platform_device_count)",
     )
     p.add_argument(
+        "--nproc-per-node",
+        type=int,
+        default=None,
+        metavar="N",
+        help="compatibility alias for torch.distributed.launch's flag "
+        "(reference README.md:96): there are no per-chip processes on TPU, "
+        "so this asserts N == local chip count on hardware, or behaves "
+        "like --simulate-chips N on CPU",
+    )
+    p.add_argument(
         "--coordinator",
         default=None,
         metavar="HOST:PORT",
@@ -64,6 +74,18 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> None:
     args = build_parser().parse_args(argv)
+
+    if args.nproc_per_node is not None and args.simulate_chips is None:
+        # Probing the backend here would initialize it before the simulate
+        # flags can take effect, so: CPU-only environments (no accelerator
+        # platform requested) treat the flag as --simulate-chips; otherwise
+        # the count is validated after runtime.initialize() below.
+        # Only an EXPLICIT cpu request maps to simulation; unset means
+        # autodetect (likely real TPU) and falls through to the
+        # post-initialize chip-count validation.
+        platforms = os.environ.get("JAX_PLATFORMS", "")
+        if platforms.split(",")[0] == "cpu":
+            args.simulate_chips = args.nproc_per_node
 
     if args.simulate_chips is not None:
         if args.simulate_chips < 1:
@@ -89,9 +111,27 @@ def main(argv: list[str] | None = None) -> None:
     if args.process_id is not None:
         os.environ["TPU_SYNCBN_PROCESS_ID"] = str(args.process_id)
 
+    # Environments that pre-register an accelerator plugin at interpreter
+    # start (sitecustomize) override JAX_PLATFORMS through jax.config; a
+    # user-provided env value must win, so mirror it into the live config.
+    if os.environ.get("JAX_PLATFORMS") and "jax" in sys.modules:
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     from tpu_syncbn import runtime
 
     runtime.initialize()
+
+    if args.nproc_per_node is not None and args.simulate_chips is None:
+        import jax
+
+        if jax.local_device_count() != args.nproc_per_node:
+            raise SystemExit(
+                f"--nproc-per-node={args.nproc_per_node} but this host has "
+                f"{jax.local_device_count()} chips; on TPU the mesh spans "
+                "all local chips automatically — drop the flag or match it"
+            )
 
     script_args = args.script_args
     if script_args and script_args[0] == "--":
