@@ -5,6 +5,7 @@ convert_sync_batchnorm tree rewrite (drop-in contract of
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import torch
 from flax import nnx
 from jax import shard_map
@@ -234,3 +235,90 @@ def test_convert_namedtuple_attr():
     assert isinstance(m.pair, _BNPair)
     assert isinstance(m.pair.a, tnn.SyncBatchNorm)
     assert isinstance(m.pair.b, tnn.SyncBatchNorm)
+
+
+def test_syncbn_group_size_syncs_within_subgroups():
+    """group_size=4 on 8 replicas: stats sync within each half only — each
+    half must match big-batch BN over ITS half (torch process_group
+    scoping, [torch] nn/modules/batchnorm.py:706)."""
+    mesh = runtime.data_parallel_mesh()
+    x = rand_x(31)  # (16, H, W, C): replicas of 2 rows each
+    sbn = tnn.SyncBatchNorm(C, group_size=4, track_running_stats=False)
+    graphdef, state = nnx.split(sbn)
+
+    f = jax.jit(
+        shard_map(
+            lambda st, xs: nnx.merge(graphdef, st, copy=True)(xs),
+            mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"),
+        )
+    )
+    y = np.asarray(f(state, jnp.asarray(x)))
+
+    bn_local = tnn.BatchNorm2d(C, track_running_stats=False)
+    for half in range(2):
+        seg = slice(half * 8, (half + 1) * 8)  # 4 replicas × 2 rows
+        expected = np.asarray(bn_local(jnp.asarray(x[seg])))
+        np.testing.assert_allclose(y[seg], expected, rtol=1e-4, atol=1e-5)
+    # and the two halves genuinely used different stats
+    full = np.asarray(bn_local(jnp.asarray(x)))
+    assert not np.allclose(y, full, rtol=1e-4, atol=1e-5)
+
+
+def test_convert_with_group_size():
+    m = _Tower()
+    tnn.convert_sync_batchnorm(m, group_size=2)
+    assert m.bn.group_size == 2
+
+
+def test_group_size_must_divide_world():
+    mesh = runtime.data_parallel_mesh()
+    sbn = tnn.SyncBatchNorm(C, group_size=3, track_running_stats=False)
+    graphdef, state = nnx.split(sbn)
+    f = shard_map(
+        lambda st, xs: nnx.merge(graphdef, st, copy=True)(xs),
+        mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"),
+    )
+    with pytest.raises(ValueError, match="must divide"):
+        f(state, jnp.asarray(rand_x(0)))
+
+
+def test_plain_bn_rejects_group_size():
+    with pytest.raises(ValueError, match="SyncBatchNorm"):
+        tnn.BatchNorm2d(C, group_size=2)
+
+
+def test_reconvert_updates_existing_syncbn_scope():
+    """torch re-converts SyncBN too: the new process_group wins uniformly."""
+    m = _Tower()
+    tnn.convert_sync_batchnorm(m)            # full-world
+    assert m.bn.group_size is None
+    tnn.convert_sync_batchnorm(m, group_size=2)
+    assert m.bn.group_size == 2
+    assert all(b.group_size == 2 for b in m.blocks)
+
+
+def test_classmethod_forwards_group_size():
+    bn = tnn.BatchNorm2d(C)
+    out = tnn.SyncBatchNorm.convert_sync_batchnorm(bn, group_size=4)
+    assert isinstance(out, tnn.SyncBatchNorm) and out.group_size == 4
+
+
+def test_grouped_sync_single_collective():
+    """Grouped SyncBN emits exactly ONE all-gather (fused triple) and no
+    full-world all-reduce."""
+    import re
+
+    mesh = runtime.data_parallel_mesh()
+    sbn = tnn.SyncBatchNorm(C, group_size=4, track_running_stats=False)
+    graphdef, state = nnx.split(sbn)
+    f = jax.jit(
+        shard_map(
+            lambda st, xs: nnx.merge(graphdef, st, copy=True)(xs),
+            mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"),
+            check_vma=False,
+        )
+    )
+    hlo = f.lower(state, jnp.asarray(rand_x(17))).compile().as_text()
+    # count by op type (instruction names vary: %all-gather vs %all_gather.7)
+    n_ag = len(re.findall(r" all-gather(?:-start)?\(", hlo))
+    assert n_ag == 1, f"expected 1 fused all-gather, got {n_ag}"

@@ -25,7 +25,7 @@ from tpu_syncbn.nn.normalization import BatchNorm, SyncBatchNorm
 from tpu_syncbn.runtime.distributed import DATA_AXIS
 
 
-def _convert_one(bn: BatchNorm, axis_name: str) -> SyncBatchNorm:
+def _convert_one(bn: BatchNorm, axis_name: str, group_size=None) -> SyncBatchNorm:
     out = SyncBatchNorm(
         bn.num_features,
         eps=bn.eps,
@@ -34,6 +34,7 @@ def _convert_one(bn: BatchNorm, axis_name: str) -> SyncBatchNorm:
         track_running_stats=bn.track_running_stats,
         channel_axis=bn.channel_axis,
         axis_name=axis_name,
+        group_size=group_size,
     )
     # Share (not copy) variables — the torch converter moves the same
     # Parameter/buffer objects onto the new module
@@ -47,37 +48,48 @@ def _convert_one(bn: BatchNorm, axis_name: str) -> SyncBatchNorm:
     return out
 
 
-def _swap_in_container(value, axis_name: str):
+def _swap_in_container(value, axis_name: str, group_size=None):
     """Swap BN→SyncBN inside ``value``; returns ``value`` itself (same
     object identity) when nothing needed converting."""
-    if isinstance(value, BatchNorm) and not isinstance(value, SyncBatchNorm):
-        return _convert_one(value, axis_name)
+    if isinstance(value, SyncBatchNorm):
+        # torch re-converts SyncBatchNorm too (it subclasses _BatchNorm),
+        # uniformly applying the given process_group — update the scope
+        # in place rather than leaving a mixed-scope model silently.
+        value.axis_name = axis_name
+        value.group_size = group_size
+        return value
+    if isinstance(value, BatchNorm):
+        return _convert_one(value, axis_name, group_size)
     if isinstance(value, (list, tuple)):
-        new = [_swap_in_container(v, axis_name) for v in value]
+        new = [_swap_in_container(v, axis_name, group_size) for v in value]
         if all(a is b for a, b in zip(new, value)):
             return value
         if isinstance(value, tuple) and hasattr(value, "_fields"):  # namedtuple
             return type(value)(*new)
         return type(value)(new)
     if isinstance(value, dict):
-        new = {k: _swap_in_container(v, axis_name) for k, v in value.items()}
+        new = {k: _swap_in_container(v, axis_name, group_size) for k, v in value.items()}
         if all(new[k] is value[k] for k in value):
             return value
         return new
     return value
 
 
-def convert_sync_batchnorm(module: nnx.Module, axis_name: str = DATA_AXIS):
+def convert_sync_batchnorm(
+    module: nnx.Module, axis_name: str = DATA_AXIS,
+    group_size: int | None = None,
+):
     """Recursively replace BatchNorm modules with SyncBatchNorm.
 
     Drop-in contract of ``[torch] nn/modules/batchnorm.py:889-951``:
     parameters and buffers are shared by reference; config and mode flags
     preserved. Returns the (possibly new) root; inner modules are rewritten
-    in place. ``axis_name`` plays the role of torch's ``process_group``
-    argument — it scopes which mesh axis the statistics sync over.
+    in place. ``axis_name`` + ``group_size`` play the role of torch's
+    ``process_group`` argument: the mesh axis the statistics sync over and
+    (optionally) the size of contiguous replica subgroups to sync within.
     """
-    if isinstance(module, BatchNorm) and not isinstance(module, SyncBatchNorm):
-        return _convert_one(module, axis_name)
+    if isinstance(module, BatchNorm):
+        return _swap_in_container(module, axis_name, group_size)
     seen = set()
     for _path, node in nnx.iter_graph(module):
         if not isinstance(node, nnx.Module) or id(node) in seen:
@@ -85,13 +97,13 @@ def convert_sync_batchnorm(module: nnx.Module, axis_name: str = DATA_AXIS):
         seen.add(id(node))
         if isinstance(node, nnx.List):
             for i in range(len(node)):
-                new = _swap_in_container(node[i], axis_name)
+                new = _swap_in_container(node[i], axis_name, group_size)
                 if new is not node[i]:
                     node[i] = new
             continue
         if isinstance(node, nnx.Dict):
             for k in list(node):
-                new = _swap_in_container(node[k], axis_name)
+                new = _swap_in_container(node[k], axis_name, group_size)
                 if new is not node[k]:
                     node[k] = new
             continue
@@ -101,7 +113,7 @@ def convert_sync_batchnorm(module: nnx.Module, axis_name: str = DATA_AXIS):
             # bookkeeping attribute is off-limits.
             if attr == "_object__state":
                 continue
-            new = _swap_in_container(value, axis_name)
+            new = _swap_in_container(value, axis_name, group_size)
             if new is not value:
                 setattr(node, attr, new)
     return module

@@ -65,17 +65,20 @@ class BatchNorm(nnx.Module):
         track_running_stats: bool = True,
         channel_axis: int = -1,
         axis_name: str | None = None,
+        group_size: int | None = None,
         dtype: jnp.dtype = jnp.float32,
         rngs: nnx.Rngs | None = None,  # unused; accepted for nnx idiom
     ):
-        if axis_name is not None and not isinstance(self, SyncBatchNorm):
+        if (axis_name is not None or group_size is not None) and not isinstance(
+            self, SyncBatchNorm
+        ):
             # Plain BN never syncs (that per-replica behavior is the bug
-            # the reference exists to fix, README.md:3); accepting the
-            # parameter here and ignoring it would silently reintroduce it.
+            # the reference exists to fix, README.md:3); accepting sync
+            # parameters here and ignoring them would silently reintroduce it.
             raise ValueError(
                 "plain BatchNorm does not sync across replicas; use "
-                "SyncBatchNorm (or convert_sync_batchnorm) for axis_name="
-                f"{axis_name!r}"
+                "SyncBatchNorm (or convert_sync_batchnorm) for "
+                f"axis_name={axis_name!r} / group_size={group_size!r}"
             )
         self.num_features = num_features
         self.eps = eps
@@ -84,6 +87,7 @@ class BatchNorm(nnx.Module):
         self.track_running_stats = track_running_stats
         self.channel_axis = channel_axis
         self.axis_name = axis_name
+        self.group_size = group_size
         self.use_running_average = False
         if affine:
             # torch init: weight=1, bias=0 ([torch] nn/modules/batchnorm.py reset_parameters)
@@ -147,6 +151,7 @@ class BatchNorm(nnx.Module):
             eps=self.eps,
             channel_axis=self.channel_axis,
             axis_name=self._sync_axis(),
+            group_size=self.group_size if self._sync_axis() else None,
             mask=mask,
         )
         if self.track_running_stats:
@@ -189,7 +194,9 @@ class SyncBatchNorm(BatchNorm):
 
     When training inside a mesh context that carries ``self.axis_name``
     (the trainer's shard_map over the ``data`` axis), per-channel moments
-    are reduced across all replicas with one fused psum
+    are reduced across all replicas — or within contiguous subgroups of
+    ``group_size`` replicas, the torch ``process_group`` scoping
+    (``[torch] nn/modules/batchnorm.py:706``) — with one fused psum
     (see ops.batch_norm.sync_moments). Outside any mesh context — eval
     mode, single-replica debugging, world size 1 — it degrades to plain BN
     exactly like the reference's fallback
@@ -200,14 +207,16 @@ class SyncBatchNorm(BatchNorm):
         super().__init__(num_features, axis_name=axis_name, **kw)
 
     @classmethod
-    def convert_sync_batchnorm(cls, module, axis_name: str = DATA_AXIS):
+    def convert_sync_batchnorm(
+        cls, module, axis_name: str = DATA_AXIS, group_size: int | None = None
+    ):
         """Drop-in spelling parity with
-        ``torch.nn.SyncBatchNorm.convert_sync_batchnorm(module)``
-        (``[torch] nn/modules/batchnorm.py:889``); delegates to
-        :func:`tpu_syncbn.nn.convert_sync_batchnorm`."""
+        ``torch.nn.SyncBatchNorm.convert_sync_batchnorm(module,
+        process_group)`` (``[torch] nn/modules/batchnorm.py:889``);
+        delegates to :func:`tpu_syncbn.nn.convert_sync_batchnorm`."""
         from tpu_syncbn.nn.convert import convert_sync_batchnorm
 
-        return convert_sync_batchnorm(module, axis_name)
+        return convert_sync_batchnorm(module, axis_name, group_size)
 
     def _sync_axis(self) -> str | None:
         # torch's need_sync requires self.training ([torch] nn/modules/

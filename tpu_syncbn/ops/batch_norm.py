@@ -116,6 +116,7 @@ def sync_moments(
     *,
     channel_axis: int = -1,
     axis_name: str | None = None,
+    group_size: int | None = None,
     mask: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Per-channel (mean, biased var, count) over the batch — cross-replica
@@ -142,7 +143,7 @@ def sync_moments(
         count = jnp.sum(mf, axis=axes)  # per-channel (all equal when the
         # mask has channel-axis size 1); reduce_moments handles either form
     if axis_name is not None:
-        return reduce_moments(s, sq, count, axis_name)
+        return reduce_moments(s, sq, count, axis_name, group_size=group_size)
     mean, var = moments_from_stats(s, sq, count)
     return mean, var, count
 
@@ -230,9 +231,12 @@ def batch_norm_train(
     eps: float = 1e-5,
     channel_axis: int = -1,
     axis_name: str | None = None,
+    group_size: int | None = None,
     mask: jax.Array | None = None,
 ):
     """Full training-mode BN forward (optionally cross-replica synced).
+    ``group_size`` scopes the sync to contiguous replica subgroups (the
+    torch ``process_group`` capability).
 
     Returns ``(y, (new_running_mean, new_running_var, new_num_batches_tracked))``;
     the stats triple is ``(None, None, None)`` when running stats aren't
@@ -247,7 +251,7 @@ def batch_norm_train(
     (``_functions.py:160-165``).
     """
     channel_last = channel_axis in (-1, x.ndim - 1)
-    if _use_pallas() and channel_last and mask is None:
+    if _use_pallas() and channel_last and mask is None and group_size is None:
         # fused Pallas fast path (ops.pallas_bn): one-pass stats kernel,
         # folded normalize, hand-derived backward issuing the reference's
         # exact collectives
@@ -258,7 +262,8 @@ def batch_norm_train(
         )
     else:
         mean, var, count = sync_moments(
-            x, channel_axis=channel_axis, axis_name=axis_name, mask=mask
+            x, channel_axis=channel_axis, axis_name=axis_name,
+            group_size=group_size, mask=mask,
         )
         y = batch_norm_elemt(
             x, mean, var, weight, bias, eps, channel_axis=channel_axis

@@ -16,6 +16,7 @@ from tpu_syncbn.parallel.collectives import (
     all_to_all,
     reduce_scatter,
     reduce_moments,
+    psum_in_groups,
 )
 
 __all__ = [
@@ -36,4 +37,5 @@ __all__ = [
     "all_to_all",
     "reduce_scatter",
     "reduce_moments",
+    "psum_in_groups",
 ]

@@ -144,11 +144,50 @@ def reduce_scatter(
     )
 
 
+def psum_in_groups(
+    tree: Pytree, axis_name: str, group_size: int
+) -> Pytree:
+    """Sum within contiguous subgroups of ``group_size`` replicas along the
+    axis — the TPU form of torch's ``process_group`` scoping (e.g. SyncBN
+    synced within a node rather than the whole world).
+
+    shard_map doesn't support psum's ``axis_index_groups`` (jax 0.9), so
+    this gathers the per-replica values and sums this replica's group
+    slice — fine for the small per-channel stat vectors it exists for.
+    """
+    world = lax.axis_size(axis_name)
+    if group_size < 1 or world % group_size:
+        raise ValueError(
+            f"group_size {group_size} must divide axis size {world}"
+        )
+    if group_size == world:
+        return lax.psum(tree, axis_name)
+    group_start = (lax.axis_index(axis_name) // group_size) * group_size
+
+    # ONE collective for the whole tree: flatten leaves into a single
+    # vector, all_gather once, group-slice, sum, split back (keeps the
+    # "one fused collective per BN layer" property of the full-world path).
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    flat = jnp.concatenate([jnp.ravel(l).astype(jnp.float32) for l in leaves])
+    g = lax.all_gather(flat, axis_name, axis=0)  # (world, total)
+    mine = lax.dynamic_slice_in_dim(g, group_start, group_size, axis=0)
+    summed = mine.sum(axis=0)
+    out = []
+    offset = 0
+    for l in leaves:
+        n = l.size
+        out.append(summed[offset : offset + n].reshape(l.shape).astype(l.dtype))
+        offset += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def reduce_moments(
     local_sum: jax.Array,
     local_sumsq: jax.Array,
     local_count: jax.Array,
     axis_name: str = DATA_AXIS,
+    *,
+    group_size: int | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Count-weighted global moments from per-replica partial sums.
 
@@ -171,9 +210,13 @@ def reduce_moments(
       *biased* (1/N) variance — what BN normalizes with; the unbiased
       running-var correction is the caller's job (see ops.batch_norm).
     """
-    total_sum, total_sumsq, total_count = lax.psum(
-        (local_sum, local_sumsq, local_count), axis_name
-    )
+    triple = (local_sum, local_sumsq, local_count)
+    if group_size is not None:
+        total_sum, total_sumsq, total_count = psum_in_groups(
+            triple, axis_name, group_size
+        )
+    else:
+        total_sum, total_sumsq, total_count = lax.psum(triple, axis_name)
     mean, var = moments_from_stats(total_sum, total_sumsq, total_count)
     return mean, var, total_count
 
