@@ -1,0 +1,22 @@
+"""Shared benchmark bootstrap: --simulate N wiring (forced host devices +
+CPU platform override that beats any sitecustomize-registered plugin) and
+repo-root imports."""
+
+import os
+import sys
+
+
+def setup(simulate: int | None) -> None:
+    if simulate:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={simulate}"
+        ).strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    if simulate:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

@@ -39,19 +39,12 @@ def main():
 
     import os
 
-    if args.simulate:
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.simulate}"
-        ).strip()
-        os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import _common
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    _common.setup(args.simulate)
 
     import jax
-
-    if args.simulate:
-        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P

@@ -1,0 +1,81 @@
+"""SyncBN overhead benchmark: step time of SyncBN vs plain (local) BN on
+the same model — isolates the per-layer collective cost the design
+collapses (SURVEY §3.3: the reference pays ~106 latency-bound small
+collectives per ResNet-50 step; here it's one fused psum per BN layer,
+compiler-overlapped).
+
+    python benchmarks/syncbn_overhead.py [--simulate 8] [--arch resnet50]
+Prints one JSON line with ms/step for each mode and the sync overhead %.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--simulate", type=int, default=None)
+    p.add_argument("--arch", default="resnet18")
+    p.add_argument("--per-chip-batch", type=int, default=8)
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--steps", type=int, default=10)
+    args = p.parse_args()
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import _common
+
+    _common.setup(args.simulate)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax import nnx
+
+    from tpu_syncbn import models, nn, parallel, runtime
+
+    n = runtime.global_device_count()
+    batch = args.per_chip_batch * n
+    x = jnp.zeros((batch, args.image_size, args.image_size, 3), jnp.float32)
+    y = jnp.zeros((batch,), jnp.int32)
+
+    def loss_fn(m, b):
+        xx, yy = b
+        return optax.softmax_cross_entropy_with_integer_labels(m(xx), yy).mean()
+
+    def measure(convert):
+        model = models.RESNETS[args.arch](
+            num_classes=10, small_input=True, rngs=nnx.Rngs(0)
+        )
+        if convert:
+            nn.convert_sync_batchnorm(model)
+        dp = parallel.DataParallel(model, optax.sgd(0.1), loss_fn)
+        b = jax.device_put((x, y), dp.batch_sharding)
+        for _ in range(3):
+            out = dp.train_step(b)
+        out.loss.block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            out = dp.train_step(b)
+        out.loss.block_until_ready()
+        return (time.perf_counter() - t0) / args.steps * 1e3
+
+    sync_ms = measure(convert=True)
+    local_ms = measure(convert=False)
+    print(f"sync {sync_ms:.2f} ms/step, local {local_ms:.2f} ms/step",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "syncbn_overhead",
+        "arch": args.arch,
+        "chips": n,
+        "sync_ms_per_step": round(sync_ms, 3),
+        "local_bn_ms_per_step": round(local_ms, 3),
+        "overhead_pct": round((sync_ms / local_ms - 1) * 100, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,8 +17,16 @@ from tpu_syncbn.data.dataset import (
 )
 from tpu_syncbn.data.loader import DataLoader, default_collate, device_prefetch, staged_iter
 from tpu_syncbn.data import transforms
+from tpu_syncbn.data.detection import (
+    SyntheticDetectionDataset,
+    CocoDetectionDataset,
+    pad_ground_truth,
+)
 
 __all__ = [
+    "SyntheticDetectionDataset",
+    "CocoDetectionDataset",
+    "pad_ground_truth",
     "staged_iter",
     "transforms",
     "Sampler",

@@ -1,0 +1,122 @@
+"""Detection data pipeline: padded-GT datasets and collation for the
+RetinaNet capability config (BASELINE.json config 4).
+
+Detection batches need static shapes on TPU (XLA recompiles on shape
+change), so ground truth is padded to a fixed ``max_boxes`` per image with
+a validity mask — the exact contract ``models.RetinaNet.loss`` consumes.
+COCO-style annotations on disk load through :class:`CocoDetectionDataset`
+when present; a deterministic synthetic generator stands in otherwise
+(zero-egress environment).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from tpu_syncbn.data.dataset import Dataset
+
+
+def pad_ground_truth(
+    boxes: np.ndarray, labels: np.ndarray, max_boxes: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pad (N,4) boxes / (N,) labels to ``max_boxes`` with a validity mask;
+    excess boxes are truncated (torchvision keeps them — TPU static shapes
+    force the cap; choose max_boxes above the dataset's true maximum)."""
+    n = min(len(boxes), max_boxes)
+    out_boxes = np.zeros((max_boxes, 4), np.float32)
+    out_labels = np.zeros((max_boxes,), np.int32)
+    valid = np.zeros((max_boxes,), bool)
+    out_boxes[:n] = boxes[:n]
+    out_labels[:n] = labels[:n]
+    valid[:n] = True
+    return out_boxes, out_labels, valid
+
+
+class SyntheticDetectionDataset(Dataset):
+    """Deterministic synthetic detection samples:
+    ``(image HWC, boxes (M,4), labels (M,), valid (M,))`` with 1..max_boxes
+    random boxes per image — shapes ready for RetinaNet.loss."""
+
+    def __init__(
+        self,
+        length: int = 256,
+        image_size: tuple[int, int] = (64, 64),
+        num_classes: int = 5,
+        max_boxes: int = 8,
+        seed: int = 0,
+    ):
+        self.length = length
+        self.image_size = image_size
+        self.num_classes = num_classes
+        self.max_boxes = max_boxes
+        self.seed = seed
+
+    def __len__(self):
+        return self.length
+
+    def __getitem__(self, idx):
+        if not 0 <= idx < self.length:
+            raise IndexError(idx)
+        rng = np.random.RandomState((self.seed * 999_983 + idx) % (2**31))
+        h, w = self.image_size
+        image = rng.randn(h, w, 3).astype(np.float32)
+        n = rng.randint(1, self.max_boxes + 1)
+        x1 = rng.uniform(0, w * 0.7, n)
+        y1 = rng.uniform(0, h * 0.7, n)
+        bw = rng.uniform(w * 0.1, w * 0.3, n)
+        bh = rng.uniform(h * 0.1, h * 0.3, n)
+        boxes = np.stack(
+            [x1, y1, np.minimum(x1 + bw, w), np.minimum(y1 + bh, h)], axis=1
+        ).astype(np.float32)
+        labels = rng.randint(0, self.num_classes, n).astype(np.int32)
+        return (image,) + pad_ground_truth(boxes, labels, self.max_boxes)
+
+
+class CocoDetectionDataset(Dataset):
+    """COCO-format annotations + an image-array store.
+
+    ``annotation_file`` is standard COCO instances JSON. Images load from
+    ``image_root`` as ``{file_name}.npy`` arrays (HWC float32) — the
+    decode-to-npy step is a one-off preprocessing pass (no JPEG decode
+    dependency in the hot path). Category ids are densified to [0, K).
+    """
+
+    def __init__(self, annotation_file: str, image_root: str, *,
+                 max_boxes: int = 100):
+        with open(annotation_file) as f:
+            coco = json.load(f)
+        self.image_root = image_root
+        self.max_boxes = max_boxes
+        cats = sorted(c["id"] for c in coco.get("categories", []))
+        self.cat_to_dense = {c: i for i, c in enumerate(cats)}
+        self.num_classes = len(cats)
+        anns_by_img: dict[int, list] = {}
+        for a in coco.get("annotations", []):
+            anns_by_img.setdefault(a["image_id"], []).append(a)
+        self.entries = []
+        for img in coco.get("images", []):
+            anns = anns_by_img.get(img["id"], [])
+            boxes = np.asarray(
+                [
+                    [a["bbox"][0], a["bbox"][1],
+                     a["bbox"][0] + a["bbox"][2], a["bbox"][1] + a["bbox"][3]]
+                    for a in anns
+                ],
+                np.float32,
+            ).reshape(-1, 4)
+            labels = np.asarray(
+                [self.cat_to_dense[a["category_id"]] for a in anns], np.int32
+            )
+            self.entries.append((img["file_name"], boxes, labels))
+
+    def __len__(self):
+        return len(self.entries)
+
+    def __getitem__(self, idx):
+        file_name, boxes, labels = self.entries[idx]
+        path = os.path.join(self.image_root, file_name + ".npy")
+        image = np.load(path).astype(np.float32)
+        return (image,) + pad_ground_truth(boxes, labels, self.max_boxes)
