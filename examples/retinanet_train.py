@@ -1,0 +1,126 @@
+"""RetinaNet-R50-FPN + SyncBN at per-chip batch 2 — the reference's
+small-batch detection capability config (BASELINE.json config 4; the
+workload class the recipe exists for, reference ``README.md:3``).
+
+    python -m tpu_syncbn.launch examples/retinanet_train.py -- --iters 50
+    python -m tpu_syncbn.launch --simulate-chips 8 examples/retinanet_train.py -- \
+        --iters 4 --image-size 64 --arch small
+
+Uses COCO-format data via --coco-annotations/--coco-images when present,
+synthetic detection data otherwise.
+"""
+
+import argparse
+
+import numpy as np
+import optax
+from flax import nnx
+
+from tpu_syncbn import data as tdata
+from tpu_syncbn import models, nn, parallel, runtime, utils
+from tpu_syncbn.models import detection as det
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=50)
+    p.add_argument("--per-chip-batch", type=int, default=2)  # the config
+    p.add_argument("--image-size", type=int, default=256)
+    p.add_argument("--num-classes", type=int, default=80)
+    p.add_argument("--max-boxes", type=int, default=32)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--arch", choices=["r50", "small"], default="r50",
+                   help="'small' = tiny backbone for CPU simulation")
+    p.add_argument("--coco-annotations", default=None)
+    p.add_argument("--coco-images", default=None)
+    p.add_argument("--ckpt-dir", default=None)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    runtime.initialize()
+    log = runtime.get_logger("retinanet")
+    n_chips = runtime.global_device_count()
+    global_batch = args.per_chip_batch * n_chips
+    log.info("world: %d chips; per-chip batch %d (global %d)",
+             n_chips, args.per_chip_batch, global_batch)
+
+    size = (args.image_size, args.image_size)
+    if args.arch == "small":
+        from tpu_syncbn.models.resnet import ResNet, BasicBlock
+
+        backbone = ResNet(BasicBlock, (1, 1, 1, 1), num_classes=1, width=16,
+                          rngs=nnx.Rngs(0))
+        model = models.RetinaNet(
+            num_classes=args.num_classes, image_size=size, fpn_channels=32,
+            backbone=backbone, rngs=nnx.Rngs(0),
+        )
+    else:
+        model = models.retinanet_r50_fpn(
+            num_classes=args.num_classes, image_size=size, rngs=nnx.Rngs(0)
+        )
+    # SyncBN in the backbone: THE point of per-chip batch 2 (README.md:3)
+    nn.convert_sync_batchnorm(model)
+
+    dp = parallel.DataParallel(
+        model, optax.adam(args.lr), lambda m, b: m.loss(*b)
+    )
+
+    ds = None
+    if args.coco_annotations and args.coco_images:
+        ds = tdata.CocoDetectionDataset(
+            args.coco_annotations, args.coco_images, max_boxes=args.max_boxes
+        )
+        log.info("COCO: %d images, %d classes", len(ds), ds.num_classes)
+    if ds is None:
+        ds = tdata.SyntheticDetectionDataset(
+            length=max(global_batch * 8, 64), image_size=size,
+            num_classes=args.num_classes, max_boxes=args.max_boxes,
+        )
+    sampler = tdata.DistributedSampler(
+        len(ds), num_replicas=runtime.process_count(),
+        rank=runtime.process_index(), shuffle=True, seed=0,
+    )
+    loader = tdata.DataLoader(
+        ds, batch_size=global_batch // runtime.process_count(),
+        sampler=sampler, num_workers=4, drop_last=True,
+    )
+
+    it = 0
+    meter = utils.AverageMeter("loss")
+    while it < args.iters:
+        sampler.set_epoch(it)
+        for batch in tdata.device_prefetch(iter(loader),
+                                           sharding=dp.batch_sharding):
+            out = dp.train_step(batch)
+            meter.update(float(out.loss))
+            it += 1
+            if it % 10 == 0:
+                runtime.master_print(
+                    f"iter {it}: loss {meter.avg:.4f} "
+                    f"(cls {float(out.metrics['cls_loss']):.4f} "
+                    f"box {float(out.metrics['box_loss']):.4f})"
+                )
+                meter.reset()
+            if it >= args.iters:
+                break
+    if args.ckpt_dir:
+        utils.save_checkpoint(args.ckpt_dir, it, dp.state_dict())
+
+    # decode + per-class NMS on one batch (the eval post-process)
+    m = dp.sync_to_model()
+    m.eval()
+    sample = ds[0][0][None]
+    boxes, scores, classes, keep_mask = m.decode(sample, top_k=50)
+    kept = det.batched_nms(
+        np.asarray(boxes[0]), np.asarray(scores[0]), np.asarray(classes[0])
+    )
+    runtime.master_print(
+        f"done: {it} iters; {len(kept)} boxes after NMS, "
+        f"top score {float(scores[0].max()):.3f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

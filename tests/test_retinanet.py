@@ -234,3 +234,32 @@ def test_coco_dataset_format(tmp_path):
     np.testing.assert_allclose(boxes[0], [10, 20, 40, 60])  # xywh→xyxy
     assert labels[0] == 1 and labels[1] == 0  # densified: id 7→1, id 3→0
     assert valid.tolist() == [True, True, False, False]
+
+
+def test_nms_suppresses_overlaps():
+    boxes = np.asarray([
+        [0, 0, 10, 10],
+        [1, 1, 11, 11],    # heavy overlap with 0, lower score -> suppressed
+        [20, 20, 30, 30],  # disjoint -> kept
+    ], np.float32)
+    scores = np.asarray([0.9, 0.8, 0.7], np.float32)
+    keep = det.nms(boxes, scores, iou_threshold=0.5)
+    assert keep == [0, 2]
+
+
+def test_batched_nms_keeps_cross_class_overlaps():
+    boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+    scores = np.asarray([0.9, 0.8], np.float32)
+    classes = np.asarray([0, 1])
+    keep = det.batched_nms(boxes, scores, classes, iou_threshold=0.5)
+    assert sorted(keep) == [0, 1]  # different classes: both survive
+    keep_same = det.batched_nms(boxes, scores, np.asarray([0, 0]), 0.5)
+    assert keep_same == [0]
+
+
+def test_batched_nms_negative_coordinates():
+    """Regression: negative coords must not leak across class regions."""
+    boxes = np.asarray([[-40, 0, 10, 50], [-39, 1, 11, 51]], np.float32)
+    scores = np.asarray([0.9, 0.8], np.float32)
+    keep = det.batched_nms(boxes, scores, np.asarray([0, 1]), 0.5)
+    assert sorted(keep) == [0, 1]  # different classes: both survive

@@ -16,6 +16,7 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 # -- anchors --------------------------------------------------------------
@@ -186,3 +187,51 @@ def sigmoid_focal_loss(
 def smooth_l1(pred: jnp.ndarray, target: jnp.ndarray, beta: float = 0.1111) -> jnp.ndarray:
     d = jnp.abs(pred - target)
     return jnp.where(d < beta, 0.5 * d * d / beta, d - 0.5 * beta)
+
+
+# -- host-side NMS (eval post-process) ------------------------------------
+
+
+def nms(boxes: np.ndarray, scores: np.ndarray, iou_threshold: float = 0.5):
+    """Greedy non-maximum suppression on the host (numpy) — the eval
+    post-process torchvision runs after RetinaNet decode. Returns indices
+    of kept boxes in descending score order."""
+    boxes = np.asarray(boxes, np.float32)
+    scores = np.asarray(scores, np.float32)
+    order = np.argsort(-scores)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(int(i))
+        if order.size == 1:
+            break
+        rest = order[1:]
+        lt = np.maximum(boxes[i, :2], boxes[rest, :2])
+        rb = np.minimum(boxes[i, 2:], boxes[rest, 2:])
+        wh = np.clip(rb - lt, 0, None)
+        inter = wh[:, 0] * wh[:, 1]
+        area_i = max((boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1]), 0)
+        area_r = np.clip(boxes[rest, 2] - boxes[rest, 0], 0, None) * np.clip(
+            boxes[rest, 3] - boxes[rest, 1], 0, None
+        )
+        union = area_i + area_r - inter
+        iou = np.where(union > 0, inter / union, 0.0)
+        order = rest[iou <= iou_threshold]
+    return keep
+
+
+def batched_nms(boxes, scores, classes, iou_threshold: float = 0.5):
+    """Per-class NMS (boxes of different classes never suppress each
+    other), torchvision.ops.batched_nms semantics."""
+    boxes = np.asarray(boxes, np.float32)
+    classes = np.asarray(classes)
+    if boxes.size == 0:
+        return []
+    # offset trick: shift each class into a disjoint coordinate region.
+    # Normalize to a non-negative origin first — decoded boxes can have
+    # negative coordinates near image edges, which would otherwise leak
+    # across class regions.
+    boxes = boxes - float(boxes.min())
+    span = float(boxes.max()) + 1.0
+    offsets = classes.astype(np.float32)[:, None] * span
+    return nms(boxes + offsets, scores, iou_threshold)
