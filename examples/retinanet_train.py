@@ -47,6 +47,36 @@ def main():
              n_chips, args.per_chip_batch, global_batch)
 
     size = (args.image_size, args.image_size)
+
+    # dataset FIRST: the model's classes/anchors depend on it
+    ds = None
+    if args.coco_annotations and args.coco_images:
+        base = tdata.CocoDetectionDataset(
+            args.coco_annotations, args.coco_images, max_boxes=args.max_boxes
+        )
+        args.num_classes = base.num_classes
+        log.info("COCO: %d images, %d classes", len(base), base.num_classes)
+
+        from tpu_syncbn.data import transforms as T
+
+        resize = T.Resize(args.image_size)
+
+        def fit(sample):
+            image, boxes, labels, valid = sample
+            h, w = image.shape[:2]
+            image = resize(image)
+            scale = np.asarray(
+                [args.image_size / w, args.image_size / h] * 2, np.float32
+            )
+            return image, boxes * scale, labels, valid
+
+        ds = tdata.TransformDataset(base, fit)
+    if ds is None:
+        ds = tdata.SyntheticDetectionDataset(
+            length=64, image_size=size,
+            num_classes=args.num_classes, max_boxes=args.max_boxes,
+        )
+
     if args.arch == "small":
         from tpu_syncbn.models.resnet import ResNet, BasicBlock
 
@@ -67,17 +97,6 @@ def main():
         model, optax.adam(args.lr), lambda m, b: m.loss(*b)
     )
 
-    ds = None
-    if args.coco_annotations and args.coco_images:
-        ds = tdata.CocoDetectionDataset(
-            args.coco_annotations, args.coco_images, max_boxes=args.max_boxes
-        )
-        log.info("COCO: %d images, %d classes", len(ds), ds.num_classes)
-    if ds is None:
-        ds = tdata.SyntheticDetectionDataset(
-            length=max(global_batch * 8, 64), image_size=size,
-            num_classes=args.num_classes, max_boxes=args.max_boxes,
-        )
     sampler = tdata.DistributedSampler(
         len(ds), num_replicas=runtime.process_count(),
         rank=runtime.process_index(), shuffle=True, seed=0,
@@ -113,12 +132,15 @@ def main():
     m.eval()
     sample = ds[0][0][None]
     boxes, scores, classes, keep_mask = m.decode(sample, top_k=50)
+    above = np.asarray(keep_mask[0])  # score_thresh filter from decode
     kept = det.batched_nms(
-        np.asarray(boxes[0]), np.asarray(scores[0]), np.asarray(classes[0])
+        np.asarray(boxes[0])[above],
+        np.asarray(scores[0])[above],
+        np.asarray(classes[0])[above],
     )
     runtime.master_print(
-        f"done: {it} iters; {len(kept)} boxes after NMS, "
-        f"top score {float(scores[0].max()):.3f}"
+        f"done: {it} iters; {int(above.sum())} above threshold, "
+        f"{len(kept)} after NMS, top score {float(scores[0].max()):.3f}"
     )
 
 

@@ -132,6 +132,16 @@ def _resize_nearest(x: np.ndarray, size: int) -> np.ndarray:
     return x[ri][:, rj]
 
 
+class Resize:
+    """Nearest-neighbor resize to (size, size)."""
+
+    def __init__(self, size: int):
+        self.size = size
+
+    def __call__(self, x):
+        return _resize_nearest(x, self.size)
+
+
 class CenterCrop:
     def __init__(self, size: int):
         self.size = size

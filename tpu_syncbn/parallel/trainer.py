@@ -127,14 +127,26 @@ class DataParallel:
         accum_steps: int = 1,
         donate: bool = True,
         remat: bool = False,
+        grad_compression: str | None = None,
     ):
         """``remat=True`` rematerializes the forward during backward
         (``jax.checkpoint``) — trades ~1/3 more FLOPs for activation
         memory, the standard HBM-pressure lever on TPU; step numerics are
-        unchanged (tested)."""
+        unchanged (tested).
+
+        ``grad_compression="bf16"`` casts gradients to bfloat16 for the
+        cross-replica all-reduce and back — DDP's
+        ``bf16_compress_hook`` communication hook
+        (``[torch] distributed/algorithms/ddp_comm_hooks``), halving the
+        gradient traffic over ICI/DCN at a small precision cost."""
         if accum_steps < 1:
             raise ValueError("accum_steps must be >= 1")
+        if grad_compression not in (None, "bf16"):
+            raise ValueError(
+                f"grad_compression must be None or 'bf16', got {grad_compression!r}"
+            )
         self.remat = remat
+        self.grad_compression = grad_compression
         self._model = model
         self.mesh = mesh if mesh is not None else dist.data_parallel_mesh()
         self.axis_name = axis_name
@@ -247,7 +259,18 @@ class DataParallel:
                 metrics = jax.tree_util.tree_map(jnp.mean, metricses)
 
             # DDP gradient averaging: one compiler-scheduled all-reduce
-            grads = collectives.pmean(grads, axis)
+            if self.grad_compression == "bf16":
+                # bf16_compress_hook parity: halve the wire traffic
+                dtypes = jax.tree_util.tree_map(lambda g: g.dtype, grads)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.bfloat16), grads
+                )
+                grads = collectives.pmean(grads, axis)
+                grads = jax.tree_util.tree_map(
+                    lambda g, d: g.astype(d), grads, dtypes
+                )
+            else:
+                grads = collectives.pmean(grads, axis)
             loss = collectives.pmean(loss, axis)
             metrics = collectives.pmean(metrics, axis)
 
