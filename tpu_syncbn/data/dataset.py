@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import os
 import pickle
-from typing import Any, Callable, Sequence
+from typing import Any, Callable
 
 import numpy as np
 

@@ -15,7 +15,6 @@ by the ResNet-18/CIFAR-10 capability config; default is the ImageNet stem
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable
 
 import jax

@@ -13,7 +13,6 @@ plain convs like torchvision's retinanet_resnet50_fpn) so
 from __future__ import annotations
 
 import math
-from functools import partial
 
 import jax
 import jax.numpy as jnp

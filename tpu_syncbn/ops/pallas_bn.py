@@ -32,7 +32,7 @@ backward issue the identical cross-replica psums as the XLA-fusion path in
 from __future__ import annotations
 
 import functools
-import math
+
 
 import jax
 import jax.numpy as jnp
