@@ -79,8 +79,9 @@ def _stats_kernel(x_ref, sum_ref, sumsq_ref, acc_ref):
 
     @pl.when(i == pl.num_programs(0) - 1)
     def _done():
-        sum_ref[...] = acc_ref[0, :]
-        sumsq_ref[...] = acc_ref[1, :]
+        # (1, C) outputs: 2-D lane-aligned layout per the TPU tiling rules
+        sum_ref[0, :] = acc_ref[0, :]
+        sumsq_ref[0, :] = acc_ref[1, :]
 
 
 def bn_stats(x: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
@@ -99,23 +100,24 @@ def bn_stats(x: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
 def _stats_2d(x2: jax.Array, c: int) -> tuple[jax.Array, jax.Array]:
     """Stats kernel over an already-padded (M', C) view."""
     grid = (x2.shape[0] // _BLOCK_M,)
-    return pl.pallas_call(
+    s, sq = pl.pallas_call(
         _stats_kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((_BLOCK_M, c), lambda i: (i, 0), memory_space=pltpu.VMEM)
         ],
         out_specs=[
-            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
-            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((c,), jnp.float32),
-            jax.ShapeDtypeStruct((c,), jnp.float32),
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((2, c), jnp.float32)],
         interpret=_interpret(),
     )(x2)
+    return s[0], sq[0]
 
 
 # -- normalize kernel -----------------------------------------------------
@@ -123,7 +125,7 @@ def _stats_2d(x2: jax.Array, c: int) -> tuple[jax.Array, jax.Array]:
 
 def _normalize_kernel(x_ref, scale_ref, shift_ref, y_ref):
     xf = x_ref[...].astype(jnp.float32)
-    y = xf * scale_ref[...] + shift_ref[...]
+    y = xf * scale_ref[0, :] + shift_ref[0, :]
     y_ref[...] = y.astype(y_ref.dtype)
 
 
@@ -155,15 +157,15 @@ def _normalize_2d(x2p, scale, shift, c, out_dtype):
         grid=grid,
         in_specs=[
             pl.BlockSpec((_BLOCK_M, c), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
-            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
             (_BLOCK_M, c), lambda i: (i, 0), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct(x2p.shape, out_dtype),
         interpret=_interpret(),
-    )(x2p, scale, shift)
+    )(x2p, scale[None], shift[None])
 
 
 # -- backward reduce kernel ----------------------------------------------
@@ -178,14 +180,14 @@ def _bwd_reduce_kernel(dy_ref, x_ref, mean_ref, invstd_ref, sdy_ref, sdyx_ref, a
 
     dyf = dy_ref[...].astype(jnp.float32)
     xf = x_ref[...].astype(jnp.float32)
-    xhat = (xf - mean_ref[...]) * invstd_ref[...]
+    xhat = (xf - mean_ref[0, :]) * invstd_ref[0, :]
     acc_ref[0, :] += jnp.sum(dyf, axis=0)
     acc_ref[1, :] += jnp.sum(dyf * xhat, axis=0)
 
     @pl.when(i == pl.num_programs(0) - 1)
     def _done():
-        sdy_ref[...] = acc_ref[0, :]
-        sdyx_ref[...] = acc_ref[1, :]
+        sdy_ref[0, :] = acc_ref[0, :]
+        sdyx_ref[0, :] = acc_ref[1, :]
 
 
 def bn_backward_reduce(
@@ -206,21 +208,21 @@ def bn_backward_reduce(
         in_specs=[
             pl.BlockSpec((_BLOCK_M, c), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((_BLOCK_M, c), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
-            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
-            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((c,), jnp.float32),
-            jax.ShapeDtypeStruct((c,), jnp.float32),
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((2, c), jnp.float32)],
         interpret=_interpret(),
-    )(dy2, x2, mean, invstd)
-    return sdy, sdyx
+    )(dy2, x2, mean[None], invstd[None])
+    return sdy[0], sdyx[0]
 
 
 # -- fused custom-vjp batch norm -----------------------------------------
