@@ -3,10 +3,11 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "img/s/chip", "vs_baseline": N}
 
-The reference publishes no numbers (BASELINE.md), so vs_baseline is the
-ratio against a fixed placeholder target of 1.0 until a measured reference
-exists; the metric itself (images/sec/chip, BASELINE.json) is the
-tracked quantity.
+The reference publishes no numbers (BASELINE.md), so this measurement
+defines the baseline and vs_baseline is reported as the constant 1.0;
+the metric itself (images/sec/chip, BASELINE.json) is the tracked
+quantity, and "backend" records which platform produced it (a CPU
+fallback number is tagged, not silently mixed with TPU rounds).
 """
 
 import json
@@ -87,6 +88,9 @@ def main():
         # the reference publishes no throughput number (BASELINE.md), so
         # this round's measurement IS the baseline: ratio 1.0
         "vs_baseline": 1.0,
+        "backend": jax.default_backend(),
+        "chips": n_chips,
+        "per_chip_batch": per_chip_batch,
     }))
 
 
