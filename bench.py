@@ -1,16 +1,26 @@
 """Headline benchmark: ResNet-50 + SyncBN data-parallel training throughput.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "img/s/chip", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "img/s/chip", "vs_baseline": N, ...}
 
 The reference publishes no numbers (BASELINE.md), so this measurement
 defines the baseline and vs_baseline is reported as the constant 1.0;
 the metric itself (images/sec/chip, BASELINE.json) is the tracked
-quantity, and "backend" records which platform produced it (a CPU
-fallback number is tagged, not silently mixed with TPU rounds).
+quantity. Extra fields: "backend" records which platform produced the
+number (a CPU fallback is tagged, not silently mixed with TPU rounds),
+and "mfu" reports model-FLOPs utilization (train-step FLOPs from HLO
+cost analysis / device peak) so the TPU number is judgeable on its own.
+
+The accelerator is probed in a subprocess with a hard timeout before jax
+touches the backend in-process: the environment's known failure mode is a
+*hang* in ``jax.devices()`` (dead tunnel behind a registered PJRT
+plugin), which an in-process except clause can never catch. On CPU
+fallback the workload shrinks (batch 8, 2 steps, 64x64 images) so the
+JSON line always lands inside the driver budget.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -19,15 +29,37 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+# bf16 peak FLOP/s per chip by TPU generation (public spec sheets);
+# device_kind substring -> peak. Used only for the MFU annotation.
+_PEAK_FLOPS = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v4", 275e12),
+]
+
+
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for token, peak in _PEAK_FLOPS:
+        if token in kind:
+            return peak
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for token, peak in _PEAK_FLOPS:
+        if token in gen:
+            return peak
+    return None
+
+
 def main():
+    from tpu_syncbn.runtime import probe
+
+    info = probe.ensure_backend(1)
+    on_accel = info.platform not in ("cpu",)
+    log(f"probe: platform={info.platform} devices={info.device_count}")
+
     import jax
-
-    try:
-        jax.devices()
-    except RuntimeError as e:  # accelerator backend down: record CPU number
-        log(f"accelerator backend unavailable ({e}); falling back to CPU")
-        jax.config.update("jax_platforms", "cpu")
-
     import jax.numpy as jnp
     import optax
     from flax import nnx
@@ -38,12 +70,18 @@ def main():
     n_chips = runtime.global_device_count()
     log(f"backend={jax.default_backend()} chips={n_chips}")
 
-    import os
-
-    per_chip_batch = int(os.environ.get("BENCH_PER_CHIP_BATCH", "64"))
-    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    # CPU fallback must emit its JSON line fast; the accelerator path runs
+    # the real headline shape.
+    if on_accel:
+        per_chip_batch = int(os.environ.get("BENCH_PER_CHIP_BATCH", "64"))
+        steps = int(os.environ.get("BENCH_STEPS", "10"))
+        side = int(os.environ.get("BENCH_IMAGE_SIDE", "224"))
+    else:
+        per_chip_batch = int(os.environ.get("BENCH_PER_CHIP_BATCH", "8"))
+        steps = int(os.environ.get("BENCH_STEPS", "2"))
+        side = int(os.environ.get("BENCH_IMAGE_SIDE", "64"))
     global_batch = per_chip_batch * n_chips
-    image = (224, 224, 3)
+    image = (side, side, 3)
 
     # bfloat16 compute (MXU fast path); params f32, BN accumulates f32
     model = nn.convert_sync_batchnorm(
@@ -64,9 +102,21 @@ def main():
     y = jnp.zeros((global_batch,), jnp.int32)
     batch = jax.device_put((x, y), dp.batch_sharding)
 
+    # FLOPs per step from HLO cost analysis on the *lowered* (pre-compile)
+    # module — a trace, not a second backend compile. Done before any
+    # donated execution so the lowered args are still live.
+    flops_per_step = None
+    try:
+        cost = dp.lowered_train_step(batch).cost_analysis()
+        if cost and cost.get("flops"):
+            flops_per_step = float(cost["flops"])
+    except Exception as e:  # cost analysis is an annotation, never fatal
+        log(f"cost analysis unavailable: {type(e).__name__}: {e}")
+
     log("compiling + warmup...")
     t_c = time.perf_counter()
-    for _ in range(3):
+    warmup = 3 if on_accel else 1
+    for _ in range(warmup):
         out = dp.train_step(batch)
     out.loss.block_until_ready()
     log(f"compile+warmup took {time.perf_counter()-t_c:.1f}s")
@@ -81,6 +131,14 @@ def main():
     img_per_sec_per_chip = img_per_sec / n_chips
     log(f"{img_per_sec:.1f} img/s total, {img_per_sec_per_chip:.1f} img/s/chip")
 
+    mfu = None
+    peak = _peak_flops(jax.devices()[0]) if on_accel else None
+    if flops_per_step and peak:
+        # cost_analysis reports whole-program flops; per-chip share is
+        # flops/n_chips for a data-parallel step
+        mfu = round(flops_per_step / n_chips / (dt / steps) / peak, 4)
+        log(f"MFU={mfu} (flops/step={flops_per_step:.3e}, peak={peak:.0e})")
+
     print(json.dumps({
         "metric": "resnet50_syncbn_dp_train_throughput",
         "value": round(img_per_sec_per_chip, 2),
@@ -91,6 +149,9 @@ def main():
         "backend": jax.default_backend(),
         "chips": n_chips,
         "per_chip_batch": per_chip_batch,
+        "image_side": side,
+        "mfu": mfu,
+        "flops_per_step": flops_per_step,
     }))
 
 

@@ -1,0 +1,85 @@
+"""Backend probe: the defense against the registered-but-dead accelerator
+plugin whose failure mode is a *hang* in ``jax.devices()`` (not a raise).
+The probe must run out-of-process with a hard timeout so driver entry
+points (bench.py, __graft_entry__) always complete."""
+
+import os
+
+import pytest
+
+from tpu_syncbn.runtime import probe
+
+
+def test_probe_backend_reports_cpu(monkeypatch):
+    # conftest pins JAX_PLATFORMS=cpu in os.environ; the subprocess
+    # inherits it and must report the cpu platform promptly
+    monkeypatch.setattr(probe, "_probe_cache", {})
+    info = probe.probe_backend(timeout=120)
+    assert info is not None
+    assert info.platform == "cpu"
+    assert info.device_count >= 1
+
+
+def test_probe_hang_returns_none(monkeypatch, tmp_path):
+    # simulate the axon tunnel hang: a sitecustomize that blocks forever
+    monkeypatch.setattr(probe, "_probe_cache", {})
+    (tmp_path / "sitecustomize.py").write_text("import time; time.sleep(600)")
+    monkeypatch.setenv("PYTHONPATH", str(tmp_path))
+    info = probe.probe_backend(timeout=3)
+    assert info is None
+
+
+def test_probe_raise_returns_none(monkeypatch, tmp_path):
+    # simulate a plugin that raises at backend init: shadow jax itself
+    monkeypatch.setattr(probe, "_probe_cache", {})
+    (tmp_path / "jax.py").write_text("raise RuntimeError('backend down')")
+    monkeypatch.setenv("PYTHONPATH", str(tmp_path))
+    info = probe.probe_backend(timeout=60)
+    assert info is None
+
+
+def test_probe_result_is_cached_per_process(monkeypatch, tmp_path):
+    # a dead-tunnel probe costs its full timeout; a second caller in the
+    # same process (entry() then dryrun_multichip()) must not pay it again
+    monkeypatch.setattr(probe, "_probe_cache", {})
+    (tmp_path / "sitecustomize.py").write_text("import time; time.sleep(600)")
+    monkeypatch.setenv("PYTHONPATH", str(tmp_path))
+    assert probe.probe_backend(timeout=3) is None
+    import time
+
+    t0 = time.perf_counter()
+    assert probe.probe_backend(timeout=3) is None  # served from cache
+    assert time.perf_counter() - t0 < 1.0
+
+
+def test_device_count_flag_merge():
+    out = probe._merge_device_count_flag(
+        "--foo --xla_force_host_platform_device_count=2", 8
+    )
+    assert "--xla_force_host_platform_device_count=8" in out
+    assert "--foo" in out
+    # keeps a larger existing value
+    out = probe._merge_device_count_flag(
+        "--xla_force_host_platform_device_count=16", 8
+    )
+    assert "--xla_force_host_platform_device_count=16" in out
+
+
+def test_force_cpu_after_backend_init():
+    # with the cpu backend live (8 devices): a satisfiable request is a
+    # no-op, an unsatisfiable one must raise loudly — XLA_FLAGS edits can
+    # no longer take effect
+    import jax
+
+    jax.device_count()  # ensure backend initialization
+    assert probe._backend_initialized()
+    probe.force_cpu(8)  # satisfied: no-op
+    with pytest.raises(RuntimeError, match="already initialized"):
+        probe.force_cpu(10_000)
+
+
+def test_ensure_backend_force_cpu_env(monkeypatch):
+    monkeypatch.setenv("XLA_FLAGS", os.environ.get("XLA_FLAGS", ""))
+    monkeypatch.setenv("TPU_SYNCBN_FORCE_CPU", "1")
+    info = probe.ensure_backend(4)
+    assert info.platform == "cpu"

@@ -294,3 +294,54 @@ def test_grad_compression_validation():
             SmallCNN(nnx.Rngs(0)), optax.sgd(0.1), ce_loss,
             grad_compression="fp8",
         )
+
+
+def test_lowered_train_step_cost_analysis():
+    # public AOT-lowering hook used by bench.py for MFU reporting: flops
+    # must be available from the lowered (pre-compile) module
+    m = tnn.convert_sync_batchnorm(SmallCNN(nnx.Rngs(0)))
+    dp = parallel.DataParallel(m, optax.sgd(0.05), ce_loss, donate=False)
+    batch = (
+        jnp.zeros((8, 8, 8, 3), jnp.float32),
+        jnp.zeros((8,), jnp.int32),
+    )
+    cost = dp.lowered_train_step(batch).cost_analysis()
+    assert cost.get("flops", 0) > 0
+
+
+def test_vma_unvarying_grad_transpose_pinned():
+    """Pin the VMA-mode AD semantics behind round 1's "8x off" BN grads:
+    under shard_map(check_vma=True), differentiating a *replicated*
+    (unvarying) param against sharded data returns a grad that is ALREADY
+    psum'd across replicas — the implicit pvary at the param's use
+    transposes to a psum. Casting the param to varying OUTSIDE the VJP
+    keeps the grad local. The trainer relies on exactly this pair of
+    facts (see _microbatch_grads); if a jax upgrade changes either, this
+    fails loudly before any silent numeric drift."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = runtime.data_parallel_mesh()
+    world = int(mesh.shape["data"])
+    x = jnp.arange(float(world * 2)).reshape(world * 2)
+
+    def body(w, xs):
+        loss = lambda w: (w * xs).sum()
+        g_auto = jax.grad(loss)(w)  # unvarying param: transpose psums
+        w_var = jax.lax.pcast(w, "data", to="varying")
+        g_local = jax.grad(loss)(w_var)  # varying param: local grad
+        return g_auto, jax.lax.psum(g_local, "data")
+
+    f = jax.jit(
+        shard_map(
+            body, mesh=mesh, in_specs=(P(), P("data")), out_specs=(P(), P()),
+            check_vma=True,
+        )
+    )
+    g_auto, g_local_sum = f(jnp.float32(2.0), x)
+    # the no-collective autodiff grad already equals the GLOBAL sum:
+    np.testing.assert_allclose(np.asarray(g_auto), np.asarray(x.sum()))
+    # and explicitly psum'ing the local grads gives the same — so doing
+    # BOTH (autodiff through unvarying + explicit psum/pmean) would
+    # double-count by exactly the world size
+    np.testing.assert_allclose(np.asarray(g_local_sum), np.asarray(x.sum()))

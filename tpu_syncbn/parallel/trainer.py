@@ -75,6 +75,19 @@ def sync_module_states(model: nnx.Module, src: int = 0) -> None:
     nnx.update(model, state)
 
 
+def _pcast_varying(tree, axis: str):
+    """Idempotently cast every leaf to device-varying over ``axis`` (pcast
+    raises on an already-varying input, and BN state mixes both: SyncBN
+    stats come out of their psum unvarying, plain-BN stats stay varying)."""
+
+    def leaf(x):
+        if axis in getattr(jax.typeof(x), "vma", frozenset()):
+            return x
+        return jax.lax.pcast(x, axis, to="varying")
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
 @dataclasses.dataclass
 class StepOutput:
     """What a compiled train step returns to the host."""
@@ -207,6 +220,16 @@ class DataParallel:
 
         if self.remat:
             lossed = jax.checkpoint(lossed)
+        # Cast replicated params to device-varying OUTSIDE the
+        # differentiated function. Under shard_map's VMA type system an
+        # *unvarying* param meeting varying data gets an implicit pvary
+        # whose transpose is a psum — value_and_grad would then return
+        # grads already summed across replicas, and the explicit pmean
+        # below would double-count by the world size (the "8x off"
+        # discrepancy of round 1). With the cast outside the VJP, grads
+        # stay local and the explicit pmean is the one aggregation —
+        # DDP's semantics, and check_vma=True validates the whole step.
+        params = _pcast_varying(params, self.axis_name)
         (loss, (metrics, new_rest)), grads = jax.value_and_grad(
             lossed, has_aux=True
         )(params, rest, batch)
@@ -240,15 +263,26 @@ class DataParallel:
                     batch,
                 )
 
+                # scan carries must keep a stable VMA type: local grads are
+                # device-varying, and BN stats flip between unvarying
+                # (SyncBN: psum'd) and varying (plain BN) — pin both
+                # carries to varying and let the post-scan broadcast/pmean
+                # restore replication
+                def to_varying(tree):
+                    return _pcast_varying(tree, axis)
+
                 def body(carry, mb):
                     rest, acc = carry
                     loss, metrics, rest, grads = self._microbatch_grads(
                         params, rest, mb
                     )
                     acc = jax.tree_util.tree_map(jnp.add, acc, grads)
-                    return (rest, acc), (loss, metrics)
+                    return (to_varying(rest), acc), (loss, metrics)
 
-                zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+                zero = to_varying(
+                    jax.tree_util.tree_map(jnp.zeros_like, params)
+                )
+                rest = to_varying(rest)
                 (rest, grads), (losses, metricses) = jax.lax.scan(
                     body, (rest, zero), micro
                 )
@@ -281,8 +315,11 @@ class DataParallel:
                 # per-step buffer broadcast (DDP forward_sync_buffers :793)
                 rest = collectives.broadcast(rest, src=0, axis_name=axis)
             else:
-                # re-stack for honest per-replica storage
-                rest = jax.tree_util.tree_map(lambda x: x[None], rest)
+                # re-stack for honest per-replica storage (P(axis) output:
+                # declare varying even when SyncBN stats are replicated)
+                rest = jax.tree_util.tree_map(
+                    lambda x: x[None], _pcast_varying(rest, axis)
+                )
             return params, rest, opt_state, loss, metrics
 
         sharded = shard_map(
@@ -290,13 +327,11 @@ class DataParallel:
             mesh=self.mesh,
             in_specs=(P(), self._rest_spec, P(), P(self.axis_name)),
             out_specs=(P(), self._rest_spec, P(), P(), P()),
-            # check_vma=False: enabling the VMA checker changes psum/pmean
-            # AD transpose semantics inside the step and produced BN-param
-            # grads that disagree with the verified big-batch oracle (8x
-            # off); output replication is instead guaranteed structurally —
-            # buffers are either broadcast from replica 0 or stored
-            # per-replica under P(axis).
-            check_vma=False,
+            # VMA checker ON: validates that params/opt_state/loss really
+            # are replicated after the step. Requires the explicit
+            # varying-cast of params in _microbatch_grads — see the
+            # comment there for the round-1 "8x off" root cause.
+            check_vma=True,
         )
         donate_argnums = (0, 1, 2) if donate else ()
         return jax.jit(sharded, donate_argnums=donate_argnums)
@@ -318,7 +353,7 @@ class DataParallel:
             mesh=self.mesh,
             in_specs=(P(), self._rest_spec, P(self.axis_name)),
             out_specs=(P(), P()),
-            check_vma=False,
+            check_vma=True,
         )
         return jax.jit(sharded)
 
@@ -335,6 +370,15 @@ class DataParallel:
     def eval_step(self, batch) -> StepOutput:
         loss, metrics = self._eval_step(self.params, self.rest, batch)
         return StepOutput(loss=loss, metrics=metrics)
+
+    def lowered_train_step(self, batch):
+        """AOT-lower the train step for the current state and ``batch``
+        without executing it — e.g. ``.cost_analysis()['flops']`` for MFU
+        reporting, or ``.as_text()`` for HLO inspection. Keeps the
+        (params, rest, opt_state, batch) calling convention private."""
+        return self._train_step.lower(
+            self.params, self.rest, self.opt_state, batch
+        )
 
     def sync_to_model(self) -> nnx.Module:
         """Write the trained state back into the wrapped nnx model (the

@@ -1,0 +1,182 @@
+"""Backend probing that survives a *hung* accelerator plugin.
+
+The reference recipe assumes a working NCCL backend and fails fast when it
+is absent. The TPU-tunnel equivalent failure mode here is worse than a
+raise: a registered-but-dead PJRT plugin makes ``jax.devices()`` block
+forever, which no in-process ``except`` clause can catch. Every driver
+entry point (``bench.py``, ``__graft_entry__.dryrun_multichip``) therefore
+probes the backend in a **subprocess with a hard timeout** before touching
+jax in its own process, and falls back to the CPU platform when the
+accelerator is unusable or too small.
+
+Env overrides:
+  ``TPU_SYNCBN_FORCE_CPU=1``      skip the probe, force the CPU platform
+  ``TPU_SYNCBN_PROBE_TIMEOUT=s``  probe timeout in seconds (default 75)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import NamedTuple, Optional
+
+
+class BackendInfo(NamedTuple):
+    platform: str
+    device_count: int
+
+
+# The axon sitecustomize force-selects its platform via jax.config at
+# interpreter start, which beats the JAX_PLATFORMS env var; re-assert the
+# env's explicit choice through jax.config so a driver that already forced
+# cpu gets a fast, honest probe instead of a doomed accelerator attempt.
+_PROBE_CODE = (
+    "import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
+    "p and jax.config.update('jax_platforms', p); "
+    "ds = jax.devices(); "
+    "print('PROBE', ds[0].platform, len(ds), flush=True)"
+)
+
+
+# One probe per process: the answer cannot change within a process (env
+# and sitecustomize are fixed at interpreter start), and a dead-tunnel
+# probe costs its full timeout — a driver calling entry() then
+# dryrun_multichip() must not pay it twice. Keyed by nothing; holds the
+# last result including the failure sentinel.
+_probe_cache: dict = {}
+
+
+def probe_backend(timeout: Optional[float] = None) -> Optional[BackendInfo]:
+    """Ask, in a throwaway subprocess, what ``jax.devices()`` would return
+    under the current environment. Returns ``None`` if the backend raises
+    OR hangs past ``timeout`` — the latter is the axon tunnel's observed
+    failure mode and the reason this cannot be an in-process try/except.
+    Caches its result for the life of the process.
+    """
+    if "result" in _probe_cache:
+        return _probe_cache["result"]
+    result = _probe_uncached(timeout)
+    _probe_cache["result"] = result
+    return result
+
+
+def _probe_uncached(timeout: Optional[float]) -> Optional[BackendInfo]:
+    if timeout is None:
+        timeout = float(os.environ.get("TPU_SYNCBN_PROBE_TIMEOUT", "75"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    if proc.returncode != 0:
+        return None
+    for line in proc.stdout.splitlines():
+        parts = line.split()
+        if len(parts) == 3 and parts[0] == "PROBE":
+            return BackendInfo(platform=parts[1], device_count=int(parts[2]))
+    return None
+
+
+def _backend_initialized() -> bool:
+    """Has THIS process already initialized a jax backend? (After that,
+    platform/XLA_FLAGS changes silently do nothing — fail loudly instead.)"""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge.backends_are_initialized())
+    except Exception:
+        return False
+
+
+def force_cpu(min_devices: int = 1) -> None:
+    """Select the CPU platform *before* the in-process backend initializes,
+    with at least ``min_devices`` virtual host devices.
+
+    Both the env var and the config update are required: the axon
+    sitecustomize pins ``jax_platforms`` via ``jax.config`` at interpreter
+    start, so the env var alone loses; and ``XLA_FLAGS`` is only read at
+    first backend initialization, so this must run before any
+    ``jax.devices()`` call in this process. If the backend is already
+    live and does not satisfy the request, this raises instead of
+    returning a fallback that silently never happens.
+    """
+    if _backend_initialized():
+        import jax
+
+        if jax.default_backend() != "cpu" or len(jax.devices()) < min_devices:
+            raise RuntimeError(
+                "cannot fall back to CPU: this process already initialized "
+                f"the '{jax.default_backend()}' backend with "
+                f"{len(jax.devices())} device(s) (< {min_devices} requested "
+                "or wrong platform). Call ensure_backend(min_devices) "
+                "before any jax computation in the process."
+            )
+        return
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if min_devices > 1:
+        os.environ["XLA_FLAGS"] = _merge_device_count_flag(
+            os.environ.get("XLA_FLAGS", ""), min_devices
+        )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _merge_device_count_flag(flags: str, min_devices: int) -> str:
+    """Ensure ``--xla_force_host_platform_device_count`` is present with at
+    least ``min_devices`` (keeping a larger existing value)."""
+    token = "--xla_force_host_platform_device_count="
+    parts = [p for p in flags.split() if not p.startswith(token)]
+    existing = next(
+        (int(p[len(token):]) for p in flags.split() if p.startswith(token)),
+        0,
+    )
+    parts.append(token + str(max(existing, min_devices)))
+    return " ".join(parts)
+
+
+def ensure_backend(min_devices: int = 1) -> BackendInfo:
+    """Guarantee a *usable* jax backend with >= ``min_devices`` devices,
+    probing the accelerator first and falling back to (virtual) CPU
+    devices when it is dead, hung, or too small. Returns what the probe
+    (or the fallback decision) established; call before first jax backend
+    touch in the process.
+    """
+    if os.environ.get("TPU_SYNCBN_FORCE_CPU") == "1":
+        force_cpu(min_devices)
+        return BackendInfo("cpu", min_devices)
+    # Mirror _PROBE_CODE in-process: the sitecustomize's jax.config pin
+    # beats the JAX_PLATFORMS env var, so a successful "cpu" probe under
+    # JAX_PLATFORMS=cpu would otherwise still leave THIS process about to
+    # initialize the (possibly hung) accelerator platform.
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms:
+        import jax
+
+        jax.config.update("jax_platforms", env_platforms)
+    info = probe_backend()
+    if info is None:
+        print(
+            "[tpu_syncbn.probe] accelerator backend unusable (probe failed "
+            "or timed out); forcing CPU platform",
+            file=sys.stderr,
+            flush=True,
+        )
+        force_cpu(min_devices)
+        return BackendInfo("cpu", min_devices)
+    if info.device_count < min_devices:
+        print(
+            f"[tpu_syncbn.probe] {info.platform} offers {info.device_count} "
+            f"device(s) < required {min_devices}; forcing CPU platform with "
+            f"{min_devices} virtual devices",
+            file=sys.stderr,
+            flush=True,
+        )
+        force_cpu(min_devices)
+        return BackendInfo("cpu", min_devices)
+    return info
