@@ -239,7 +239,10 @@ void tsb_store_close(int32_t fd) {
 int32_t tsb_store_set(int32_t fd, const char* key, const uint8_t* val,
                       uint32_t vlen) {
   char op = 't';
-  std::string k(key), v((const char*)val, vlen);
+  // val may be NULL for an empty value (ctypes passes None as NULL);
+  // std::string(nullptr, 0) is UB per the standard, so guard it.
+  std::string k(key), v(val ? std::string((const char*)val, vlen)
+                            : std::string());
   if (!write_full(fd, &op, 1) || !write_str(fd, k) || !write_str(fd, v))
     return -1;
   char resp;

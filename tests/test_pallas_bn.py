@@ -177,3 +177,24 @@ def test_fused_bn_bias_only_grad():
     gb_x = jax.grad(loss_x)(b)
     assert float(jnp.abs(gb_p).max()) > 0
     np.testing.assert_allclose(np.asarray(gb_p), np.asarray(gb_x), rtol=1e-4, atol=1e-5)
+
+
+def test_fused_batch_norm_stat_grad_fails_loudly():
+    # the VJP defines no gradient for the stat outputs; requesting one must
+    # raise, not silently return zeros (advisor finding, round 1)
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    w = jnp.ones((8,), jnp.float32)
+    b = jnp.zeros((8,), jnp.float32)
+
+    def loss_through_mean(x):
+        _, mean, _, _ = pallas_bn.fused_batch_norm(x, w, b, 1e-5, None)
+        return mean.sum()
+
+    with pytest.raises(ValueError, match="no gradient for its 'mean'"):
+        jax.grad(loss_through_mean)(x)
+
+    def loss_through_y(x):
+        y, _, _, _ = pallas_bn.fused_batch_norm(x, w, b, 1e-5, None)
+        return y.sum()
+
+    jax.grad(loss_through_y)(x)  # y-only gradient still works

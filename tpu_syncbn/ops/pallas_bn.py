@@ -258,16 +258,39 @@ def _fbn_fwd_impl(x, weight, bias, eps, axis_name):
     return y, mean, var, count, invstd
 
 
+def _unwrap_primal(p):
+    from jax.custom_derivatives import CustomVJPPrimal
+
+    return p.value if isinstance(p, CustomVJPPrimal) else p
+
+
 def _fbn_fwd(x, weight, bias, eps, axis_name):
+    # symbolic_zeros=True wraps each diff argument in CustomVJPPrimal
+    x, weight, bias = map(_unwrap_primal, (x, weight, bias))
     y, mean, var, count, invstd = _fbn_fwd_impl(x, weight, bias, eps, axis_name)
     return (y, mean, var, count), (x, weight, bias, mean, invstd, count)
 
 
 def _fbn_bwd(eps, axis_name, res, cts):
+    from jax.custom_derivatives import SymbolicZero
+
     x, weight, bias, mean, invstd, count = res
-    dy = cts[0]  # cotangents for mean/var/count are ignored: stats feed the
-    # (stop-gradient) running buffers only, as in the reference where the
-    # buffer update happens inside a no-grad kernel
+    dy, *stat_cts = cts
+    # The mean/var/count outputs feed the (no-grad) running-buffer update
+    # only, as in the reference where that update happens inside a no-grad
+    # kernel; this VJP defines no gradient for them. symbolic_zeros lets us
+    # verify the caller isn't differentiating through them — silently
+    # returning zero for a requested gradient would be a wrong answer.
+    for name, ct in zip(("mean", "var", "count"), stat_cts):
+        if not isinstance(ct, SymbolicZero):
+            raise ValueError(
+                f"fused_batch_norm defines no gradient for its '{name}' "
+                "statistic output (stats feed the no-grad running-buffer "
+                "update only); apply jax.lax.stop_gradient to the stats or "
+                "differentiate through y alone"
+            )
+    if isinstance(dy, SymbolicZero):  # only stats were used downstream
+        dy = jnp.zeros(dy.shape, dy.dtype)
 
     sum_dy, sum_dy_xhat = bn_backward_reduce(dy, x, mean, invstd)
 
@@ -305,4 +328,4 @@ def _fbn_bwd(eps, axis_name, res, cts):
     return dx, gw, gb
 
 
-fused_batch_norm.defvjp(_fbn_fwd, _fbn_bwd)
+fused_batch_norm.defvjp(_fbn_fwd, _fbn_bwd, symbolic_zeros=True)

@@ -117,9 +117,14 @@ def load_checkpoint(directory: str, target: Any, *, step: int | None = None):
     Multi-host (shared filesystem): hosts first synchronize, then agree on
     the step by taking the *master host's* latest — listing independently
     could race the master's in-flight write/prune and restore different
-    steps per host, breaking the replicas-identical invariant.
+    steps per host, breaking the replicas-identical invariant. Followers
+    then open the agreed path directly (with a short retry) instead of
+    validating it against their *own* directory listing: on a shared
+    filesystem with attribute-cache lag the listing can omit a file that
+    is already readable.
     """
-    if dist.process_count() > 1:
+    multi_host = dist.process_count() > 1
+    if multi_host:
         dist.barrier("ckpt-load")
         if step is None:
             from jax.experimental import multihost_utils
@@ -132,17 +137,42 @@ def load_checkpoint(directory: str, target: Any, *, step: int | None = None):
                     mine, is_source=dist.is_master()
                 )
             )
-            step = agreed if agreed >= 0 else None
-    steps = available_steps(directory)
-    if not steps or (step is not None and step not in steps):
-        raise FileNotFoundError(
-            f"step {step} not in {steps}" if steps
-            else f"no checkpoints in {directory!r}"
-        )
-    if step is None:
-        step = steps[-1]
-    with open(_path(directory, step), "rb") as f:
-        data = f.read()
+            if agreed < 0:
+                # master sees nothing: fail identically on every host
+                raise FileNotFoundError(
+                    f"no checkpoints in {directory!r} on the master host"
+                )
+            step = agreed
+    if multi_host and not dist.is_master():
+        data = _read_with_retry(_path(directory, step))
+    else:
+        steps = available_steps(directory)
+        if not steps or (step is not None and step not in steps):
+            raise FileNotFoundError(
+                f"step {step} not in {steps}" if steps
+                else f"no checkpoints in {directory!r}"
+            )
+        if step is None:
+            step = steps[-1]
+        with open(_path(directory, step), "rb") as f:
+            data = f.read()
     pure_target = _purify(target)
     pure = serialization.from_bytes(pure_target, data)
     return _unpurify(target, pure), step
+
+
+def _read_with_retry(path: str, attempts: int = 5, delay: float = 0.2) -> bytes:
+    """Open ``path`` directly, retrying briefly on FileNotFoundError —
+    shared-filesystem attribute caches can lag a peer's just-completed
+    rename even though the data is readable."""
+    import time
+
+    for i in range(attempts):
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            if i == attempts - 1:
+                raise
+            time.sleep(delay * (2**i))
+    raise AssertionError("unreachable")
