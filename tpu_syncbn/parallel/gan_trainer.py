@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_syncbn.models.gan import bce_gan_losses, hinge_gan_losses
 from tpu_syncbn.parallel import collectives
+from tpu_syncbn.parallel.trainer import _pcast_varying
 from tpu_syncbn.runtime import distributed as dist
 from tpu_syncbn.runtime.distributed import DATA_AXIS
 
@@ -110,8 +111,13 @@ class GANTrainer:
                 aux = (gr_out, dr_out, real_logits, fake_logits)
                 return d_loss, aux
 
+            # varying-cast OUTSIDE the VJP so grads stay local and the
+            # explicit pmean is the one aggregation (see trainer.py's
+            # _microbatch_grads for the VMA transpose root cause)
             (d_loss, (gr, dr, real_logits, fake_logits)), d_grads = (
-                jax.value_and_grad(d_loss_fn, has_aux=True)(dp_, gr, dr)
+                jax.value_and_grad(d_loss_fn, has_aux=True)(
+                    _pcast_varying(dp_, axis), gr, dr
+                )
             )
             d_grads = collectives.pmean(d_grads, axis)
             d_updates, od = self.d_opt.update(d_grads, od, dp_)
@@ -132,7 +138,7 @@ class GANTrainer:
 
             (g_loss, (gr, dr)), g_grads = jax.value_and_grad(
                 g_loss_fn, has_aux=True
-            )(gp, gr, dr)
+            )(_pcast_varying(gp, axis), gr, dr)
             g_grads = collectives.pmean(g_grads, axis)
             g_updates, og = self.g_opt.update(g_grads, og, gp)
             gp = optax.apply_updates(gp, g_updates)
@@ -157,7 +163,7 @@ class GANTrainer:
             in_specs=(P(), P(), P(), P(), P(), P(),
                       P(self.axis_name), P(self.axis_name), P(self.axis_name)),
             out_specs=(P(),) * 6 + (P(), P(), P()),
-            check_vma=False,
+            check_vma=True,
         )
         donate_argnums = tuple(range(6)) if donate else ()
         return jax.jit(sharded, donate_argnums=donate_argnums)
@@ -216,7 +222,7 @@ class GANTrainer:
                     gen, mesh=self.mesh,
                     in_specs=(P(), P(), P(self.axis_name)),
                     out_specs=P(self.axis_name),
-                    check_vma=False,
+                    check_vma=True,
                 )
             )
         world = int(self.mesh.shape[self.axis_name])
