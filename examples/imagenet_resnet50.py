@@ -14,6 +14,7 @@ sharding, and step math are identical.
 """
 
 import argparse
+import os
 
 import jax.numpy as jnp
 import optax
@@ -22,10 +23,48 @@ from flax import nnx
 from tpu_syncbn import data as tdata
 from tpu_syncbn import models, nn, parallel, runtime, utils
 
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def make_imagefolder_datasets(root: str, image_size: int):
+    """Real-JPEG ImageFolder datasets (``root/train`` + ``root/val``, or a
+    single split dir) with the standard ImageNet train/eval transforms —
+    the reference's step-5 real ``Dataset`` (``README.md:76-91``)."""
+    T = tdata.transforms
+    train_tf = T.Compose([
+        T.RandomResizedCrop(image_size),
+        T.RandomHorizontalFlip(),
+        T.ToFloat(),
+        T.Normalize(IMAGENET_MEAN, IMAGENET_STD),
+    ])
+    eval_tf = T.Compose([
+        # shorter-side resize preserving aspect (torchvision Resize(256))
+        T.ResizeShortestEdge(max(image_size, int(round(image_size * 256 / 224)))),
+        T.CenterCrop(image_size),
+        T.ToFloat(),
+        T.Normalize(IMAGENET_MEAN, IMAGENET_STD),
+    ])
+    train_root = os.path.join(root, "train")
+    val_root = os.path.join(root, "val")
+    if not os.path.isdir(train_root):
+        train_root = val_root = root  # single-split tree
+    if not os.path.isdir(val_root):
+        val_root = train_root
+    train_ds = tdata.ImageFolderDataset(train_root, train_tf)
+    val_ds = tdata.ImageFolderDataset(
+        val_root, eval_tf, class_to_idx=train_ds.class_to_idx
+    )
+    return train_ds, val_ds
+
 
 def parse_args():
     p = argparse.ArgumentParser()
     p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--data-root", default=None,
+                   help="ImageFolder tree (root/train/<class>/*.jpg and "
+                        "root/val/<class>/*.jpg, or a single split dir); "
+                        "synthetic data when omitted")
     p.add_argument("--batch-size", type=int, default=256, help="global")
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--image-size", type=int, default=224)
@@ -48,6 +87,25 @@ def main():
     log = runtime.get_logger("imagenet")
     log.info("world: %d chips / %d hosts", runtime.global_device_count(),
              runtime.process_count())
+
+    shape = (args.image_size, args.image_size, 3)
+    if args.data_root:
+        train_ds, val_ds = make_imagefolder_datasets(
+            args.data_root, args.image_size
+        )
+        args.num_classes = len(train_ds.class_to_idx)
+        args.dataset_size = len(train_ds)
+        log.info("real data: %d train / %d val images, %d classes",
+                 len(train_ds), len(val_ds), args.num_classes)
+    else:
+        train_ds = tdata.SyntheticImageDataset(
+            length=args.dataset_size, shape=shape,
+            num_classes=args.num_classes, seed=0,
+        )
+        val_ds = tdata.SyntheticImageDataset(
+            length=max(args.batch_size, args.dataset_size // 8), shape=shape,
+            num_classes=args.num_classes, seed=1,
+        )
 
     dtype = jnp.bfloat16 if args.dtype == "bf16" else None
     model = nn.convert_sync_batchnorm(
@@ -84,15 +142,6 @@ def main():
         except FileNotFoundError:
             log.info("no checkpoint found; starting fresh")
 
-    shape = (args.image_size, args.image_size, 3)
-    train_ds = tdata.SyntheticImageDataset(
-        length=args.dataset_size, shape=shape, num_classes=args.num_classes,
-        seed=0,
-    )
-    val_ds = tdata.SyntheticImageDataset(
-        length=max(args.batch_size, args.dataset_size // 8), shape=shape,
-        num_classes=args.num_classes, seed=1,
-    )
     sampler = tdata.DistributedSampler(
         len(train_ds), num_replicas=runtime.process_count(),
         rank=runtime.process_index(), shuffle=True, seed=0,

@@ -1,0 +1,170 @@
+"""Real-image ingestion: JPEG-folder dataset (PIL decode), bilinear
+transforms, and real-image COCO loading — the reference's step-5 real
+``Dataset`` contract (``README.md:76-91``)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpu_syncbn import data as tdata
+from tpu_syncbn.data import transforms as T
+
+
+def _write_jpeg(path, rgb, size=(32, 24)):
+    from PIL import Image
+
+    w, h = size
+    arr = np.zeros((h, w, 3), np.uint8)
+    arr[..., :] = rgb
+    Image.fromarray(arr).save(path, quality=95)
+
+
+@pytest.fixture
+def image_tree(tmp_path):
+    """root/{cats,dogs}/*.jpg with distinguishable solid colors."""
+    for cls, rgb, n in (("cats", (200, 30, 30), 3), ("dogs", (30, 30, 200), 2)):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(n):
+            _write_jpeg(str(d / f"img_{i}.jpg"), rgb)
+    return str(tmp_path)
+
+
+def test_image_folder_layout_and_labels(image_tree):
+    ds = tdata.ImageFolderDataset(image_tree)
+    assert len(ds) == 5
+    assert ds.class_to_idx == {"cats": 0, "dogs": 1}
+    img, label = ds[0]
+    assert img.dtype == np.uint8 and img.shape == (24, 32, 3)
+    # first three samples are cats (sorted): red-dominant
+    assert label == 0 and img[..., 0].mean() > img[..., 2].mean()
+    img, label = ds[4]
+    assert label == 1 and img[..., 2].mean() > img[..., 0].mean()
+
+
+def test_image_folder_pinned_class_mapping(image_tree):
+    pinned = {"dogs": 0, "cats": 1}
+    ds = tdata.ImageFolderDataset(image_tree, class_to_idx=pinned)
+    labels = {ds[i][1] for i in range(len(ds))}
+    assert labels == {0, 1}
+    assert ds.samples[0][1] == 1  # cats now label 1
+
+
+def test_image_folder_transform_and_loader(image_tree):
+    tf = T.Compose([
+        T.RandomResizedCrop(16, seed=0),
+        T.RandomHorizontalFlip(seed=1),
+        T.ToFloat(),
+        T.Normalize((0.5, 0.5, 0.5), (0.25, 0.25, 0.25)),
+    ])
+    ds = tdata.ImageFolderDataset(image_tree, tf)
+    sampler = tdata.DistributedSampler(
+        len(ds), num_replicas=2, rank=0, shuffle=True, seed=0, drop_last=False
+    )
+    loader = tdata.DataLoader(
+        ds, batch_size=2, sampler=sampler, num_workers=2, drop_last=True
+    )
+    batches = list(loader)
+    assert len(batches) == 1  # ceil(5/2)=3 per rank, one full batch of 2
+    x, y = batches[0]
+    assert x.shape == (2, 16, 16, 3) and x.dtype == np.float32
+    assert y.shape == (2,)
+
+
+def test_image_folder_missing_root(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        tdata.ImageFolderDataset(str(tmp_path / "nope"))
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    (empty / "cls").mkdir()
+    with pytest.raises(FileNotFoundError):
+        tdata.ImageFolderDataset(str(empty))
+
+
+def test_resize_bilinear_matches_pil_uint8():
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 256, (20, 30, 3), np.uint8)
+    out = T.Resize(8)(x)
+    ref = np.asarray(Image.fromarray(x).resize((8, 8), Image.BILINEAR))
+    np.testing.assert_array_equal(out, ref)
+    assert out.dtype == np.uint8
+
+
+def test_resize_bilinear_float_and_nearest_option():
+    x = np.linspace(0, 1, 16 * 12 * 3, dtype=np.float32).reshape(16, 12, 3)
+    out = T.Resize(6)(x)
+    assert out.shape == (6, 6, 3) and out.dtype == np.float32
+    # bilinear of a linear ramp stays within the input range
+    assert out.min() >= x.min() - 1e-6 and out.max() <= x.max() + 1e-6
+    out_nn = T.Resize(6, interpolation="nearest")(x)
+    assert out_nn.shape == (6, 6, 3)
+    # nearest picks existing values
+    assert np.isin(out_nn, x).all()
+
+
+def test_coco_real_images(tmp_path):
+    img_dir = tmp_path / "images"
+    img_dir.mkdir()
+    _write_jpeg(str(img_dir / "a.jpg"), (100, 150, 200), size=(40, 20))
+    ann = {
+        "images": [{"id": 1, "file_name": "a.jpg"}],
+        "categories": [{"id": 7}, {"id": 9}],
+        "annotations": [
+            {"image_id": 1, "category_id": 9, "bbox": [10, 5, 20, 10]},
+        ],
+    }
+    ann_file = tmp_path / "ann.json"
+    ann_file.write_text(json.dumps(ann))
+
+    ds = tdata.CocoDetectionDataset(
+        str(ann_file), str(img_dir), max_boxes=4, image_size=(10, 20)
+    )
+    image, boxes, labels, valid = ds[0]
+    assert image.shape == (10, 20, 3) and image.dtype == np.float32
+    assert 0.0 <= image.min() and image.max() <= 1.0  # /255 scaling
+    # original 40x20 → 20x10: boxes halve in both axes
+    np.testing.assert_allclose(boxes[0], [5.0, 2.5, 15.0, 7.5])
+    assert labels[0] == 1 and valid[0] and not valid[1]
+
+
+def test_coco_npy_fallback(tmp_path):
+    img_dir = tmp_path / "images"
+    img_dir.mkdir()
+    np.save(str(img_dir / "b.jpg.npy"), np.ones((8, 8, 3), np.float32))
+    ann = {
+        "images": [{"id": 1, "file_name": "b.jpg"}],
+        "categories": [{"id": 1}],
+        "annotations": [
+            {"image_id": 1, "category_id": 1, "bbox": [1, 1, 2, 2]},
+        ],
+    }
+    ann_file = tmp_path / "ann.json"
+    ann_file.write_text(json.dumps(ann))
+    ds = tdata.CocoDetectionDataset(str(ann_file), str(img_dir), max_boxes=2)
+    image, boxes, labels, valid = ds[0]
+    assert image.shape == (8, 8, 3)
+    np.testing.assert_allclose(boxes[0], [1, 1, 3, 3])
+
+
+def test_resize_shortest_edge_preserves_aspect():
+    x = np.random.RandomState(0).randint(0, 256, (100, 50, 3), np.uint8)
+    out = T.ResizeShortestEdge(25)(x)  # shorter side 50 → 25, longer 100 → 50
+    assert out.shape == (50, 25, 3)
+    y = np.random.RandomState(1).randint(0, 256, (30, 90, 3), np.uint8)
+    out = T.ResizeShortestEdge(15)(y)
+    assert out.shape == (15, 45, 3)
+    # no-op when already at size
+    z = np.zeros((20, 40, 3), np.uint8)
+    assert T.ResizeShortestEdge(20)(z) is z
+
+
+def test_resize_bilinear_grayscale_round_trip():
+    # 2-D input stays 2-D; integer output is rounded, not truncated
+    x = np.full((10, 10), 100, np.uint8)
+    out = T.Resize(4)(x)
+    assert out.shape == (4, 4) and out.dtype == np.uint8
+    np.testing.assert_array_equal(out, np.full((4, 4), 100, np.uint8))

@@ -22,8 +22,11 @@ from tpu_syncbn.data.detection import (
     CocoDetectionDataset,
     pad_ground_truth,
 )
+from tpu_syncbn.data.image_folder import ImageFolderDataset, decode_image
 
 __all__ = [
+    "ImageFolderDataset",
+    "decode_image",
     "SyntheticDetectionDataset",
     "CocoDetectionDataset",
     "pad_ground_truth",

@@ -76,20 +76,27 @@ class SyntheticDetectionDataset(Dataset):
 
 
 class CocoDetectionDataset(Dataset):
-    """COCO-format annotations + an image-array store.
+    """COCO-format annotations + real images (or a pre-decoded store).
 
     ``annotation_file`` is standard COCO instances JSON. Images load from
-    ``image_root`` as ``{file_name}.npy`` arrays (HWC float32) — the
-    decode-to-npy step is a one-off preprocessing pass (no JPEG decode
-    dependency in the hot path). Category ids are densified to [0, K).
+    ``image_root``: the actual ``file_name`` (JPEG/PNG, PIL decode — the
+    real-COCO path, reference ``README.md:76-91`` step 5) when present,
+    else ``{file_name}.npy`` (HWC float32 from a one-off pre-decode
+    pass). Category ids are densified to [0, K).
+
+    ``image_size=(H, W)`` resizes every image to a fixed shape (bilinear)
+    and scales its boxes to match — TPU static-shape requirement for
+    batched detection training.
     """
 
     def __init__(self, annotation_file: str, image_root: str, *,
-                 max_boxes: int = 100):
+                 max_boxes: int = 100,
+                 image_size: tuple[int, int] | None = None):
         with open(annotation_file) as f:
             coco = json.load(f)
         self.image_root = image_root
         self.max_boxes = max_boxes
+        self.image_size = image_size
         cats = sorted(c["id"] for c in coco.get("categories", []))
         self.cat_to_dense = {c: i for i, c in enumerate(cats)}
         self.num_classes = len(cats)
@@ -117,6 +124,21 @@ class CocoDetectionDataset(Dataset):
 
     def __getitem__(self, idx):
         file_name, boxes, labels = self.entries[idx]
-        path = os.path.join(self.image_root, file_name + ".npy")
-        image = np.load(path).astype(np.float32)
+        raw = os.path.join(self.image_root, file_name)
+        if os.path.exists(raw):
+            from tpu_syncbn.data.image_folder import decode_image
+
+            image = decode_image(raw).astype(np.float32) / 255.0
+        else:
+            image = np.load(raw + ".npy").astype(np.float32)
+        if self.image_size is not None:
+            h, w = image.shape[:2]
+            th, tw = self.image_size
+            if (h, w) != (th, tw):
+                from tpu_syncbn.data.transforms import _resize_bilinear
+
+                image = _resize_bilinear(image, (th, tw))
+                boxes = boxes * np.asarray(
+                    [tw / w, th / h, tw / w, th / h], np.float32
+                )
         return (image,) + pad_ground_truth(boxes, labels, self.max_boxes)

@@ -96,13 +96,16 @@ class RandomCrop:
 
 
 class RandomResizedCrop:
-    """ImageNet-style scale/aspect jitter crop + nearest resize."""
+    """ImageNet-style scale/aspect jitter crop + resize (bilinear by
+    default, matching torchvision)."""
 
     def __init__(self, size: int, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
-                 *, rng=None, seed: int | None = None):
+                 *, interpolation: str = "bilinear",
+                 rng=None, seed: int | None = None):
         self.size = size
         self.scale = scale
         self.ratio = ratio
+        self.interpolation = interpolation
         self._draws = _Draws(rng, seed)
 
     def __call__(self, x):
@@ -119,10 +122,12 @@ class RandomResizedCrop:
                 i = self._draws.randint(h - ch + 1)
                 j = self._draws.randint(w - cw + 1)
                 crop = x[i : i + ch, j : j + cw]
-                return _resize_nearest(crop, self.size)
+                return _resize(crop, self.size, self.interpolation)
         side = min(h, w)  # fallback: center crop
         i, j = (h - side) // 2, (w - side) // 2
-        return _resize_nearest(x[i : i + side, j : j + side], self.size)
+        return _resize(
+            x[i : i + side, j : j + side], self.size, self.interpolation
+        )
 
 
 def _resize_nearest(x: np.ndarray, size: int) -> np.ndarray:
@@ -132,14 +137,79 @@ def _resize_nearest(x: np.ndarray, size: int) -> np.ndarray:
     return x[ri][:, rj]
 
 
+def _resize_bilinear(
+    x: np.ndarray, size: int | tuple[int, int]
+) -> np.ndarray:
+    """PIL bilinear resize (the torchvision default filter) to
+    ``(size, size)`` or ``(h, w)``; uint8 RGB goes through the fast C
+    path, everything else per-channel in 'F' mode (rounded, not
+    truncated, when cast back to an integer dtype)."""
+    from PIL import Image
+
+    th, tw = (size, size) if isinstance(size, int) else size
+    if x.dtype == np.uint8 and x.ndim == 3 and x.shape[2] in (3, 4):
+        mode = "RGB" if x.shape[2] == 3 else "RGBA"
+        im = Image.fromarray(x, mode)
+        return np.asarray(im.resize((tw, th), Image.BILINEAR))
+    squeeze = x.ndim == 2
+    x3 = np.atleast_3d(x)
+    chans = [
+        np.asarray(
+            Image.fromarray(np.asarray(x3[..., c], np.float32), mode="F")
+            .resize((tw, th), Image.BILINEAR)
+        )
+        for c in range(x3.shape[2])
+    ]
+    out = np.stack(chans, axis=-1)
+    if np.issubdtype(x.dtype, np.integer):
+        info = np.iinfo(x.dtype)
+        out = np.clip(np.rint(out), info.min, info.max)
+    out = out.astype(x.dtype)
+    return out[..., 0] if squeeze else out
+
+
+def _resize(x, size, interpolation: str):
+    if interpolation == "bilinear":
+        return _resize_bilinear(x, size)
+    if interpolation == "nearest":
+        return _resize_nearest(x, size)
+    raise ValueError(
+        f"interpolation must be 'bilinear' or 'nearest', got {interpolation!r}"
+    )
+
+
 class Resize:
-    """Nearest-neighbor resize to (size, size)."""
+    """Resize to (size, size); bilinear by default (torchvision's filter,
+    needed for top-1 parity on real images), ``interpolation="nearest"``
+    for the exact-integer path. NOTE: always square — for torchvision's
+    ``Resize(int)`` shorter-side semantics use :class:`ResizeShortestEdge`."""
+
+    def __init__(self, size: int, *, interpolation: str = "bilinear"):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, x):
+        return _resize(x, self.size, self.interpolation)
+
+
+class ResizeShortestEdge:
+    """torchvision ``Resize(int)`` semantics: scale the *shorter* side to
+    ``size``, preserving aspect ratio (bilinear) — the standard ImageNet
+    eval preprocessing (Resize(256) → CenterCrop(224)); a square resize
+    there distorts every non-square image and breaks top-1 parity."""
 
     def __init__(self, size: int):
         self.size = size
 
     def __call__(self, x):
-        return _resize_nearest(x, self.size)
+        h, w = x.shape[:2]
+        if h <= w:
+            th, tw = self.size, max(1, int(round(w * self.size / h)))
+        else:
+            th, tw = max(1, int(round(h * self.size / w))), self.size
+        if (th, tw) == (h, w):
+            return x
+        return _resize_bilinear(x, (th, tw))
 
 
 class CenterCrop:
