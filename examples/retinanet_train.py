@@ -33,6 +33,9 @@ def parse_args():
                    help="'small' = tiny backbone for CPU simulation")
     p.add_argument("--coco-annotations", default=None)
     p.add_argument("--coco-images", default=None)
+    p.add_argument("--eval-images", type=int, default=64,
+                   help="images for the final mAP eval")
+    p.add_argument("--eval-top-k", type=int, default=100)
     p.add_argument("--ckpt-dir", default=None)
     return p.parse_args()
 
@@ -127,20 +130,35 @@ def main():
     if args.ckpt_dir:
         utils.save_checkpoint(args.ckpt_dir, it, dp.state_dict())
 
-    # decode + per-class NMS on one batch (the eval post-process)
+    # full eval: decode + per-class NMS per image, then COCO-style
+    # AP@[.5:.95] over the (rank-local) eval split — the BASELINE mAP
+    # harness (self-contained; pycocotools is unavailable here)
     m = dp.sync_to_model()
     m.eval()
-    sample = ds[0][0][None]
-    boxes, scores, classes, keep_mask = m.decode(sample, top_k=50)
-    above = np.asarray(keep_mask[0])  # score_thresh filter from decode
-    kept = det.batched_nms(
-        np.asarray(boxes[0])[above],
-        np.asarray(scores[0])[above],
-        np.asarray(classes[0])[above],
+    n_eval = min(len(ds), args.eval_images)
+    detections, ground_truths = [], []
+    for i in range(n_eval):
+        image, gboxes, glabels, gvalid = ds[i]
+        boxes, scores, classes, keep_mask = m.decode(
+            image[None], top_k=args.eval_top_k
+        )
+        above = np.asarray(keep_mask[0])
+        b = np.asarray(boxes[0])[above]
+        s = np.asarray(scores[0])[above]
+        c = np.asarray(classes[0])[above]
+        kept = det.batched_nms(b, s, c)
+        detections.append((b[kept], s[kept], c[kept]))
+        gvalid = np.asarray(gvalid)
+        ground_truths.append(
+            (np.asarray(gboxes)[gvalid], np.asarray(glabels)[gvalid])
+        )
+    ap = utils.evaluate_detections(
+        detections, ground_truths, num_classes=args.num_classes
     )
     runtime.master_print(
-        f"done: {it} iters; {int(above.sum())} above threshold, "
-        f"{len(kept)} after NMS, top score {float(scores[0].max()):.3f}"
+        f"done: {it} iters; eval on {n_eval} images: "
+        f"mAP@[.5:.95] {ap['mAP']:.4f}  AP50 {ap['AP50']:.4f}  "
+        f"AP75 {ap['AP75']:.4f}"
     )
 
 

@@ -1,0 +1,108 @@
+"""Self-contained COCO mAP implementation, validated on hand-computed
+cases (pycocotools is unavailable in this environment)."""
+
+import numpy as np
+import pytest
+
+from tpu_syncbn.utils.coco_map import evaluate_detections, _box_iou_np
+
+
+def det(boxes, scores, classes):
+    return (
+        np.asarray(boxes, np.float32).reshape(-1, 4),
+        np.asarray(scores, np.float32),
+        np.asarray(classes, np.int32),
+    )
+
+
+def gt(boxes, classes):
+    return (
+        np.asarray(boxes, np.float32).reshape(-1, 4),
+        np.asarray(classes, np.int32),
+    )
+
+
+def test_box_iou():
+    a = np.asarray([[0, 0, 10, 10]], np.float32)
+    b = np.asarray([[0, 0, 10, 10], [5, 5, 15, 15], [20, 20, 30, 30]], np.float32)
+    iou = _box_iou_np(a, b)
+    np.testing.assert_allclose(iou[0], [1.0, 25 / 175, 0.0], atol=1e-6)
+
+
+def test_perfect_detections_map_1():
+    g = [gt([[0, 0, 10, 10], [20, 20, 30, 30]], [0, 1])]
+    d = [det([[0, 0, 10, 10], [20, 20, 30, 30]], [0.9, 0.8], [0, 1])]
+    out = evaluate_detections(d, g, num_classes=2)
+    assert out["mAP"] == pytest.approx(1.0)
+    assert out["AP50"] == pytest.approx(1.0)
+    np.testing.assert_allclose(out["per_class"], [1.0, 1.0])
+
+
+def test_one_tp_one_higher_scored_fp():
+    # FP scored above the TP: precision envelope is 0.5 at every recall
+    g = [gt([[0, 0, 10, 10]], [0])]
+    d = [det([[50, 50, 60, 60], [0, 0, 10, 10]], [0.9, 0.8], [0, 0])]
+    out = evaluate_detections(d, g, num_classes=1)
+    assert out["mAP"] == pytest.approx(0.5)
+
+
+def test_localization_quality_gates_iou_thresholds():
+    # det [0,0,10,6] vs gt [0,0,10,10]: IoU = 60/100 = 0.6
+    # → TP at thresholds 0.50, 0.55, 0.60 only: mAP = 3/10
+    g = [gt([[0, 0, 10, 10]], [0])]
+    d = [det([[0, 0, 10, 6]], [0.9], [0])]
+    out = evaluate_detections(d, g, num_classes=1)
+    assert out["mAP"] == pytest.approx(0.3)
+    assert out["AP50"] == pytest.approx(1.0)
+    assert out["AP75"] == pytest.approx(0.0)
+
+
+def test_duplicate_detection_is_fp():
+    # two detections on the same GT: greedy matches the higher-scored one,
+    # the duplicate is a FP → AP = interpolated 1.0@r<=1 but precision
+    # envelope [1.0, 0.5]: AP = mean over recall grid = 1.0 (max precision
+    # at every achieved recall is 1.0 since TP comes first)
+    g = [gt([[0, 0, 10, 10]], [0])]
+    d = [det([[0, 0, 10, 10], [0, 0, 10, 10]], [0.9, 0.8], [0, 0])]
+    out = evaluate_detections(d, g, num_classes=1)
+    assert out["mAP"] == pytest.approx(1.0)
+
+
+def test_missed_gt_caps_recall():
+    # 2 GT, 1 perfect detection: recall caps at 0.5 → 101-point AP ≈ 51/101
+    g = [gt([[0, 0, 10, 10], [20, 20, 30, 30]], [0, 0])]
+    d = [det([[0, 0, 10, 10]], [0.9], [0])]
+    out = evaluate_detections(d, g, num_classes=1)
+    assert out["mAP"] == pytest.approx(51 / 101)
+
+
+def test_class_without_gt_excluded():
+    g = [gt([[0, 0, 10, 10]], [0])]
+    d = [det([[0, 0, 10, 10]], [0.9], [0])]
+    out = evaluate_detections(d, g, num_classes=3)
+    assert np.isnan(out["per_class"][1]) and np.isnan(out["per_class"][2])
+    assert out["mAP"] == pytest.approx(1.0)  # mean over classes WITH gt
+
+
+def test_multi_image_accumulation():
+    # class 0: perfect on image 0, missed on image 1 (recall 0.5 with no FP)
+    g = [gt([[0, 0, 10, 10]], [0]), gt([[0, 0, 10, 10]], [0])]
+    d = [det([[0, 0, 10, 10]], [0.9], [0]), det(np.zeros((0, 4)), [], [])]
+    out = evaluate_detections(d, g, num_classes=1)
+    assert out["mAP"] == pytest.approx(51 / 101)
+
+
+def test_max_dets_cap():
+    g = [gt([[0, 0, 10, 10]], [0])]
+    boxes = np.tile([[50, 50, 60, 60]], (150, 1))
+    boxes[-1] = [0, 0, 10, 10]
+    scores = np.linspace(0.9, 0.5, 150)
+    scores[-1] = 0.99  # the TP has the best score: survives the cap
+    d = [det(boxes, scores, np.zeros(150, np.int32))]
+    out = evaluate_detections(d, g, num_classes=1, max_dets=100)
+    assert out["AP50"] == pytest.approx(1.0)
+
+
+def test_length_mismatch_raises():
+    with pytest.raises(ValueError):
+        evaluate_detections([], [gt(np.zeros((0, 4)), [])], 1)

@@ -11,8 +11,10 @@ from tpu_syncbn.utils.metrics import (
     profiler_trace,
     step_timer,
 )
+from tpu_syncbn.utils.coco_map import evaluate_detections
 
 __all__ = [
+    "evaluate_detections",
     "save_checkpoint",
     "load_checkpoint",
     "available_steps",
