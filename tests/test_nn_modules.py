@@ -303,9 +303,10 @@ def test_classmethod_forwards_group_size():
     assert isinstance(out, tnn.SyncBatchNorm) and out.group_size == 4
 
 
-def test_grouped_sync_single_collective():
-    """Grouped SyncBN emits exactly ONE all-gather (fused triple) and no
-    full-world all-reduce."""
+def test_grouped_sync_butterfly_collectives():
+    """Power-of-two grouped SyncBN lowers to the ppermute butterfly:
+    log2(group) CollectivePermutes of the fused stat triple — NO
+    full-world all-gather and NO full-world all-reduce."""
     import re
 
     mesh = runtime.data_parallel_mesh()
@@ -321,4 +322,8 @@ def test_grouped_sync_single_collective():
     hlo = f.lower(state, jnp.asarray(rand_x(17))).compile().as_text()
     # count by op type (instruction names vary: %all-gather vs %all_gather.7)
     n_ag = len(re.findall(r" all-gather(?:-start)?\(", hlo))
-    assert n_ag == 1, f"expected 1 fused all-gather, got {n_ag}"
+    n_cp = len(re.findall(r" collective-permute(?:-start)?\(", hlo))
+    n_ar = len(re.findall(r" all-reduce(?:-start)?\(", hlo))
+    assert n_ag == 0, f"expected no all-gather, got {n_ag}"
+    assert n_cp == 2, f"expected log2(4)=2 collective-permutes, got {n_cp}"
+    assert n_ar == 0, f"expected no full-world all-reduce, got {n_ar}"

@@ -151,9 +151,16 @@ def psum_in_groups(
     axis — the TPU form of torch's ``process_group`` scoping (e.g. SyncBN
     synced within a node rather than the whole world).
 
-    shard_map doesn't support psum's ``axis_index_groups`` (jax 0.9), so
-    this gathers the per-replica values and sums this replica's group
-    slice — fine for the small per-channel stat vectors it exists for.
+    ``lax.psum(axis_index_groups=...)`` is unimplemented under shard_map's
+    VMA checker (jax 0.9: the type system cannot express a group-varying
+    reduce result), so a power-of-two ``group_size`` uses a
+    recursive-doubling butterfly of ``ppermute``s — O(payload · log g)
+    traffic, VMA-legal, CollectivePermute HLOs that XLA schedules over the
+    direct ICI neighbor links the contiguous groups sit on. Other group
+    sizes fall back to one full-world all_gather + group slice
+    (O(payload · world) — fine for the 2C+1-float stat vectors this
+    serves). Either way the whole tree moves as ONE fused payload,
+    keeping the "one collective per BN layer" property.
     """
     world = lax.axis_size(axis_name)
     if group_size < 1 or world % group_size:
@@ -162,16 +169,28 @@ def psum_in_groups(
         )
     if group_size == world:
         return lax.psum(tree, axis_name)
-    group_start = (lax.axis_index(axis_name) // group_size) * group_size
 
-    # ONE collective for the whole tree: flatten leaves into a single
-    # vector, all_gather once, group-slice, sum, split back (keeps the
-    # "one fused collective per BN layer" property of the full-world path).
+    # one fused payload for the whole tree
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     flat = jnp.concatenate([jnp.ravel(l).astype(jnp.float32) for l in leaves])
-    g = lax.all_gather(flat, axis_name, axis=0)  # (world, total)
-    mine = lax.dynamic_slice_in_dim(g, group_start, group_size, axis=0)
-    summed = mine.sum(axis=0)
+
+    if group_size & (group_size - 1) == 0:
+        # butterfly: partner = own index XOR 2^k within the group
+        step = 1
+        while step < group_size:
+            perm = [
+                (i, (i // group_size) * group_size + ((i % group_size) ^ step))
+                for i in range(world)
+            ]
+            flat = flat + lax.ppermute(flat, axis_name, perm)
+            step *= 2
+        summed = flat
+    else:
+        group_start = (lax.axis_index(axis_name) // group_size) * group_size
+        g = lax.all_gather(flat, axis_name, axis=0)  # (world, total)
+        mine = lax.dynamic_slice_in_dim(g, group_start, group_size, axis=0)
+        summed = mine.sum(axis=0)
+
     out = []
     offset = 0
     for l in leaves:
