@@ -345,3 +345,67 @@ def test_vma_unvarying_grad_transpose_pinned():
     # BOTH (autodiff through unvarying + explicit psum/pmean) would
     # double-count by exactly the world size
     np.testing.assert_allclose(np.asarray(g_local_sum), np.asarray(x.sum()))
+
+
+def test_auto_buffer_broadcast_skips_wasted_allreduce():
+    """broadcast_buffers='auto' on a fully-converted (SyncBN) model skips
+    the per-step DDP buffer broadcast: fewer all-reduces in the compiled
+    step than broadcast_buffers=True, and bit-identical training math."""
+    import re
+
+    batch = (
+        jnp.asarray(np.random.RandomState(3).randn(GLOBAL_BATCH, 8, 8, 3),
+                    jnp.float32),
+        jnp.asarray(np.random.RandomState(4).randint(
+            0, NUM_CLASSES, GLOBAL_BATCH), jnp.int32),
+    )
+
+    def build(mode):
+        m = tnn.convert_sync_batchnorm(SmallCNN(nnx.Rngs(0)))
+        return parallel.DataParallel(
+            m, optax.sgd(0.05), ce_loss, broadcast_buffers=mode, donate=False
+        )
+
+    def n_allreduce(dp):
+        hlo = dp.lowered_train_step(batch).compile().as_text()
+        return len(re.findall(r" all-reduce(?:-start)?\(", hlo))
+
+    dp_auto, dp_bcast = build("auto"), build(True)
+    assert not dp_auto._per_step_broadcast
+    assert dp_bcast._per_step_broadcast
+    n_auto, n_bcast = n_allreduce(dp_auto), n_allreduce(dp_bcast)
+    assert n_auto < n_bcast, (n_auto, n_bcast)
+
+    out_a = dp_auto.train_step(batch)
+    out_b = dp_bcast.train_step(batch)
+    np.testing.assert_allclose(float(out_a.loss), float(out_b.loss), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        ),
+        dp_auto.params, dp_bcast.params,
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        ),
+        dp_auto.rest, dp_bcast.rest,
+    )
+
+
+def test_auto_buffer_broadcast_keeps_broadcast_for_plain_bn():
+    m = _BNOnly()  # plain BatchNorm: stats are NOT replicated-safe
+    dp = parallel.DataParallel(
+        m, optax.sgd(0.05),
+        lambda mo, b: jnp.mean(mo(b[0]) ** 2), broadcast_buffers="auto",
+        donate=False,
+    )
+    assert dp._per_step_broadcast
+
+
+def test_broadcast_buffers_rejects_bad_value():
+    with pytest.raises(ValueError, match="broadcast_buffers"):
+        parallel.DataParallel(
+            SmallCNN(nnx.Rngs(0)), optax.sgd(0.1), ce_loss,
+            broadcast_buffers="sometimes",
+        )

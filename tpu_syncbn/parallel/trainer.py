@@ -75,6 +75,37 @@ def sync_module_states(model: nnx.Module, src: int = 0) -> None:
     nnx.update(model, state)
 
 
+def _stats_replicated_by_construction(model: nnx.Module) -> bool:
+    """True when every non-Param Variable in the model is owned by a
+    full-world SyncBatchNorm: such stats are computed from psum'd global
+    moments, hence bit-identical on every replica — a per-step buffer
+    broadcast would be a pure waste of ICI bandwidth.
+
+    Conservative on purpose: the per-step broadcast legalizes ALL of the
+    ``rest`` state (anything non-Param), so any leaf whose owner is not a
+    full-world SyncBatchNorm — group-scoped SyncBN, plain BN, RNG state,
+    custom mutable Variables, stats nested in containers — keeps DDP's
+    broadcast-from-replica-0."""
+    from tpu_syncbn.nn.normalization import SyncBatchNorm
+
+    modules: dict[tuple, nnx.Module] = {}
+    var_paths: list[tuple] = []
+    for path, node in nnx.iter_graph(model):
+        if isinstance(node, nnx.Module):
+            modules[tuple(path)] = node
+        elif isinstance(node, nnx.Variable) and not isinstance(node, nnx.Param):
+            var_paths.append(tuple(path))
+    for vpath in var_paths:
+        owner = None
+        for k in range(len(vpath), -1, -1):
+            if vpath[:k] in modules:
+                owner = modules[vpath[:k]]
+                break
+        if not isinstance(owner, SyncBatchNorm) or owner.group_size is not None:
+            return False
+    return True
+
+
 def _pcast_varying(tree, axis: str):
     """Idempotently cast every leaf to device-varying over ``axis`` (pcast
     raises on an already-varying input, and BN state mixes both: SyncBN
@@ -122,10 +153,16 @@ class DataParallel:
     accumulation and ONE cross-replica grad reduction at the end
     (``[torch] nn/parallel/distributed.py:1659``).
 
-    ``broadcast_buffers`` (default True, DDP's default ``:793``): BatchStat
-    buffers are broadcast from replica 0 inside the step, keeping plain-BN
-    buffers replicated exactly as DDP does per forward. With SyncBN the
-    stats are already identical, and XLA folds the no-op broadcast.
+    ``broadcast_buffers`` (default ``"auto"``): ``True`` broadcasts
+    BatchStat buffers from replica 0 inside every step (DDP's default
+    ``forward_sync_buffers``, ``:793``), keeping plain-BN buffers
+    replicated exactly as DDP does; ``False`` stores buffers honestly
+    per-replica. ``"auto"`` detects the converted-model case — every
+    stat-owning module a full-world SyncBatchNorm, whose stats are
+    already identical on all replicas by construction — and skips the
+    per-step broadcast there (XLA cannot fold a value-dependent no-op
+    all-reduce, so on hardware the DDP-parity broadcast is a real
+    per-step cost), broadcasting otherwise.
     """
 
     def __init__(
@@ -136,7 +173,7 @@ class DataParallel:
         *,
         mesh: Mesh | None = None,
         axis_name: str = DATA_AXIS,
-        broadcast_buffers: bool = True,
+        broadcast_buffers: bool | str = "auto",
         accum_steps: int = 1,
         donate: bool = True,
         remat: bool = False,
@@ -158,6 +195,11 @@ class DataParallel:
             raise ValueError(
                 f"grad_compression must be None or 'bf16', got {grad_compression!r}"
             )
+        if broadcast_buffers not in (True, False, "auto"):
+            raise ValueError(
+                "broadcast_buffers must be True, False, or 'auto', got "
+                f"{broadcast_buffers!r}"
+            )
         self.remat = remat
         self.grad_compression = grad_compression
         self._model = model
@@ -166,6 +208,15 @@ class DataParallel:
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.accum_steps = accum_steps
+        if broadcast_buffers == "auto":
+            # replicated storage either way; skip the per-step broadcast
+            # when the stats are replicated by construction
+            self._per_step_broadcast = not _stats_replicated_by_construction(
+                model
+            )
+            broadcast_buffers = True
+        else:
+            self._per_step_broadcast = bool(broadcast_buffers)
         self.broadcast_buffers = broadcast_buffers
 
         self.graphdef, params, rest = nnx.split(model, nnx.Param, ...)
@@ -265,11 +316,15 @@ class DataParallel:
 
                 # scan carries must keep a stable VMA type: local grads are
                 # device-varying, and BN stats flip between unvarying
-                # (SyncBN: psum'd) and varying (plain BN) — pin both
-                # carries to varying and let the post-scan broadcast/pmean
-                # restore replication
+                # (SyncBN: psum'd) and varying (plain BN). Pin the grad
+                # accumulator to varying always; pin the buffer carry to
+                # varying only when a post-scan broadcast (or per-replica
+                # out-spec) will legalize it — in the skip-broadcast mode
+                # the stats stay unvarying through every iteration.
                 def to_varying(tree):
                     return _pcast_varying(tree, axis)
+
+                pin_rest = self._per_step_broadcast or not self.broadcast_buffers
 
                 def body(carry, mb):
                     rest, acc = carry
@@ -277,12 +332,13 @@ class DataParallel:
                         params, rest, mb
                     )
                     acc = jax.tree_util.tree_map(jnp.add, acc, grads)
-                    return (to_varying(rest), acc), (loss, metrics)
+                    rest = to_varying(rest) if pin_rest else rest
+                    return (rest, acc), (loss, metrics)
 
                 zero = to_varying(
                     jax.tree_util.tree_map(jnp.zeros_like, params)
                 )
-                rest = to_varying(rest)
+                rest = to_varying(rest) if pin_rest else rest
                 (rest, grads), (losses, metricses) = jax.lax.scan(
                     body, (rest, zero), micro
                 )
@@ -312,8 +368,13 @@ class DataParallel:
             params = optax.apply_updates(params, updates)
 
             if self.broadcast_buffers:
-                # per-step buffer broadcast (DDP forward_sync_buffers :793)
-                rest = collectives.broadcast(rest, src=0, axis_name=axis)
+                if self._per_step_broadcast:
+                    # per-step buffer broadcast (DDP forward_sync_buffers
+                    # :793)
+                    rest = collectives.broadcast(rest, src=0, axis_name=axis)
+                # else: full-world SyncBN stats are replicated by
+                # construction (psum'd moments) — already unvarying, and
+                # an explicit broadcast would be a wasted all-reduce
             else:
                 # re-stack for honest per-replica storage (P(axis) output:
                 # declare varying even when SyncBN stats are replicated)
