@@ -130,9 +130,14 @@ def main():
     if args.ckpt_dir:
         utils.save_checkpoint(args.ckpt_dir, it, dp.state_dict())
 
-    # full eval: decode + per-class NMS per image, then COCO-style
-    # AP@[.5:.95] over the (rank-local) eval split — the BASELINE mAP
-    # harness (self-contained; pycocotools is unavailable here)
+    # master-only eval (the rank-0 convention, README.md:9): decode +
+    # per-class NMS per image, then COCO-style AP@[.5:.95] over the first
+    # n_eval images — the BASELINE mAP harness (self-contained;
+    # pycocotools is unavailable here). Sanity eval on the train images;
+    # point --coco-annotations at a val split for a held-out number.
+    if not runtime.is_master():
+        runtime.barrier("eval")
+        return
     m = dp.sync_to_model()
     m.eval()
     n_eval = min(len(ds), args.eval_images)
@@ -160,6 +165,7 @@ def main():
         f"mAP@[.5:.95] {ap['mAP']:.4f}  AP50 {ap['AP50']:.4f}  "
         f"AP75 {ap['AP75']:.4f}"
     )
+    runtime.barrier("eval")  # release the non-master hosts
 
 
 if __name__ == "__main__":

@@ -131,11 +131,17 @@ def evaluate_detections(
             is_tp = np.concatenate(tps) if tps else np.zeros(0, bool)
             ap[ti, c] = _ap_from_matches(all_scores, is_tp, num_gt)
 
-    with np.errstate(invalid="ignore"):
+    import warnings
+
+    with warnings.catch_warnings():
+        # all-NaN columns (classes with no GT) are expected and excluded;
+        # silence nanmean's "Mean of empty slice"
+        warnings.simplefilter("ignore", category=RuntimeWarning)
         per_class = np.nanmean(ap, axis=0)
         valid = ~np.isnan(ap)
         m_ap = float(np.nanmean(ap)) if valid.any() else 0.0
-        ap50 = float(np.nanmean(ap[0])) if valid[0].any() else 0.0
+        i50 = int(np.argmin(np.abs(iou_thresholds - 0.50)))
         i75 = int(np.argmin(np.abs(iou_thresholds - 0.75)))
+        ap50 = float(np.nanmean(ap[i50])) if valid[i50].any() else 0.0
         ap75 = float(np.nanmean(ap[i75])) if valid[i75].any() else 0.0
     return {"mAP": m_ap, "AP50": ap50, "AP75": ap75, "per_class": per_class}
