@@ -285,3 +285,215 @@ def test_staged_iter_early_exit_and_error_propagation():
     # yielded arrays are writable (like every other loader path)
     out = next(tdata.staged_iter(iter(tdata.DataLoader(ds, batch_size=4))))
     out[0][0, 0, 0, 0] = 42.0
+
+
+# -- process workers (the reference's literal worker model) ---------------
+
+
+class _FailAt:
+    def __init__(self, bad_idx):
+        self.bad = bad_idx
+
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        if i == self.bad:
+            raise ValueError("boom at 7")
+        return np.full((3,), i, np.float32), np.int32(i)
+
+
+def _init_raises(wid):
+    raise RuntimeError("bad init")
+
+
+def test_process_loader_matches_sequential_order():
+    xs = np.arange(24, dtype=np.float32).reshape(12, 2)
+    ys = np.arange(12, dtype=np.int64)
+    ds = tdata.ArrayDataset(xs, ys)
+    seq = list(tdata.DataLoader(ds, batch_size=4))
+    proc = list(
+        tdata.DataLoader(ds, batch_size=4, num_workers=2,
+                         worker_type="process")
+    )
+    assert len(seq) == len(proc) == 3
+    for (sx, sy), (px, py) in zip(seq, proc):
+        np.testing.assert_array_equal(sx, px)
+        np.testing.assert_array_equal(sy, py)
+
+
+def test_process_loader_propagates_worker_error():
+    loader = tdata.DataLoader(
+        _FailAt(7), batch_size=4, num_workers=2, worker_type="process"
+    )
+    with pytest.raises(tdata.WorkerError, match="boom at 7"):
+        list(loader)
+
+
+def test_process_loader_worker_init_error():
+    ds = tdata.ArrayDataset(np.zeros((8, 2), np.float32))
+    loader = tdata.DataLoader(
+        ds, batch_size=2, num_workers=1, worker_type="process",
+        worker_init_fn=_init_raises,
+    )
+    with pytest.raises(tdata.WorkerError, match="bad init"):
+        list(loader)
+
+
+def test_worker_type_validation():
+    ds = tdata.ArrayDataset(np.zeros((4, 2), np.float32))
+    with pytest.raises(ValueError, match="worker_type"):
+        tdata.DataLoader(ds, batch_size=2, worker_type="greenlet")
+
+
+def test_transforms_are_picklable_for_process_workers():
+    import pickle
+
+    T = tdata.transforms
+    tf = T.Compose([
+        T.RandomResizedCrop(8, seed=0),
+        T.RandomHorizontalFlip(seed=1),
+        T.ToFloat(),
+        T.Normalize((0.5,) * 3, (0.25,) * 3),
+    ])
+    tf2 = pickle.loads(pickle.dumps(tf))
+    x = np.random.RandomState(0).randint(0, 256, (16, 16, 3), np.uint8)
+    out = tf2(x)
+    assert out.shape == (8, 8, 3) and out.dtype == np.float32
+
+
+class _TaggedDS:
+    """__getitem__ returns a worker-settable tag — proves worker_init_fn
+    reaches the worker's OWN dataset copy via get_worker_info()."""
+
+    def __init__(self):
+        self.tag = -1
+
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        return np.int32(self.tag)
+
+
+def _tag_with_worker_id(wid):
+    info = tdata.get_worker_info()
+    assert info is not None and info.id == wid
+    info.dataset.tag = wid + 100
+
+
+def test_process_worker_init_reaches_worker_dataset_copy():
+    ds = _TaggedDS()
+    loader = tdata.DataLoader(
+        ds, batch_size=2, num_workers=2, worker_type="process",
+        worker_init_fn=_tag_with_worker_id,
+    )
+    vals = np.concatenate([b for b in loader])
+    # batches alternate between the two workers' tags, round-robin
+    np.testing.assert_array_equal(vals, [100, 100, 101, 101, 100, 100, 101, 101])
+    assert ds.tag == -1  # parent copy untouched
+    loader.close()
+
+
+def test_process_workers_persist_across_epochs():
+    xs = np.arange(16, dtype=np.float32).reshape(8, 2)
+    ds = tdata.ArrayDataset(xs)
+    loader = tdata.DataLoader(ds, batch_size=2, num_workers=2,
+                              worker_type="process")
+    first = [b.copy() for b in loader]
+    procs = loader._pool["procs"]
+    second = [b.copy() for b in loader]
+    assert loader._pool["procs"] is procs  # no respawn
+    assert all(p.is_alive() for p in procs)
+    for a, b in zip(first, second):
+        np.testing.assert_array_equal(a, b)
+    loader.close()
+    assert loader._pool is None
+
+
+def test_process_loader_abandoned_epoch_does_not_leak():
+    xs = np.arange(32, dtype=np.float32).reshape(16, 2)
+    ds = tdata.ArrayDataset(xs)
+    loader = tdata.DataLoader(ds, batch_size=2, num_workers=2,
+                              worker_type="process")
+    it = iter(loader)
+    next(it)  # abandon mid-epoch
+    del it
+    full = list(loader)  # stale epoch-1 outputs must be dropped
+    assert len(full) == 8
+    np.testing.assert_array_equal(full[0], xs[:2])
+    np.testing.assert_array_equal(full[-1], xs[14:])
+    loader.close()
+
+
+def test_get_worker_info_none_in_main_process():
+    assert tdata.get_worker_info() is None
+
+
+def test_process_loader_rejects_concurrent_iterators():
+    ds = tdata.ArrayDataset(np.arange(16, dtype=np.float32).reshape(8, 2))
+    loader = tdata.DataLoader(ds, batch_size=2, num_workers=1,
+                              worker_type="process")
+    it1 = iter(loader)
+    next(it1)
+    with pytest.raises(RuntimeError, match="ONE active iterator"):
+        next(iter(loader))
+    it1.close()
+    assert len(list(loader)) == 4  # usable again after the first is closed
+    loader.close()
+
+
+class _CropValueDS:
+    """Returns the crop of a fixed ramp image — output depends entirely on
+    the transform's RNG draw, making decorrelation observable."""
+
+    def __init__(self):
+        T = tdata.transforms
+        self.transform = T.Compose([T.RandomCrop(4, padding=0, seed=0)])
+        self.image = np.arange(16 * 16 * 1, dtype=np.float32).reshape(16, 16, 1)
+
+    def __len__(self):
+        return 4
+
+    def __getitem__(self, i):
+        return self.transform(self.image)
+
+
+def _reseed_by_worker(wid):
+    tdata.get_worker_info().dataset.transform.reseed(1000 + wid)
+
+
+def test_compose_reseed_decorrelates_process_workers():
+    ds = _CropValueDS()
+    loader = tdata.DataLoader(
+        ds, batch_size=1, num_workers=2, worker_type="process",
+        worker_init_fn=_reseed_by_worker,
+    )
+    crops1 = [b.copy() for b in loader]
+    # workers 0 and 1 (alternating batches) draw from different streams
+    assert not np.array_equal(crops1[0], crops1[1])
+    # and the reseeded streams are deterministic across fresh pools
+    loader.close()
+    loader2 = tdata.DataLoader(
+        ds, batch_size=1, num_workers=2, worker_type="process",
+        worker_init_fn=_reseed_by_worker,
+    )
+    crops2 = [b.copy() for b in loader2]
+    for a, b in zip(crops1, crops2):
+        np.testing.assert_array_equal(a, b)
+    loader2.close()
+
+
+def test_compose_reseed_is_deterministic_in_process():
+    T = tdata.transforms
+    x = np.random.RandomState(0).randint(0, 256, (16, 16, 3), np.uint8)
+    tf = T.Compose([T.RandomResizedCrop(8, seed=5), T.RandomHorizontalFlip(seed=6)])
+    tf.reseed(42)
+    a = [tf(x) for _ in range(3)]
+    tf.reseed(42)
+    b = [tf(x) for _ in range(3)]
+    for u, v in zip(a, b):
+        np.testing.assert_array_equal(u, v)
+    tf.reseed(43)
+    c = [tf(x) for _ in range(3)]
+    assert any(not np.array_equal(u, w) for u, w in zip(a, c))

@@ -15,7 +15,15 @@ from tpu_syncbn.data.dataset import (
     SyntheticImageDataset,
     load_cifar10,
 )
-from tpu_syncbn.data.loader import DataLoader, default_collate, device_prefetch, staged_iter
+from tpu_syncbn.data.loader import (
+    DataLoader,
+    WorkerError,
+    WorkerInfo,
+    get_worker_info,
+    default_collate,
+    device_prefetch,
+    staged_iter,
+)
 from tpu_syncbn.data import transforms
 from tpu_syncbn.data.detection import (
     SyntheticDetectionDataset,
@@ -25,6 +33,9 @@ from tpu_syncbn.data.detection import (
 from tpu_syncbn.data.image_folder import ImageFolderDataset, decode_image
 
 __all__ = [
+    "WorkerError",
+    "WorkerInfo",
+    "get_worker_info",
     "ImageFolderDataset",
     "decode_image",
     "SyntheticDetectionDataset",

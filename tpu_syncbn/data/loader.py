@@ -30,6 +30,144 @@ from tpu_syncbn.data.dataset import Dataset
 from tpu_syncbn.data.sampler import Sampler, SequentialSampler
 
 
+class WorkerError(RuntimeError):
+    """A dataset/collate error raised inside a worker process, carrying
+    the worker's traceback text."""
+
+
+class WorkerInfo:
+    """What :func:`get_worker_info` returns inside a worker process —
+    torch's ``get_worker_info()`` contract. ``dataset`` is the worker's
+    OWN (unpickled) copy: mutate/reseed THIS object in a
+    ``worker_init_fn``; any transform object captured in the init fn's
+    closure would be an unrelated third pickle copy."""
+
+    def __init__(self, id: int, num_workers: int, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info: WorkerInfo | None = None
+
+
+def get_worker_info() -> WorkerInfo | None:
+    """Inside a process worker: this worker's :class:`WorkerInfo`; in the
+    main process (or thread workers, which share objects): ``None``."""
+    return _worker_info
+
+
+# Worker wire protocol, shared by thread and process paths:
+#   index queue:  ("batch", epoch, seq, idxs) | ("epoch_end", epoch) |
+#                 ("stop",)
+#   out queue:    ("ok", epoch, seq, batch) | ("err", epoch, seq, err) |
+#                 ("epoch_end", epoch) | ("init_err", traceback_text)
+# Threads use epoch=0 throughout (workers die with the iterator, so no
+# staleness); persistent process workers tag everything with the live
+# epoch so batches from an abandoned iteration are dropped, not yielded.
+
+
+def _persistent_process_worker(
+    wid, num_workers, dataset, collate_fn, worker_init_fn, index_q, out_q
+):
+    """Top-level (spawn-picklable) body for ``worker_type="process"``
+    workers. Lives across epochs: ``epoch_end`` is echoed and the loop
+    continues; only ``stop`` (or parent exit — daemon) ends it."""
+    import traceback
+
+    global _worker_info
+    _worker_info = WorkerInfo(id=wid, num_workers=num_workers, dataset=dataset)
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(wid)
+    except Exception:
+        out_q.put(("init_err", traceback.format_exc()))
+        return
+    while True:
+        item = index_q.get()
+        tag = item[0]
+        if tag == "stop":
+            return
+        if tag == "epoch_end":
+            out_q.put(("epoch_end", item[1]))
+            continue
+        _, epoch, seq, idxs = item
+        try:
+            out_q.put(("ok", epoch, seq,
+                       collate_fn([dataset[i] for i in idxs])))
+        except Exception:
+            out_q.put(("err", epoch, seq, traceback.format_exc()))
+
+
+def _bounded_put(q, item, stop: threading.Event) -> bool:
+    """put() that gives up when the consumer abandoned the iterator, so
+    no producer can block forever on a full queue no one will drain."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.05)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _consume_ordered(out_queues, dispatch_error, *, epoch=0, idle_check=None):
+    """Yield batches in dispatch order from per-worker out queues (batch
+    ``seq`` was dispatched to worker ``seq % n`` round-robin, so reading
+    the queues round-robin restores global order). ``idle_check(wid)``
+    may return a final drained item or raise for a dead worker."""
+    n = len(out_queues)
+    done = [False] * n
+    seq = 0
+    while not all(done):
+        wid = seq % n
+        if done[wid]:
+            seq += 1
+            continue
+        try:
+            item = out_queues[wid].get(timeout=0.05)
+        except queue.Empty:
+            if dispatch_error:
+                raise dispatch_error[0]
+            item = idle_check(wid) if idle_check is not None else None
+            if item is None:
+                continue
+        tag = item[0]
+        if tag == "init_err":
+            raise WorkerError(f"worker {wid} init failed:\n{item[1]}")
+        if item[1] != epoch:
+            continue  # stale output from an abandoned iteration: drop
+        if tag == "epoch_end":
+            done[wid] = True
+            seq += 1
+            continue
+        _, _, got_seq, payload = item
+        assert got_seq == seq, f"order violation: {got_seq} != {seq}"
+        if tag == "err":
+            if isinstance(payload, BaseException):
+                raise payload  # thread worker: original exception object
+            raise WorkerError(f"error in worker {wid}:\n{payload}")
+        yield payload
+        seq += 1
+
+
+def _close_pool(pool) -> None:
+    """Terminate a process-worker pool (GC finalizer / explicit close)."""
+    for q in pool["index_queues"]:
+        try:
+            q.put_nowait(("stop",))
+        except queue.Full:
+            pass
+    for p in pool["procs"]:
+        p.join(timeout=0.5)
+        if p.is_alive():
+            p.terminate()
+            p.join(timeout=5)
+    for q in (*pool["index_queues"], *pool["out_queues"]):
+        q.cancel_join_thread()
+        q.close()
+
+
 def default_collate(samples: Sequence[Any]):
     """Stack a list of samples into batched numpy arrays (mirrors torch's
     default_collate for array/tuple/dict/scalar structures)."""
@@ -46,10 +184,27 @@ def default_collate(samples: Sequence[Any]):
 class DataLoader:
     """Iterates batches of collated samples.
 
-    ``num_workers`` threads run ``dataset[i]`` concurrently (numpy decode
-    and IO release the GIL); batch order is deterministic — identical to
-    the single-threaded order — because workers fill a slot-addressed
-    reorder window, not a free-for-all queue.
+    ``num_workers`` workers run ``dataset[i]`` concurrently; batch order
+    is deterministic — identical to the single-threaded order — because
+    workers fill a slot-addressed reorder window, not a free-for-all
+    queue.
+
+    ``worker_type`` selects the concurrency model. ``"thread"`` (default)
+    matches TPU-host reality: PIL's JPEG decode and numpy's transforms
+    release the GIL, so threads parallelize the real work without
+    process-spawn or pickling overhead. ``"process"`` is the reference's
+    literal model (8 worker *processes*, ``README.md:87``) for
+    Python-heavy, GIL-bound per-sample work: the dataset and collate_fn
+    must be picklable, workers are spawned ONCE per loader and persist
+    across epochs (each worker owns a frozen pickle-copy of the dataset
+    — parent-side mutations after the first iteration are not seen), and
+    ``worker_init_fn(worker_id)`` (torch's ``worker_init_fn``) runs once
+    per worker — reseed per-worker augmentation RNGs there via
+    ``get_worker_info().dataset``, which is the worker's own copy.
+    ``close()`` (or GC) shuts the pool down. Spawn's standard contract
+    applies (as for torch's workers on spawn platforms): the training
+    script's ``__main__`` must be importable — guard entry with
+    ``if __name__ == "__main__":`` and don't drive from a REPL/stdin.
     """
 
     def __init__(
@@ -62,11 +217,17 @@ class DataLoader:
         drop_last: bool = False,
         collate_fn: Callable = default_collate,
         prefetch_batches: int = 2,
+        worker_type: str = "thread",
+        worker_init_fn: Callable[[int], None] | None = None,
     ):
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
         if num_workers < 0:
             raise ValueError("num_workers must be >= 0")
+        if worker_type not in ("thread", "process"):
+            raise ValueError(
+                f"worker_type must be 'thread' or 'process', got {worker_type!r}"
+            )
         self.dataset = dataset
         self.batch_size = batch_size
         self.sampler = sampler if sampler is not None else SequentialSampler(len(dataset))
@@ -74,6 +235,12 @@ class DataLoader:
         self.drop_last = drop_last
         self.collate_fn = collate_fn
         self.prefetch_batches = max(1, prefetch_batches)
+        self.worker_type = worker_type
+        self.worker_init_fn = worker_init_fn
+        self._pool: dict | None = None
+        self._pool_finalizer = None
+        self._epoch = 0
+        self._iterating = False
 
     def _batches_of_indices(self) -> Iterator[list[int]]:
         batch: list[int] = []
@@ -96,16 +263,134 @@ class DataLoader:
             for idxs in self._batches_of_indices():
                 yield self.collate_fn([self.dataset[i] for i in idxs])
             return
+        if self.worker_type == "process":
+            yield from self._iter_processes()
+            return
         yield from self._iter_threaded()
+
+    def _start_dispatcher(self, index_queues, stop, epoch):
+        """Feed (epoch, seq)-tagged index batches round-robin, then an
+        epoch_end marker per worker. Returns the error box the consumer
+        polls (a user sampler raising mid-iteration must surface, not
+        hang the loop)."""
+        dispatch_error: list[BaseException] = []
+
+        def run():
+            seq = 0
+            try:
+                for idxs in self._batches_of_indices():
+                    q = index_queues[seq % len(index_queues)]
+                    if not _bounded_put(q, ("batch", epoch, seq, idxs), stop):
+                        return
+                    seq += 1
+            except BaseException as e:
+                dispatch_error.append(e)
+                return
+            for q in index_queues:
+                if not _bounded_put(q, ("epoch_end", epoch), stop):
+                    return
+
+        threading.Thread(target=run, daemon=True).start()
+        return dispatch_error
+
+    # -- process workers ---------------------------------------------------
+
+    def _ensure_pool(self) -> dict:
+        """Spawn the persistent worker processes once per loader: spawn
+        (fork is unsafe once jax's thread pools exist) re-imports the
+        interpreter per worker, so paying it per epoch would stall every
+        epoch boundary. Workers live until close()/GC."""
+        if self._pool is not None:
+            return self._pool
+        import multiprocessing as mp
+        import weakref
+
+        ctx = mp.get_context("spawn")
+        n = self.num_workers
+        pool = {
+            "index_queues": [
+                ctx.Queue(maxsize=self.prefetch_batches) for _ in range(n)
+            ],
+            "out_queues": [
+                ctx.Queue(maxsize=self.prefetch_batches) for _ in range(n)
+            ],
+        }
+        pool["procs"] = [
+            ctx.Process(
+                target=_persistent_process_worker,
+                args=(w, n, self.dataset, self.collate_fn,
+                      self.worker_init_fn,
+                      pool["index_queues"][w], pool["out_queues"][w]),
+                daemon=True,
+            )
+            for w in range(n)
+        ]
+        for p in pool["procs"]:
+            p.start()
+        self._pool = pool
+        self._pool_finalizer = weakref.finalize(self, _close_pool, pool)
+        return pool
+
+    def close(self) -> None:
+        """Shut down persistent process workers (no-op otherwise)."""
+        if self._pool is not None:
+            self._pool_finalizer.detach()
+            _close_pool(self._pool)
+            self._pool = None
+
+    def _iter_processes(self):
+        """The reference's worker-process model (``README.md:87``): same
+        slot-addressed reorder pipeline as the threaded path, over the
+        persistent spawn pool; epoch tags keep outputs of an abandoned
+        iteration from leaking into the next."""
+        if self._iterating:
+            # concurrent iterators would share the pool's queues under
+            # different epoch tags and silently starve each other — the
+            # thread path supports this (fresh queues per iterator), the
+            # persistent pool cannot; fail loudly instead of hanging
+            raise RuntimeError(
+                "a process-mode DataLoader supports ONE active iterator; "
+                "exhaust or abandon the previous iteration first (or use "
+                "worker_type='thread' for concurrent iterators)"
+            )
+        pool = self._ensure_pool()
+        self._epoch += 1
+        epoch = self._epoch
+        self._iterating = True
+        stop = threading.Event()
+        dispatch_error = self._start_dispatcher(
+            pool["index_queues"], stop, epoch
+        )
+
+        def idle_check(wid):
+            if not pool["procs"][wid].is_alive():
+                try:
+                    # the worker's final items can still be in the pipe
+                    # when the process exits — drain before declaring death
+                    return pool["out_queues"][wid].get_nowait()
+                except queue.Empty:
+                    raise WorkerError(
+                        f"worker process {wid} died (exit code "
+                        f"{pool['procs'][wid].exitcode}) without reporting"
+                    ) from None
+            return None
+
+        try:
+            yield from _consume_ordered(
+                pool["out_queues"], dispatch_error,
+                epoch=epoch, idle_check=idle_check,
+            )
+        finally:
+            stop.set()
+            self._iterating = False
+
+    # -- thread workers ----------------------------------------------------
 
     def _iter_threaded(self):
         """Ordered pipeline: a dispatcher assigns batch slots round-robin;
         each worker collates its own batches; the consumer reassembles in
         slot order so output order matches the sequential loader."""
         n_workers = self.num_workers
-        # Per-worker index queues: batch seq goes to worker seq % n_workers,
-        # so each worker's output queue is in global-order for its stride
-        # and the consumer can reassemble deterministically.
         index_queues = [
             queue.Queue(maxsize=self.prefetch_batches) for _ in range(n_workers)
         ]
@@ -113,7 +398,6 @@ class DataLoader:
             queue.Queue(maxsize=self.prefetch_batches) for _ in range(n_workers)
         ]
         stop = threading.Event()
-        SENTINEL = None
 
         def worker(wid: int):
             while True:
@@ -123,84 +407,30 @@ class DataLoader:
                     if stop.is_set():
                         return
                     continue
-                if item is SENTINEL:
-                    _put_checking_stop(out_queues[wid], SENTINEL)
-                    return
-                seq, idxs = item
+                if item[0] == "epoch_end":
+                    _bounded_put(out_queues[wid], ("epoch_end", 0), stop)
+                    return  # thread workers are per-iteration
+                _, _, seq, idxs = item
                 try:
-                    batch = self.collate_fn([self.dataset[i] for i in idxs])
-                except Exception as e:  # propagate to consumer
-                    batch = e
-                if not _put_checking_stop(out_queues[wid], (seq, batch)):
-                    return
-
-        def _put_checking_stop(q, item) -> bool:
-            """put() that gives up when the consumer abandoned the
-            iterator (stop set), so the dispatcher can never block forever
-            on a full queue no one will drain."""
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.05)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
-        dispatch_error: list[BaseException] = []
-
-        def dispatcher():
-            seq = 0
-            try:
-                for idxs in self._batches_of_indices():
-                    if not _put_checking_stop(
-                        index_queues[seq % n_workers], (seq, idxs)
-                    ):
-                        return
-                    seq += 1
-            except BaseException as e:  # user sampler raised mid-iteration:
-                # surface it to the consumer instead of hanging the loop
-                dispatch_error.append(e)
-                return
-            for q in index_queues:
-                if not _put_checking_stop(q, SENTINEL):
+                    out = (
+                        "ok", 0, seq,
+                        self.collate_fn([self.dataset[i] for i in idxs]),
+                    )
+                except Exception as e:  # same-process: keep the object
+                    out = ("err", 0, seq, e)
+                if not _bounded_put(out_queues[wid], out, stop):
                     return
 
         threads = [
             threading.Thread(target=worker, args=(w,), daemon=True)
             for w in range(n_workers)
         ]
-        disp = threading.Thread(target=dispatcher, daemon=True)
         for t in threads:
             t.start()
-        disp.start()
+        dispatch_error = self._start_dispatcher(index_queues, stop, epoch=0)
 
         try:
-            # Batch `seq` was dispatched to worker `seq % n_workers`
-            # round-robin (queue.put order == dispatch order per worker),
-            # so reading worker queues round-robin restores global order.
-            done = [False] * n_workers
-            seq = 0
-            while not all(done):
-                wid = seq % n_workers
-                if done[wid]:
-                    seq += 1
-                    continue
-                try:
-                    item = out_queues[wid].get(timeout=0.05)
-                except queue.Empty:
-                    if dispatch_error:
-                        raise dispatch_error[0]
-                    continue
-                if item is SENTINEL:
-                    done[wid] = True
-                    seq += 1
-                    continue
-                got_seq, batch = item
-                assert got_seq == seq, f"order violation: {got_seq} != {seq}"
-                if isinstance(batch, Exception):
-                    raise batch
-                yield batch
-                seq += 1
+            yield from _consume_ordered(out_queues, dispatch_error, epoch=0)
         finally:
             stop.set()
             # drain so workers blocked on put() can exit (the dispatcher's

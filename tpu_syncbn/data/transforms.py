@@ -23,7 +23,13 @@ import numpy as np
 
 
 class _Draws:
-    """Lock-protected RandomState shared safely across loader threads."""
+    """Lock-protected RandomState shared safely across loader threads.
+
+    Picklable (for process workers): the lock is dropped and recreated;
+    the RNG state pickles with numpy. Each worker process then owns a
+    COPY of the generator — reseed via ``DataLoader(worker_init_fn=...)``
+    if per-worker decorrelated augmentation draws matter (same caveat as
+    torch's per-worker seeding)."""
 
     def __init__(self, rng: np.random.RandomState | None, seed: int | None):
         if rng is not None:
@@ -31,6 +37,17 @@ class _Draws:
         else:
             self._rng = np.random.RandomState(seed)  # None → OS entropy
         self._lock = threading.Lock()
+
+    def __getstate__(self):
+        return {"_rng": self._rng}
+
+    def __setstate__(self, state):
+        self._rng = state["_rng"]
+        self._lock = threading.Lock()
+
+    def reseed(self, seed: int) -> None:
+        with self._lock:
+            self._rng = np.random.RandomState(seed)
 
     def rand(self) -> float:
         with self._lock:
@@ -54,11 +71,27 @@ class Compose:
             x = t(x)
         return x
 
+    def reseed(self, seed: int) -> None:
+        """Reseed every random child transform with a seed derived from
+        ``seed`` and its position — THE public hook for per-worker
+        augmentation decorrelation in a process-worker ``worker_init_fn``
+        (each spawn worker inherits an identical pickled RNG state)::
+
+            def init(wid):
+                tdata.get_worker_info().dataset.transform.reseed(1000 + wid)
+        """
+        for i, t in enumerate(self.transforms):
+            if hasattr(t, "reseed"):
+                t.reseed(seed * 1_000_003 + i)
+
 
 class RandomHorizontalFlip:
     def __init__(self, p: float = 0.5, *, rng=None, seed: int | None = None):
         self.p = p
         self._draws = _Draws(rng, seed)
+
+    def reseed(self, seed: int) -> None:
+        self._draws.reseed(seed)
 
     def __call__(self, x):
         if self._draws.rand() < self.p:
@@ -78,6 +111,9 @@ class RandomCrop:
         self.padding = padding
         self.padding_mode = padding_mode
         self._draws = _Draws(rng, seed)
+
+    def reseed(self, seed: int) -> None:
+        self._draws.reseed(seed)
 
     def __call__(self, x):
         p = self.padding
@@ -107,6 +143,9 @@ class RandomResizedCrop:
         self.ratio = ratio
         self.interpolation = interpolation
         self._draws = _Draws(rng, seed)
+
+    def reseed(self, seed: int) -> None:
+        self._draws.reseed(seed)
 
     def __call__(self, x):
         h, w = x.shape[:2]
