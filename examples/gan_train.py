@@ -59,7 +59,21 @@ def main():
 
     ds = None
     if args.data_root:
+        # CIFAR pickle dir, else any real-JPEG ImageFolder tree scaled to
+        # 32x32 in [-1, 1] (the generator's tanh range)
         ds = tdata.load_cifar10(args.data_root, train=True)
+        if ds is None:
+            T = tdata.transforms
+            ds = tdata.ImageFolderDataset(
+                args.data_root,
+                T.Compose([
+                    T.ResizeShortestEdge(32),
+                    T.CenterCrop(32),
+                    T.ToFloat(),
+                    T.Normalize((0.5,) * 3, (0.5,) * 3),
+                ]),
+            )
+            log.info("ImageFolder: %d real images", len(ds))
     if ds is None:
         ds = tdata.SyntheticImageDataset(length=2048, shape=(32, 32, 3))
     sampler = tdata.DistributedSampler(

@@ -256,3 +256,35 @@ def test_psum_in_groups_tree_payload_fused():
     assert out["a"].shape == (8, 2, 2) and out["b"].shape == (8,)
     np.testing.assert_allclose(np.asarray(out["a"]), 2.0)
     np.testing.assert_allclose(np.asarray(out["b"]), 4.0)
+
+
+def test_ring_all_reduce_matches_psum():
+    """The explicit ppermute ring (reduce-scatter + all-gather phases)
+    computes exactly lax.psum — pins the ring algebra the NCCL-equivalent
+    path and ring-style long-context algorithms build on."""
+    mesh = runtime.data_parallel_mesh()
+    rng = np.random.RandomState(0)
+    # deliberately NOT divisible by 8: exercises the padding path
+    x = jnp.asarray(rng.randn(8, 13).astype(np.float32))
+
+    def body(xs):
+        return collectives.ring_all_reduce(xs, "data"), jax.lax.psum(xs, "data")
+
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("data"),),
+                          out_specs=(P("data"), P("data"))))
+    ring, ref = f(x)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(ref), rtol=1e-5)
+
+
+def test_ring_all_reduce_hlo_is_collective_permutes():
+    import re
+
+    mesh = runtime.data_parallel_mesh()
+    x = jnp.ones((8, 16), jnp.float32)
+    f = jax.jit(shard_map(lambda xs: collectives.ring_all_reduce(xs, "data"),
+                          mesh=mesh, in_specs=(P("data"),),
+                          out_specs=P("data")))
+    hlo = f.lower(x).compile().as_text()
+    assert len(re.findall(r" all-reduce(?:-start)?\(", hlo)) == 0
+    n_cp = len(re.findall(r" collective-permute(?:-start)?\(", hlo))
+    assert n_cp == 14, n_cp  # 2*(N-1) hops for N=8

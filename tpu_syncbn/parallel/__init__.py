@@ -17,6 +17,7 @@ from tpu_syncbn.parallel.collectives import (
     reduce_scatter,
     reduce_moments,
     psum_in_groups,
+    ring_all_reduce,
 )
 
 __all__ = [
@@ -38,4 +39,5 @@ __all__ = [
     "reduce_scatter",
     "reduce_moments",
     "psum_in_groups",
+    "ring_all_reduce",
 ]

@@ -200,6 +200,58 @@ def psum_in_groups(
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def ring_all_reduce(
+    x: jax.Array, axis_name: str = DATA_AXIS
+) -> jax.Array:
+    """Bandwidth-optimal ring all-reduce built from ``ppermute`` steps —
+    the explicit form of what NCCL's ring kernels (reference ``'nccl'``
+    backend, ``README.md:31``) and XLA's AllReduce do internally.
+
+    reduce-scatter phase: N-1 neighbor hops, each accumulating one 1/N
+    chunk; all-gather phase: N-1 hops circulating the finished chunks.
+    Total traffic per device: 2·(N-1)/N · payload — the ring optimum.
+
+    ``lax.psum`` (one AllReduce HLO that XLA schedules over ICI) is the
+    production path; this exists to (a) pin the ring algebra with tests,
+    (b) serve as the template for ring-style long-context algorithms
+    (ring attention passes KV blocks around the same neighbor cycle
+    while overlapping compute — SURVEY §5.7's extension point).
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    orig_shape = x.shape
+    flat = jnp.ravel(x)
+    pad = (-flat.size) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    chunks = flat.reshape(n, -1)
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    me = lax.axis_index(axis_name)
+
+    # reduce-scatter: at step s device ``me`` receives the partial sum of
+    # chunk (me - s) from its left neighbor and adds its own copy; after
+    # N-1 steps it owns the complete sum of chunk (me + 1) % n
+    acc = jnp.take(chunks, me, axis=0)
+    for s in range(1, n):
+        acc = lax.ppermute(acc, axis_name, fwd)
+        acc = acc + jnp.take(chunks, (me - s) % n, axis=0)
+    # all-gather: circulate each finished chunk around the ring
+    gathered = [acc]
+    cur = acc
+    for _ in range(n - 1):
+        cur = lax.ppermute(cur, axis_name, fwd)
+        gathered.append(cur)
+    # device me received chunk (me - s + 1) % n at gather step s; restore
+    # index order: out[j] = gathered[(me + 1 - j) % n]
+    order = jnp.stack(gathered)  # (n, chunk)
+    idx = (me + 1 - jnp.arange(n)) % n
+    out = jnp.take(order, idx, axis=0).reshape(-1)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(orig_shape)
+
+
 def reduce_moments(
     local_sum: jax.Array,
     local_sumsq: jax.Array,
