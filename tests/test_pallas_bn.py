@@ -198,3 +198,100 @@ def test_fused_batch_norm_stat_grad_fails_loudly():
         return y.sum()
 
     jax.grad(loss_through_y)(x)  # y-only gradient still works
+
+
+def test_trainer_with_pallas_kernels_matches_xla_path():
+    """The exact combination the TPU runs: DataParallel tracing the Pallas
+    BN path (check_vma auto-disabled — interpret-mode kernel bodies mix
+    unvarying scratch with varying blocks). Must compile, train, and match
+    the XLA-fusion trainer step numerically."""
+    import optax
+    from flax import nnx
+
+    from tpu_syncbn import models, nn, parallel
+    from tpu_syncbn.ops import batch_norm as xops
+
+    def build():
+        m = nn.convert_sync_batchnorm(
+            models.resnet18(num_classes=10, small_input=True,
+                            rngs=nnx.Rngs(0))
+        )
+
+        def loss_fn(mo, batch):
+            xs, ys = batch
+            import optax as _o
+            return _o.softmax_cross_entropy_with_integer_labels(
+                mo(xs), ys
+            ).mean()
+
+        return parallel.DataParallel(m, optax.sgd(0.1), loss_fn, donate=False)
+
+    rng = np.random.RandomState(0)
+    batch = (
+        jnp.asarray(rng.randn(16, 8, 8, 3).astype(np.float32)),
+        jnp.asarray(rng.randint(0, 10, 16).astype(np.int32)),
+    )
+
+    mode_before = xops._PALLAS_MODE
+    try:
+        xops.set_pallas_mode("on")
+        dp_pallas = build()
+        assert not dp_pallas._check_vma  # pallas ⇒ checker off
+        out_p = dp_pallas.train_step(batch)
+        # the XLA oracle is forced explicitly (ambient mode could be
+        # pallas-active on a TPU host or under TPU_SYNCBN_PALLAS=on)
+        xops.set_pallas_mode("off")
+        dp_xla = build()
+        assert dp_xla._check_vma
+        out_x = dp_xla.train_step(batch)
+    finally:
+        xops.set_pallas_mode(mode_before)
+
+    np.testing.assert_allclose(
+        float(out_p.loss), float(out_x.loss), rtol=1e-5
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5
+        ),
+        dp_pallas.params, dp_xla.params,
+    )
+
+
+def test_group_scoped_model_keeps_vma_checker_under_pallas_mode():
+    """Finer gating: with pallas mode ON but a group-scoped model (which
+    the BN fast path rejects), only XLA traces — the VMA checker must
+    stay enabled and the step must run."""
+    import optax
+    from flax import nnx
+
+    from tpu_syncbn import models, nn, parallel
+    from tpu_syncbn.ops import batch_norm as xops
+
+    mode_before = xops._PALLAS_MODE
+    try:
+        xops.set_pallas_mode("on")
+        m = nn.convert_sync_batchnorm(
+            models.resnet18(num_classes=10, small_input=True,
+                            rngs=nnx.Rngs(0)),
+            group_size=2,
+        )
+
+        def loss_fn(mo, batch):
+            import optax as _o
+            xs, ys = batch
+            return _o.softmax_cross_entropy_with_integer_labels(
+                mo(xs), ys
+            ).mean()
+
+        dp = parallel.DataParallel(m, optax.sgd(0.1), loss_fn, donate=False)
+        assert dp._check_vma  # pallas can't trace for this model
+        rng = np.random.RandomState(0)
+        batch = (
+            jnp.asarray(rng.randn(16, 8, 8, 3).astype(np.float32)),
+            jnp.asarray(rng.randint(0, 10, 16).astype(np.int32)),
+        )
+        out = dp.train_step(batch)
+        assert np.isfinite(float(out.loss))
+    finally:
+        xops.set_pallas_mode(mode_before)

@@ -43,9 +43,11 @@ def set_pallas_mode(mode: str) -> None:
     elsewhere), 'on' (always Pallas; interpret mode off-TPU), 'off'
     (always the XLA-fusion path).
 
-    Read at *trace* time: steps already jit-compiled keep the backend they
-    were traced with — call this before building the trainer / first call,
-    or clear jax caches to re-trace.
+    Read at *trace* time for direct functional calls; the trainers
+    (``DataParallel``/``GANTrainer``) additionally snapshot the
+    kernel-backend decision (and the matching VMA-checker setting) at
+    **construction** — call this BEFORE building a trainer. Steps already
+    jit-compiled keep the backend they were traced with.
     """
     global _PALLAS_MODE
     if mode not in ("auto", "on", "off"):

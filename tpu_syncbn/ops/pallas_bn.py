@@ -49,6 +49,16 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _sds(shape, dtype, like: jax.Array):
+    """ShapeDtypeStruct whose varying-axes type matches ``like``: inside a
+    ``check_vma=True`` shard_map (the trainer default), pallas_call
+    outputs must declare their vma explicitly or lowering fails."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _as_2d(x: jax.Array) -> tuple[jax.Array, int]:
     """Collapse all non-channel axes of a channel-last array into rows."""
     c = x.shape[-1]
@@ -111,8 +121,8 @@ def _stats_2d(x2: jax.Array, c: int) -> tuple[jax.Array, jax.Array]:
             pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((1, c), jnp.float32),
-            jax.ShapeDtypeStruct((1, c), jnp.float32),
+            _sds((1, c), jnp.float32, x2),
+            _sds((1, c), jnp.float32, x2),
         ],
         scratch_shapes=[pltpu.VMEM((2, c), jnp.float32)],
         interpret=_interpret(),
@@ -163,7 +173,7 @@ def _normalize_2d(x2p, scale, shift, c, out_dtype):
         out_specs=pl.BlockSpec(
             (_BLOCK_M, c), lambda i: (i, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct(x2p.shape, out_dtype),
+        out_shape=_sds(x2p.shape, out_dtype, x2p),
         interpret=_interpret(),
     )(x2p, scale[None], shift[None])
 
@@ -216,8 +226,8 @@ def bn_backward_reduce(
             pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((1, c), jnp.float32),
-            jax.ShapeDtypeStruct((1, c), jnp.float32),
+            _sds((1, c), jnp.float32, dy2),
+            _sds((1, c), jnp.float32, dy2),
         ],
         scratch_shapes=[pltpu.VMEM((2, c), jnp.float32)],
         interpret=_interpret(),

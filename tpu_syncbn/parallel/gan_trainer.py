@@ -75,6 +75,15 @@ class GANTrainer:
         self.g_opt = g_optimizer
         self.d_opt = d_optimizer
 
+        from tpu_syncbn.parallel.trainer import _model_traces_pallas_bn
+
+        # same contract as DataParallel: checker on unless pallas traces
+        # for either network (snapshotted at construction)
+        self._check_vma = not (
+            _model_traces_pallas_bn(generator)
+            or _model_traces_pallas_bn(discriminator)
+        )
+
         self.g_def, g_params, g_rest = nnx.split(generator, nnx.Param, ...)
         self.d_def, d_params, d_rest = nnx.split(discriminator, nnx.Param, ...)
         self.g_opt_state = g_optimizer.init(g_params)
@@ -114,10 +123,9 @@ class GANTrainer:
             # varying-cast OUTSIDE the VJP so grads stay local and the
             # explicit pmean is the one aggregation (see trainer.py's
             # _microbatch_grads for the VMA transpose root cause)
+            dp_in = _pcast_varying(dp_, axis) if self._check_vma else dp_
             (d_loss, (gr, dr, real_logits, fake_logits)), d_grads = (
-                jax.value_and_grad(d_loss_fn, has_aux=True)(
-                    _pcast_varying(dp_, axis), gr, dr
-                )
+                jax.value_and_grad(d_loss_fn, has_aux=True)(dp_in, gr, dr)
             )
             d_grads = collectives.pmean(d_grads, axis)
             d_updates, od = self.d_opt.update(d_grads, od, dp_)
@@ -136,9 +144,10 @@ class GANTrainer:
                 _, g_loss = loss_pair(jnp.zeros_like(fake_logits), fake_logits)
                 return g_loss, (gr_out, dr_out)
 
+            gp_in = _pcast_varying(gp, axis) if self._check_vma else gp
             (g_loss, (gr, dr)), g_grads = jax.value_and_grad(
                 g_loss_fn, has_aux=True
-            )(_pcast_varying(gp, axis), gr, dr)
+            )(gp_in, gr, dr)
             g_grads = collectives.pmean(g_grads, axis)
             g_updates, og = self.g_opt.update(g_grads, og, gp)
             gp = optax.apply_updates(gp, g_updates)
@@ -163,7 +172,7 @@ class GANTrainer:
             in_specs=(P(), P(), P(), P(), P(), P(),
                       P(self.axis_name), P(self.axis_name), P(self.axis_name)),
             out_specs=(P(),) * 6 + (P(), P(), P()),
-            check_vma=True,
+            check_vma=self._check_vma,
         )
         donate_argnums = tuple(range(6)) if donate else ()
         return jax.jit(sharded, donate_argnums=donate_argnums)
@@ -222,7 +231,7 @@ class GANTrainer:
                     gen, mesh=self.mesh,
                     in_specs=(P(), P(), P(self.axis_name)),
                     out_specs=P(self.axis_name),
-                    check_vma=True,
+                    check_vma=self._check_vma,
                 )
             )
         world = int(self.mesh.shape[self.axis_name])

@@ -75,6 +75,27 @@ def sync_module_states(model: nnx.Module, src: int = 0) -> None:
     nnx.update(model, state)
 
 
+def _model_traces_pallas_bn(model: nnx.Module) -> bool:
+    """Will compiling a step over ``model`` actually trace the Pallas BN
+    kernels? True only when the global mode selects Pallas AND the model
+    contains a channel-last, ungrouped BatchNorm (the fast-path gate in
+    ops/batch_norm.py) — so e.g. group-scoped or channel-first models
+    keep the VMA checker even on TPU."""
+    from tpu_syncbn.nn.normalization import BatchNorm
+    from tpu_syncbn.ops import batch_norm as bn_ops
+
+    if not bn_ops._use_pallas():
+        return False
+    for _, node in nnx.iter_graph(model):
+        if (
+            isinstance(node, BatchNorm)
+            and node.channel_axis == -1
+            and node.group_size is None
+        ):
+            return True
+    return False
+
+
 def _stats_replicated_by_construction(model: nnx.Module) -> bool:
     """True when every non-Param Variable in the model is owned by a
     full-world SyncBatchNorm: such stats are computed from psum'd global
@@ -218,6 +239,14 @@ class DataParallel:
         else:
             self._per_step_broadcast = bool(broadcast_buffers)
         self.broadcast_buffers = broadcast_buffers
+        # VMA checker on, EXCEPT when the Pallas BN kernels will trace
+        # for THIS model: pallas kernel bodies mix unvarying scratch refs
+        # with varying input blocks, which the checker rejects (pinned by
+        # the pallas test suite). With the checker off, replication is
+        # guaranteed structurally, exactly as in round 1. Snapshotted at
+        # construction — set_pallas_mode() must be called before building
+        # the trainer (its docstring says so).
+        self._check_vma = not _model_traces_pallas_bn(model)
 
         self.graphdef, params, rest = nnx.split(model, nnx.Param, ...)
         self.params = params
@@ -280,7 +309,9 @@ class DataParallel:
         # discrepancy of round 1). With the cast outside the VJP, grads
         # stay local and the explicit pmean is the one aggregation —
         # DDP's semantics, and check_vma=True validates the whole step.
-        params = _pcast_varying(params, self.axis_name)
+        # (With the checker off — pallas mode — grads are local anyway.)
+        if self._check_vma:
+            params = _pcast_varying(params, self.axis_name)
         (loss, (metrics, new_rest)), grads = jax.value_and_grad(
             lossed, has_aux=True
         )(params, rest, batch)
@@ -321,8 +352,12 @@ class DataParallel:
                 # varying only when a post-scan broadcast (or per-replica
                 # out-spec) will legalize it — in the skip-broadcast mode
                 # the stats stay unvarying through every iteration.
-                def to_varying(tree):
-                    return _pcast_varying(tree, axis)
+                if self._check_vma:
+                    def to_varying(tree):
+                        return _pcast_varying(tree, axis)
+                else:
+                    def to_varying(tree):
+                        return tree
 
                 pin_rest = self._per_step_broadcast or not self.broadcast_buffers
 
@@ -378,9 +413,9 @@ class DataParallel:
             else:
                 # re-stack for honest per-replica storage (P(axis) output:
                 # declare varying even when SyncBN stats are replicated)
-                rest = jax.tree_util.tree_map(
-                    lambda x: x[None], _pcast_varying(rest, axis)
-                )
+                if self._check_vma:
+                    rest = _pcast_varying(rest, axis)
+                rest = jax.tree_util.tree_map(lambda x: x[None], rest)
             return params, rest, opt_state, loss, metrics
 
         sharded = shard_map(
@@ -388,11 +423,12 @@ class DataParallel:
             mesh=self.mesh,
             in_specs=(P(), self._rest_spec, P(), P(self.axis_name)),
             out_specs=(P(), self._rest_spec, P(), P(), P()),
-            # VMA checker ON: validates that params/opt_state/loss really
-            # are replicated after the step. Requires the explicit
-            # varying-cast of params in _microbatch_grads — see the
-            # comment there for the round-1 "8x off" root cause.
-            check_vma=True,
+            # VMA checker ON (unless pallas traces — see __init__):
+            # validates that params/opt_state/loss really are replicated
+            # after the step. Requires the explicit varying-cast of params
+            # in _microbatch_grads — see the comment there for the
+            # round-1 "8x off" root cause.
+            check_vma=self._check_vma,
         )
         donate_argnums = (0, 1, 2) if donate else ()
         return jax.jit(sharded, donate_argnums=donate_argnums)
@@ -414,7 +450,7 @@ class DataParallel:
             mesh=self.mesh,
             in_specs=(P(), self._rest_spec, P(self.axis_name)),
             out_specs=(P(), P()),
-            check_vma=True,
+            check_vma=self._check_vma,
         )
         return jax.jit(sharded)
 
