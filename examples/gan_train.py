@@ -64,16 +64,22 @@ def main():
         ds = tdata.load_cifar10(args.data_root, train=True)
         if ds is None:
             T = tdata.transforms
-            ds = tdata.ImageFolderDataset(
-                args.data_root,
-                T.Compose([
-                    T.ResizeShortestEdge(32),
-                    T.CenterCrop(32),
-                    T.ToFloat(),
-                    T.Normalize((0.5,) * 3, (0.5,) * 3),
-                ]),
-            )
-            log.info("ImageFolder: %d real images", len(ds))
+            try:
+                ds = tdata.ImageFolderDataset(
+                    args.data_root,
+                    T.Compose([
+                        T.ResizeShortestEdge(32),
+                        T.CenterCrop(32),
+                        T.ToFloat(),
+                        T.Normalize((0.5,) * 3, (0.5,) * 3),
+                    ]),
+                )
+                log.info("ImageFolder: %d real images", len(ds))
+            except FileNotFoundError as e:
+                log.warning(
+                    "--data-root %r is neither a CIFAR pickle dir nor an "
+                    "image tree (%s); using synthetic data", args.data_root, e
+                )
     if ds is None:
         ds = tdata.SyntheticImageDataset(length=2048, shape=(32, 32, 3))
     sampler = tdata.DistributedSampler(

@@ -106,3 +106,55 @@ def test_max_dets_cap():
 def test_length_mismatch_raises():
     with pytest.raises(ValueError):
         evaluate_detections([], [gt(np.zeros((0, 4)), [])], 1)
+
+
+def test_map_randomized_properties():
+    """Property fuzz: for random detection/GT sets, AP stays in [0,1],
+    is invariant to image order and global coordinate scaling, and never
+    improves when extra low-scored false positives are appended."""
+    rng = np.random.RandomState(0)
+    for trial in range(10):
+        n_img, K = rng.randint(1, 5), rng.randint(1, 4)
+        gts, ds = [], []
+        for _ in range(n_img):
+            ng = rng.randint(0, 5)
+            gb = np.sort(rng.uniform(0, 50, (ng, 4)).astype(np.float32), -1)
+            gc = rng.randint(0, K, ng).astype(np.int32)
+            gts.append((gb, gc))
+            nd = rng.randint(0, 6)
+            db = np.sort(rng.uniform(0, 50, (nd, 4)).astype(np.float32), -1)
+            # mix: some detections copy a GT box (hits), some are noise
+            for j in range(nd):
+                if ng and rng.rand() < 0.5:
+                    db[j] = gb[rng.randint(ng)]
+            ds.append((db, rng.rand(nd).astype(np.float32),
+                       rng.randint(0, K, nd).astype(np.int32)))
+
+        out = evaluate_detections(ds, gts, num_classes=K)
+        assert 0.0 <= out["mAP"] <= 1.0
+        assert 0.0 <= out["AP50"] <= 1.0
+
+        # image-order invariance
+        perm = rng.permutation(n_img)
+        out_p = evaluate_detections([ds[i] for i in perm],
+                                    [gts[i] for i in perm], num_classes=K)
+        assert out_p["mAP"] == pytest.approx(out["mAP"], abs=1e-9)
+
+        # coordinate-scale invariance (IoU is scale-free)
+        scale = float(rng.uniform(0.5, 3.0))
+        ds_s = [(b * scale, s, c) for b, s, c in ds]
+        gts_s = [(b * scale, c) for b, c in gts]
+        out_s = evaluate_detections(ds_s, gts_s, num_classes=K)
+        assert out_s["mAP"] == pytest.approx(out["mAP"], abs=1e-9)
+
+        # extra low-scored junk never raises AP
+        ds_junk = []
+        for b, s, c in ds:
+            jb = np.sort(rng.uniform(60, 90, (2, 4)).astype(np.float32), -1)
+            ds_junk.append((
+                np.concatenate([b, jb]),
+                np.concatenate([s, np.full(2, 1e-4, np.float32)]),
+                np.concatenate([c, rng.randint(0, K, 2).astype(np.int32)]),
+            ))
+        out_j = evaluate_detections(ds_junk, gts, num_classes=K)
+        assert out_j["mAP"] <= out["mAP"] + 1e-9
