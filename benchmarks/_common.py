@@ -6,17 +6,25 @@ import os
 import sys
 
 
-def setup(simulate: int | None) -> None:
+def setup(simulate: int | None, *, needs_backend: bool = True) -> None:
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
     if simulate:
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
             + f" --xla_force_host_platform_device_count={simulate}"
         ).strip()
         os.environ["JAX_PLATFORMS"] = "cpu"
-    sys.path.insert(
-        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    )
-    if simulate:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    elif needs_backend:
+        # no simulation requested: the accelerator is the target, but a
+        # registered-but-dead TPU plugin HANGS jax.devices() — probe it
+        # out-of-process and fall back to CPU when unusable. Benchmarks
+        # that never touch a jax backend pass needs_backend=False and
+        # skip the probe cost entirely.
+        from tpu_syncbn.runtime import probe
+
+        probe.ensure_backend(1)

@@ -35,7 +35,7 @@ def parse_args():
 
 def main():
     args = parse_args()
-    setup(None)
+    setup(None, needs_backend=False)  # pure PIL/numpy: no jax backend
 
     import numpy as np
     from PIL import Image

@@ -1,0 +1,132 @@
+"""Tune the Pallas BN kernels' row-block size on real hardware.
+
+``_BLOCK_M`` (rows per grid step) was chosen analytically in round 1 and
+has never been validated on a chip. This sweep times the three kernels
+(stats, normalize, backward-reduce) and the full fused_batch_norm
+fwd+bwd at ResNet-50-representative (M, C) shapes across candidate block
+sizes, and prints a JSON recommendation. Run ON TPU (on CPU it measures
+interpret-mode overhead, which is meaningless — the script refuses
+unless --allow-cpu).
+
+    python benchmarks/pallas_block_sweep.py [--blocks 128 256 512 1024]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+from _common import setup
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--blocks", type=int, nargs="+",
+                   default=[128, 256, 512, 1024])
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--allow-cpu", action="store_true")
+    p.add_argument("--max-rows", type=int, default=None,
+                   help="clip each shape's M (CPU smoke runs: interpret "
+                        "mode at full R50 sizes is impractical)")
+    p.add_argument("--simulate", type=int, default=None)
+    return p.parse_args()
+
+
+# (M, C) pairs a ResNet-50 step actually runs BN over (per-chip batch 64,
+# 224px): M = N*H*W per stage, C per stage
+R50_SHAPES = [
+    (64 * 56 * 56, 64),
+    (64 * 56 * 56, 256),
+    (64 * 28 * 28, 512),
+    (64 * 14 * 14, 1024),
+    (64 * 7 * 7, 2048),
+]
+
+
+def main():
+    args = parse_args()
+    setup(args.simulate)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_syncbn.ops import pallas_bn
+
+    if jax.default_backend() != "tpu" and not args.allow_cpu:
+        print(json.dumps({
+            "metric": "pallas_block_sweep",
+            "skipped": "requires a TPU backend (interpret-mode timings "
+                       "are meaningless); pass --allow-cpu to force",
+            "backend": jax.default_backend(),
+        }))
+        sys.exit(0)
+
+    shapes = R50_SHAPES
+    if args.max_rows:
+        shapes = [(min(m, args.max_rows), c) for m, c in shapes]
+
+    default_block = pallas_bn._BLOCK_M
+    blocks = list(args.blocks)
+    if default_block not in blocks:
+        blocks.append(default_block)  # the baseline must be measured
+
+    rng = np.random.RandomState(0)
+    results: dict[int, float] = {}
+    failures: dict[str, str] = {}
+    try:
+        for block in blocks:
+            pallas_bn._BLOCK_M = block
+            jax.clear_caches()  # _BLOCK_M is baked into traced kernels
+            total = 0.0
+            ok = True
+            for m, c in shapes:
+                x = jnp.asarray(rng.randn(m, c).astype(np.float32) * 0.5)
+                w = jnp.ones((c,), jnp.float32)
+                b = jnp.zeros((c,), jnp.float32)
+                coeff = jnp.asarray(rng.randn(m, c).astype(np.float32))
+
+                def loss(x):
+                    y, _, _, _ = pallas_bn.fused_batch_norm(
+                        x, w, b, 1e-5, None
+                    )
+                    return jnp.sum(y * coeff)
+
+                g = jax.jit(jax.grad(loss))
+                try:
+                    g(x).block_until_ready()  # compile + warm
+                except Exception as e:  # e.g. VMEM overflow at big blocks
+                    failures[f"{block}@({m},{c})"] = (
+                        f"{type(e).__name__}: {e}"[:200]
+                    )
+                    ok = False
+                    break
+                t0 = time.perf_counter()
+                for _ in range(args.iters):
+                    out = g(x)
+                out.block_until_ready()
+                total += (time.perf_counter() - t0) / args.iters
+            if ok:
+                results[block] = round(total * 1e3, 3)
+    finally:
+        pallas_bn._BLOCK_M = default_block
+
+    best = min(results, key=results.get) if results else None
+    print(json.dumps({
+        "metric": "pallas_block_sweep",
+        "unit": "ms (sum of fused fwd+bwd over R50 BN shapes)",
+        "backend": jax.default_backend(),
+        "by_block": {str(k): v for k, v in results.items()},
+        "failures": failures,
+        "best_block": best,
+        "current_default": default_block,
+        "speedup_vs_default": (
+            round(results[default_block] / results[best], 3)
+            if best is not None and default_block in results
+            else None
+        ),
+    }))
+
+
+if __name__ == "__main__":
+    main()
