@@ -409,3 +409,39 @@ def test_broadcast_buffers_rejects_bad_value():
             SmallCNN(nnx.Rngs(0)), optax.sgd(0.1), ce_loss,
             broadcast_buffers="sometimes",
         )
+
+
+def test_dp_composes_with_2d_mesh():
+    """The mesh-ready extension-point claim (docs/DESIGN.md §8): the DP
+    trainer works unchanged when the mesh has an extra (model) axis it
+    doesn't use — params replicate over both axes, batch shards over
+    "data" only, and the step matches the 1-D-mesh result."""
+    from jax.sharding import Mesh
+
+    devs = np.asarray(jax.devices()).reshape(4, 2)
+    mesh2d = Mesh(devs, ("data", "model"))
+    mesh1d = Mesh(np.asarray(jax.devices()[:4]), ("data",))
+
+    rng = np.random.RandomState(0)
+    batch = (
+        jnp.asarray(rng.randn(8, 8, 8, 3).astype(np.float32)),
+        jnp.asarray(rng.randint(0, NUM_CLASSES, 8).astype(np.int32)),
+    )
+
+    def build(mesh):
+        m = tnn.convert_sync_batchnorm(SmallCNN(nnx.Rngs(0)))
+        return parallel.DataParallel(
+            m, optax.sgd(0.05), ce_loss, mesh=mesh, donate=False
+        )
+
+    dp2 = build(mesh2d)
+    out2 = dp2.train_step(jax.device_put(batch, dp2.batch_sharding))
+    dp1 = build(mesh1d)
+    out1 = dp1.train_step(jax.device_put(batch, dp1.batch_sharding))
+    np.testing.assert_allclose(float(out2.loss), float(out1.loss), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        dp2.params, dp1.params,
+    )
