@@ -11,7 +11,11 @@ accelerator is unusable or too small.
 
 Env overrides:
   ``TPU_SYNCBN_FORCE_CPU=1``      skip the probe, force the CPU platform
-  ``TPU_SYNCBN_PROBE_TIMEOUT=s``  probe timeout in seconds (default 75)
+  ``TPU_SYNCBN_PROBE_TIMEOUT=s``  probe timeout in seconds (default 150:
+                                  a live-but-contended tunnel can need
+                                  >75s to claim the chip, while the dead
+                                  case still leaves room for the CPU
+                                  fallback inside a driver budget)
 """
 
 from __future__ import annotations
@@ -63,7 +67,7 @@ def probe_backend(timeout: Optional[float] = None) -> Optional[BackendInfo]:
 
 def _probe_uncached(timeout: Optional[float]) -> Optional[BackendInfo]:
     if timeout is None:
-        timeout = float(os.environ.get("TPU_SYNCBN_PROBE_TIMEOUT", "75"))
+        timeout = float(os.environ.get("TPU_SYNCBN_PROBE_TIMEOUT", "150"))
     try:
         proc = subprocess.run(
             [sys.executable, "-c", _PROBE_CODE],
