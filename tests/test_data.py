@@ -497,3 +497,37 @@ def test_compose_reseed_is_deterministic_in_process():
     tf.reseed(43)
     c = [tf(x) for _ in range(3)]
     assert any(not np.array_equal(u, w) for u, w in zip(a, c))
+
+
+@pytest.mark.slow
+def test_loader_stress_no_deadlock():
+    """Stress the reorder/staleness machinery: random full/partial/
+    abandoned iterations over both worker types must neither hang nor
+    produce out-of-order batches (pytest-level timeout = the harness)."""
+    xs = np.arange(48, dtype=np.float32).reshape(24, 2)
+    ds = tdata.ArrayDataset(xs)
+    rng = np.random.RandomState(0)
+
+    thread_loader = tdata.DataLoader(ds, batch_size=3, num_workers=3)
+    proc_loader = tdata.DataLoader(ds, batch_size=3, num_workers=2,
+                                   worker_type="process")
+    try:
+        for trial in range(30):
+            loader = proc_loader if trial % 2 else thread_loader
+            take = rng.randint(0, 9)  # 8 full batches per epoch
+            it = iter(loader)
+            got = []
+            for _ in range(take):
+                try:
+                    got.append(next(it))
+                except StopIteration:
+                    break
+            it.close()  # abandon (or finish) the iteration
+            for i, b in enumerate(got):
+                np.testing.assert_array_equal(b, xs[i * 3:(i + 1) * 3])
+        # after all that abuse, one clean full pass
+        full = list(proc_loader)
+        assert len(full) == 8
+        np.testing.assert_array_equal(full[-1], xs[21:])
+    finally:
+        proc_loader.close()
