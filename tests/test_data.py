@@ -503,31 +503,51 @@ def test_compose_reseed_is_deterministic_in_process():
 def test_loader_stress_no_deadlock():
     """Stress the reorder/staleness machinery: random full/partial/
     abandoned iterations over both worker types must neither hang nor
-    produce out-of-order batches (pytest-level timeout = the harness)."""
+    produce out-of-order batches. The body runs in a watchdog thread so
+    a reintroduced deadlock FAILS (join timeout) instead of hanging the
+    pytest process forever."""
     xs = np.arange(48, dtype=np.float32).reshape(24, 2)
-    ds = tdata.ArrayDataset(xs)
-    rng = np.random.RandomState(0)
 
-    thread_loader = tdata.DataLoader(ds, batch_size=3, num_workers=3)
-    proc_loader = tdata.DataLoader(ds, batch_size=3, num_workers=2,
-                                   worker_type="process")
-    try:
-        for trial in range(30):
-            loader = proc_loader if trial % 2 else thread_loader
-            take = rng.randint(0, 9)  # 8 full batches per epoch
-            it = iter(loader)
-            got = []
-            for _ in range(take):
-                try:
-                    got.append(next(it))
-                except StopIteration:
-                    break
-            it.close()  # abandon (or finish) the iteration
-            for i, b in enumerate(got):
-                np.testing.assert_array_equal(b, xs[i * 3:(i + 1) * 3])
-        # after all that abuse, one clean full pass
-        full = list(proc_loader)
-        assert len(full) == 8
-        np.testing.assert_array_equal(full[-1], xs[21:])
-    finally:
-        proc_loader.close()
+    def body():
+        ds = tdata.ArrayDataset(xs)
+        rng = np.random.RandomState(0)
+        thread_loader = tdata.DataLoader(ds, batch_size=3, num_workers=3)
+        proc_loader = tdata.DataLoader(ds, batch_size=3, num_workers=2,
+                                       worker_type="process")
+        try:
+            for trial in range(30):
+                loader = proc_loader if trial % 2 else thread_loader
+                take = rng.randint(0, 9)  # 8 full batches per epoch
+                it = iter(loader)
+                got = []
+                for _ in range(take):
+                    try:
+                        got.append(next(it))
+                    except StopIteration:
+                        break
+                it.close()  # abandon (or finish) the iteration
+                for i, b in enumerate(got):
+                    np.testing.assert_array_equal(b, xs[i * 3:(i + 1) * 3])
+            # after all that abuse, one clean full pass
+            full = list(proc_loader)
+            assert len(full) == 8
+            np.testing.assert_array_equal(full[-1], xs[21:])
+        finally:
+            proc_loader.close()
+
+    import threading
+
+    errors = []
+
+    def run():
+        try:
+            body()
+        except BaseException as e:  # noqa: BLE001 - report into main thread
+            errors.append(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout=120)
+    assert not t.is_alive(), "loader stress run deadlocked (watchdog fired)"
+    if errors:
+        raise errors[0]

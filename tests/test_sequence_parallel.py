@@ -1,0 +1,138 @@
+"""Sequence-parallel attention parity: ring and Ulysses vs the full
+single-device softmax-attention oracle, forward and gradients, on the
+8-virtual-device CPU mesh (same harness as the SyncBN golden tests).
+
+The reference has no attention (SURVEY §5.7); these pin the framework's
+long-context extension: exactness of the sharded algorithms, not an
+approximation bound.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_syncbn.parallel import sequence
+
+B, L, H, D = 2, 32, 8, 16  # L and H divisible by every mesh size used
+
+
+def make_qkv(seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(
+        rng.standard_normal((B, L, H, D)).astype(np.float32), dtype
+    )
+    return mk(), mk(), mk()
+
+
+def mesh_of(n):
+    return Mesh(np.array(jax.devices()[:n]), (sequence.SEQ_AXIS,))
+
+
+def sharded_attn(impl, n, causal=False, scale=None):
+    fn = {"ring": sequence.ring_attention, "ulysses": sequence.ulysses_attention}[impl]
+    spec = P(None, sequence.SEQ_AXIS, None, None)
+    return shard_map(
+        functools.partial(fn, causal=causal, scale=scale),
+        mesh=mesh_of(n),
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.parametrize("n", [1, 2, 4, 8])
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_oracle(impl, n, causal):
+    q, k, v = make_qkv()
+    want = sequence._single_device_attention(q, k, v, causal=causal, scale=None)
+    got = jax.jit(sharded_attn(impl, n, causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_oracle(impl, causal):
+    q, k, v = make_qkv(seed=1)
+    # scalar loss keyed to every output element
+    w = jnp.asarray(
+        np.random.default_rng(2).standard_normal((B, L, H, D)).astype(np.float32)
+    )
+
+    def loss_oracle(q, k, v):
+        return jnp.sum(
+            w * sequence._single_device_attention(q, k, v, causal=causal, scale=None)
+        )
+
+    attn = sharded_attn(impl, 4, causal=causal)
+
+    def loss_sharded(q, k, v):
+        return jnp.sum(w * attn(q, k, v))
+
+    g_want = jax.grad(loss_oracle, argnums=(0, 1, 2))(q, k, v)
+    g_got = jax.jit(jax.grad(loss_sharded, argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip(g_got, g_want, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, err_msg=f"d{name}"
+        )
+
+
+def test_custom_scale_and_bf16():
+    q, k, v = make_qkv(seed=3, dtype=jnp.bfloat16)
+    want = sequence._single_device_attention(q, k, v, causal=True, scale=0.5)
+    got = jax.jit(sharded_attn("ring", 4, causal=True, scale=0.5))(q, k, v)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2
+    )
+
+
+def test_ulysses_requires_divisible_heads():
+    q = k = v = jnp.zeros((1, 8, 3, 4))  # 3 heads, 4-device mesh
+    spec = P(None, sequence.SEQ_AXIS, None, None)
+    f = shard_map(
+        sequence.ulysses_attention,
+        mesh=mesh_of(4),
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(f)(q, k, v)
+
+
+def test_wrapper_round_trip():
+    q, k, v = make_qkv(seed=4)
+    mesh = mesh_of(8)
+    want = sequence._single_device_attention(q, k, v, causal=True, scale=None)
+    for impl in ("ring", "ulysses"):
+        got = sequence.sharded_self_attention(mesh, q, k, v, causal=True, impl=impl)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, err_msg=impl
+        )
+    with pytest.raises(ValueError, match="impl"):
+        sequence.sharded_self_attention(mesh, q, k, v, impl="nope")
+
+
+def test_ring_no_full_sequence_materialization():
+    """Compiled ring attention — forward AND backward — must move data by
+    collective-permute only, never an all-gather of K/V: the point of the
+    ring is that no device ever holds the full sequence, and the scan
+    transpose in the backward must preserve that."""
+    q, k, v = make_qkv()
+    attn = sharded_attn("ring", 8)
+
+    fwd = jax.jit(attn)
+    hlo = fwd.lower(q, k, v).compile().as_text()
+    assert "collective-permute" in hlo
+    assert "all-gather" not in hlo
+
+    grad = jax.jit(
+        jax.grad(lambda q, k, v: jnp.sum(attn(q, k, v)), argnums=(0, 1, 2))
+    )
+    hlo_bwd = grad.lower(q, k, v).compile().as_text()
+    assert "collective-permute" in hlo_bwd
+    assert "all-gather" not in hlo_bwd

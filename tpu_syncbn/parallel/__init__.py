@@ -19,6 +19,11 @@ from tpu_syncbn.parallel.collectives import (
     psum_in_groups,
     ring_all_reduce,
 )
+from tpu_syncbn.parallel.sequence import (
+    ring_attention,
+    sharded_self_attention,
+    ulysses_attention,
+)
 
 __all__ = [
     "GANTrainer",
@@ -40,4 +45,7 @@ __all__ = [
     "reduce_moments",
     "psum_in_groups",
     "ring_all_reduce",
+    "ring_attention",
+    "sharded_self_attention",
+    "ulysses_attention",
 ]

@@ -109,6 +109,22 @@ def broadcast(tree: Pytree, src: int = 0, axis_name: str = DATA_AXIS) -> Pytree:
     return jax.tree_util.tree_map(one, tree)
 
 
+def pcast_varying(tree: Pytree, axis_name: str = DATA_AXIS) -> Pytree:
+    """Idempotently cast every leaf to device-varying over ``axis_name``
+    (``lax.pcast`` raises on an already-varying input, and mixed trees are
+    common: SyncBN stats come out of their psum unvarying while plain-BN
+    stats stay varying). Shared home for the VMA-cast used by the
+    trainers and the sequence-parallel scan carries — one place to adapt
+    if jax's vma/pcast API shifts again."""
+
+    def leaf(x):
+        if axis_name in getattr(jax.typeof(x), "vma", frozenset()):
+            return x
+        return lax.pcast(x, axis_name, to="varying")
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
 def ppermute(
     tree: Pytree, perm: list[tuple[int, int]], axis_name: str = DATA_AXIS
 ) -> Pytree:

@@ -30,7 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_syncbn.models.gan import bce_gan_losses, hinge_gan_losses
 from tpu_syncbn.parallel import collectives
-from tpu_syncbn.parallel.trainer import _pcast_varying
+from tpu_syncbn.parallel.collectives import pcast_varying as _pcast_varying
 from tpu_syncbn.runtime import distributed as dist
 from tpu_syncbn.runtime.distributed import DATA_AXIS
 

@@ -1,0 +1,238 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference recipe has no attention anywhere (SURVEY §5.7: absent from
+``README.md:1-104`` — the recipe is entirely conv-net BatchNorm). These
+are the long-context counterparts of the recipe's one idea — keep the
+activations local, communicate only what must be shared — promoted to
+first-class framework components over the same mesh/collective layer the
+SyncBN path uses:
+
+* :func:`ring_attention` — exact blockwise attention for sequences
+  sharded across the mesh. KV blocks rotate around the ICI ring
+  (``lax.ppermute``, the same neighbor cycle as
+  :func:`~tpu_syncbn.parallel.collectives.ring_all_reduce`) while each
+  device accumulates its queries' output with an online-softmax running
+  (max, denominator, accumulator) — so no device ever materializes the
+  full sequence, and per-step traffic is one KV block over a direct ICI
+  neighbor link. Compute per step is uniform across devices (SPMD
+  lockstep: no load imbalance, no dynamic shapes).
+
+* :func:`ulysses_attention` — DeepSpeed-Ulysses-style sequence
+  parallelism: two ``all_to_all``s trade the sequence sharding for a
+  *head* sharding, run ordinary full attention on the complete sequence
+  for this device's head slice, and trade back. Cheaper than the ring
+  when heads ≥ devices and the full sequence fits in HBM; the ring wins
+  when it does not.
+
+Both are exact (not approximations): output ≡ single-device softmax
+attention on the gathered sequence, forward and gradients — pinned by
+``tests/test_sequence_parallel.py`` on the 8-virtual-device mesh. Both
+are shard_map-level functions: arguments are this device's *local*
+sequence shard, shaped ``(batch, seq_local, heads, head_dim)``; use
+:func:`sharded_self_attention` for the array-level convenience wrapper.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SEQ_AXIS = "seq"
+
+# finite stand-in for -inf in masked logits: keeps the online-softmax
+# running max finite when an entire KV block is masked out (exp(-inf+inf)
+# would poison the rescale with NaN)
+_NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _qk_scale(head_dim: int, scale: Optional[float]) -> float:
+    return float(scale) if scale is not None else head_dim ** -0.5
+
+
+def _block_attend(q, k, v, bias, o, l, m):
+    """One online-softmax accumulation step over a KV block.
+
+    ``q``: (B, Lq, H, D) f32 pre-scaled; ``k``/``v``: (B, Lk, H, D);
+    ``bias``: (B, Lq, H, Lk) additive mask (0 or ``_NEG_BIG``);
+    carries ``o`` (B, Lq, H, D), ``l`` (B, Lq, H), ``m`` (B, Lq, H).
+    """
+    s = jnp.einsum("bqhd,bkhd->bqhk", q, k.astype(jnp.float32)) + bias
+    m_blk = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    p = jnp.exp(s - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bqhk,bkhd->bqhd", p, v.astype(jnp.float32)
+    )
+    return o_new, l_new, m_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = SEQ_AXIS,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention over a sequence sharded along ``axis_name``.
+
+    Shard-level function (call inside ``shard_map``): ``q``/``k``/``v``
+    are this device's contiguous sequence block, ``(B, L_local, H, D)``;
+    device ``i`` holds global positions ``[i·L_local, (i+1)·L_local)``.
+    Returns the local block of the attention output, same shape/dtype
+    as ``q``.
+
+    Algorithm: N-1 ``ppermute`` hops rotate the (K, V) pair around the
+    ring; at hop ``s`` this device combines the KV block that started on
+    device ``(me - s) mod N`` into its online-softmax state. Causal
+    masking uses the *global* positions reconstructed from the block's
+    origin, so the result is identical to masking the full sequence.
+    The loop is a ``lax.scan`` — compile size stays O(1) in world size.
+    """
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, l_q, h, d = q.shape
+    qf = q.astype(jnp.float32) * _qk_scale(d, scale)
+
+    if n == 1:
+        return _single_device_attention(q, k, v, causal=causal, scale=scale)
+
+    l_k = k.shape[1]
+    q_pos = me * l_q + jnp.arange(l_q)  # global query positions
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    def varying(x):  # scan carries must match the body's device-varying type
+        if axis_name in getattr(jax.typeof(x), "vma", frozenset()):
+            return x
+        return lax.pcast(x, axis_name, to="varying")
+
+    o0 = varying(jnp.zeros((b, l_q, h, d), jnp.float32))
+    l0 = varying(jnp.zeros((b, l_q, h), jnp.float32))
+    m0 = varying(jnp.full((b, l_q, h), _NEG_BIG, jnp.float32))
+
+    def bias_for(src):
+        """Additive mask for the KV block that started on device ``src``."""
+        if not causal:
+            return jnp.zeros((1, 1, 1, l_k), jnp.float32)
+        k_pos = src * l_k + jnp.arange(l_k)
+        allowed = q_pos[:, None] >= k_pos[None, :]  # (Lq, Lk)
+        return jnp.where(allowed, 0.0, _NEG_BIG)[None, :, None, :]
+
+    # own block first, then exactly N-1 (permute, attend) hops — the last
+    # rotation is never wasted (a collective in a uniform scan body cannot
+    # be dead-code-eliminated by XLA)
+    o, l, m = _block_attend(qf, k, v, bias_for(me), o0, l0, m0)
+
+    def hop(carry, s):
+        o, l, m, k_blk, v_blk = carry
+        k_blk, v_blk = lax.ppermute((k_blk, v_blk), axis_name, fwd)
+        src = (me - s) % n  # ring origin of the block now in hand
+        o, l, m = _block_attend(qf, k_blk, v_blk, bias_for(src), o, l, m)
+        return (o, l, m, k_blk, v_blk), None
+
+    (o, l, m, _, _), _ = lax.scan(hop, (o, l, m, k, v), jnp.arange(1, n))
+    # causal ⇒ every query sees at least itself, so l > 0; keep the
+    # guard anyway for degenerate fully-masked rows
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def _single_device_attention(q, k, v, *, causal, scale):
+    """Plain full-softmax attention — the n=1 path and the test oracle."""
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bqhk",
+        q.astype(jnp.float32) * _qk_scale(d, scale),
+        k.astype(jnp.float32),
+    )
+    if causal:
+        l_q, l_k = q.shape[1], k.shape[1]
+        allowed = jnp.arange(l_q)[:, None] >= jnp.arange(l_k)[None, :]
+        s = jnp.where(allowed[None, :, None, :], s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqhk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(
+        q.dtype
+    )
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = SEQ_AXIS,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Sequence parallelism by head redistribution (DeepSpeed-Ulysses).
+
+    Shard-level function: local blocks ``(B, L_local, H, D)`` with the
+    sequence sharded along ``axis_name``. An ``all_to_all`` converts the
+    layout to (full sequence × ``H/N`` local heads), full attention runs
+    locally per head slice, and a second ``all_to_all`` restores the
+    sequence sharding. Requires ``H`` divisible by the axis size.
+
+    Exact — the head axis is embarrassingly parallel in attention, so
+    resharding it changes nothing numerically. Two all_to_alls move
+    2·(N-1)/N of (Q,K,V,O) per device vs the ring's (N-1)/N of (K,V),
+    but the attention itself is one big local matmul over the full
+    sequence (best MXU shape) instead of N accumulation steps.
+    """
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if n == 1:
+        return _single_device_attention(q, k, v, causal=causal, scale=scale)
+    if h % n:
+        raise ValueError(f"heads ({h}) must be divisible by axis size ({n})")
+
+    def to_heads(x):  # (B, L/n, H, D) -> (B, L, H/n, D)
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def to_seq(x):  # (B, L, H/n, D) -> (B, L/n, H, D)
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    oh = _single_device_attention(qh, kh, vh, causal=causal, scale=scale)
+    return to_seq(oh)
+
+
+def sharded_self_attention(
+    mesh: Mesh,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = SEQ_AXIS,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    impl: str = "ring",
+) -> jax.Array:
+    """Array-level convenience wrapper: shard global ``(B, L, H, D)``
+    arrays along ``L`` over ``mesh[axis_name]`` and run ring or Ulysses
+    attention under ``shard_map`` (select with ``impl``)."""
+    fns = {"ring": ring_attention, "ulysses": ulysses_attention}
+    try:
+        fn = fns[impl]
+    except KeyError:
+        raise ValueError(f"impl must be one of {sorted(fns)}, got {impl!r}")
+    seq_sharded = P(None, axis_name, None, None)
+    shard_fn = jax.shard_map(
+        functools.partial(fn, axis_name=axis_name, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(seq_sharded, seq_sharded, seq_sharded),
+        out_specs=seq_sharded,
+    )
+    put = lambda x: jax.device_put(x, NamedSharding(mesh, seq_sharded))
+    return shard_fn(put(q), put(k), put(v))

@@ -50,6 +50,7 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_syncbn.parallel import collectives
+from tpu_syncbn.parallel.collectives import pcast_varying as _pcast_varying
 from tpu_syncbn.runtime import distributed as dist
 from tpu_syncbn.runtime.distributed import DATA_AXIS
 
@@ -127,17 +128,6 @@ def _stats_replicated_by_construction(model: nnx.Module) -> bool:
     return True
 
 
-def _pcast_varying(tree, axis: str):
-    """Idempotently cast every leaf to device-varying over ``axis`` (pcast
-    raises on an already-varying input, and BN state mixes both: SyncBN
-    stats come out of their psum unvarying, plain-BN stats stay varying)."""
-
-    def leaf(x):
-        if axis in getattr(jax.typeof(x), "vma", frozenset()):
-            return x
-        return jax.lax.pcast(x, axis, to="varying")
-
-    return jax.tree_util.tree_map(leaf, tree)
 
 
 @dataclasses.dataclass
