@@ -189,6 +189,7 @@ class DataParallel:
         donate: bool = True,
         remat: bool = False,
         grad_compression: str | None = None,
+        zero: bool = False,
     ):
         """``remat=True`` rematerializes the forward during backward
         (``jax.checkpoint``) — trades ~1/3 more FLOPs for activation
@@ -199,7 +200,21 @@ class DataParallel:
         cross-replica all-reduce and back — DDP's
         ``bf16_compress_hook`` communication hook
         (``[torch] distributed/algorithms/ddp_comm_hooks``), halving the
-        gradient traffic over ICI/DCN at a small precision cost."""
+        gradient traffic over ICI/DCN at a small precision cost.
+
+        ``zero=True`` shards parameters and optimizer state across the
+        data axis (ZeRO; beyond reference scope — DDP replicates both,
+        ``[torch] nn/parallel/distributed.py:466``). Params live as
+        dtype-grouped flat vectors sharded 1/world per device; each step
+        all_gathers params once, ``psum_scatter``s the flat gradients
+        (same wire cost as DDP's all-reduce, since all-reduce =
+        reduce-scatter + all-gather), and the optimizer touches only the
+        local shard — Adam's f32 moments never exist in full on any
+        device. Numerics are identical to ``zero=False`` for
+        *elementwise* optimizer transforms (SGD/momentum/Adam/AdamW,
+        schedules, per-leaf clipping); transforms needing a global view
+        across parameters (``clip_by_global_norm``) would compute their
+        statistic per-shard and are unsupported under ``zero``."""
         if accum_steps < 1:
             raise ValueError("accum_steps must be >= 1")
         if grad_compression not in (None, "bf16"):
@@ -238,24 +253,57 @@ class DataParallel:
         # the trainer (its docstring says so).
         self._check_vma = not _model_traces_pallas_bn(model)
 
+        self.zero = bool(zero)
         self.graphdef, params, rest = nnx.split(model, nnx.Param, ...)
-        self.params = params
         self.rest = rest  # BatchStats + any other non-Param state
-        self.opt_state = optimizer.init(params)
 
         self.batch_sharding = NamedSharding(self.mesh, P(axis_name))
         self._replicated = NamedSharding(self.mesh, P())
         self._per_replica = NamedSharding(self.mesh, P(axis_name))
         self.world = int(self.mesh.shape[axis_name])
 
-        # put state on the mesh once. Params/opt replicated; buffers
-        # replicated when broadcast_buffers keeps them in sync, otherwise
-        # stored honestly per-replica ((world, ...) sharded on the data
-        # axis) — torch's broadcast_buffers=False keeps local buffers per
-        # replica, and declaring divergent buffers "replicated" would let
-        # any host read return an arbitrary replica's stats.
-        self.params = jax.device_put(self.params, self._replicated)
-        self.opt_state = jax.device_put(self.opt_state, self._replicated)
+        # put state on the mesh once. Params/opt replicated (or flat +
+        # 1/world-sharded under zero); buffers replicated when
+        # broadcast_buffers keeps them in sync, otherwise stored honestly
+        # per-replica ((world, ...) sharded on the data axis) — torch's
+        # broadcast_buffers=False keeps local buffers per replica, and
+        # declaring divergent buffers "replicated" would let any host
+        # read return an arbitrary replica's stats.
+        if self.zero:
+            from tpu_syncbn.parallel.zero import FlatLayout
+
+            self._layout = FlatLayout(params, self.world)
+            self._pspec = {dt: P(axis_name) for dt in self._layout.groups}
+            self._param_store = jax.device_put(
+                self._layout.flatten(params), self._per_replica
+            )
+            # optimizer state is born sharded: init runs per-shard under
+            # shard_map; vector leaves (moments etc., shaped like the
+            # shard) shard along the axis, scalar leaves (step counts)
+            # replicate.
+            shard_tpl = {
+                dt: jax.ShapeDtypeStruct((n,), jnp.dtype(dt))
+                for dt, n in self._layout.shard_sizes.items()
+            }
+            opt_shapes = jax.eval_shape(optimizer.init, shard_tpl)
+            self._opt_spec = jax.tree_util.tree_map(
+                lambda l: P() if l.ndim == 0 else P(axis_name), opt_shapes
+            )
+            init_sharded = shard_map(
+                optimizer.init,
+                mesh=self.mesh,
+                in_specs=(self._pspec,),
+                out_specs=self._opt_spec,
+                check_vma=self._check_vma,
+            )
+            self.opt_state = jax.jit(init_sharded)(self._param_store)
+        else:
+            self._pspec = P()
+            self._opt_spec = P()
+            self._param_store = jax.device_put(params, self._replicated)
+            self.opt_state = jax.device_put(
+                optimizer.init(params), self._replicated
+            )
         if broadcast_buffers:
             self.rest = jax.device_put(self.rest, self._replicated)
         else:
@@ -307,10 +355,20 @@ class DataParallel:
         )(params, rest, batch)
         return loss, metrics, new_rest, grads
 
+    def _gather_params(self, store):
+        """ZeRO path: rebuild the full (device-varying) param tree from
+        this device's flat shards — ONE all_gather per dtype group."""
+        full = {
+            dt: jax.lax.all_gather(v, self.axis_name, axis=0, tiled=True)
+            for dt, v in store.items()
+        }
+        return self._layout.unflatten(full)
+
     def _build_train_step(self, donate: bool):
         axis = self.axis_name
 
-        def step(params, rest, opt_state, batch):
+        def step(pstore, rest, opt_state, batch):
+            params = self._gather_params(pstore) if self.zero else pstore
             if not self.broadcast_buffers:
                 # per-replica storage: strip the local leading axis of 1
                 rest = jax.tree_util.tree_map(lambda x: x[0], rest)
@@ -373,24 +431,52 @@ class DataParallel:
                 loss = jnp.mean(losses)
                 metrics = jax.tree_util.tree_map(jnp.mean, metricses)
 
-            # DDP gradient averaging: one compiler-scheduled all-reduce
-            if self.grad_compression == "bf16":
-                # bf16_compress_hook parity: halve the wire traffic
-                dtypes = jax.tree_util.tree_map(lambda g: g.dtype, grads)
-                grads = jax.tree_util.tree_map(
-                    lambda g: g.astype(jnp.bfloat16), grads
-                )
-                grads = collectives.pmean(grads, axis)
-                grads = jax.tree_util.tree_map(
-                    lambda g, d: g.astype(d), grads, dtypes
-                )
-            else:
-                grads = collectives.pmean(grads, axis)
             loss = collectives.pmean(loss, axis)
             metrics = collectives.pmean(metrics, axis)
 
-            updates, opt_state = self.optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
+            if self.zero:
+                # average + shard the gradients in ONE collective: a
+                # psum_scatter is the reduce-scatter half of the
+                # all-reduce DDP would issue, and the optimizer only
+                # needs this device's shard
+                flat_g = self._layout.flatten(grads)
+
+                def scatter(g):
+                    if self.grad_compression == "bf16":
+                        d = g.dtype
+                        g = jax.lax.psum_scatter(
+                            g.astype(jnp.bfloat16), axis,
+                            scatter_dimension=0, tiled=True,
+                        ).astype(d)
+                    else:
+                        g = jax.lax.psum_scatter(
+                            g, axis, scatter_dimension=0, tiled=True
+                        )
+                    return g / self.world
+
+                gshard = {dt: scatter(g) for dt, g in flat_g.items()}
+                updates, opt_state = self.optimizer.update(
+                    gshard, opt_state, pstore
+                )
+                pstore = optax.apply_updates(pstore, updates)
+            else:
+                # DDP gradient averaging: one compiler-scheduled all-reduce
+                if self.grad_compression == "bf16":
+                    # bf16_compress_hook parity: halve the wire traffic
+                    dtypes = jax.tree_util.tree_map(lambda g: g.dtype, grads)
+                    grads = jax.tree_util.tree_map(
+                        lambda g: g.astype(jnp.bfloat16), grads
+                    )
+                    grads = collectives.pmean(grads, axis)
+                    grads = jax.tree_util.tree_map(
+                        lambda g, d: g.astype(d), grads, dtypes
+                    )
+                else:
+                    grads = collectives.pmean(grads, axis)
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params
+                )
+                pstore = optax.apply_updates(params, updates)
 
             if self.broadcast_buffers:
                 if self._per_step_broadcast:
@@ -406,13 +492,15 @@ class DataParallel:
                 if self._check_vma:
                     rest = _pcast_varying(rest, axis)
                 rest = jax.tree_util.tree_map(lambda x: x[None], rest)
-            return params, rest, opt_state, loss, metrics
+            return pstore, rest, opt_state, loss, metrics
 
         sharded = shard_map(
             step,
             mesh=self.mesh,
-            in_specs=(P(), self._rest_spec, P(), P(self.axis_name)),
-            out_specs=(P(), self._rest_spec, P(), P(), P()),
+            in_specs=(self._pspec, self._rest_spec, self._opt_spec,
+                      P(self.axis_name)),
+            out_specs=(self._pspec, self._rest_spec, self._opt_spec,
+                       P(), P()),
             # VMA checker ON (unless pallas traces — see __init__):
             # validates that params/opt_state/loss really are replicated
             # after the step. Requires the explicit varying-cast of params
@@ -424,7 +512,8 @@ class DataParallel:
         return jax.jit(sharded, donate_argnums=donate_argnums)
 
     def _build_eval_step(self):
-        def step(params, rest, batch):
+        def step(pstore, rest, batch):
+            params = self._gather_params(pstore) if self.zero else pstore
             if not self.broadcast_buffers:
                 rest = jax.tree_util.tree_map(lambda x: x[0], rest)
             model = nnx.merge(self.graphdef, params, rest, copy=True)
@@ -438,7 +527,7 @@ class DataParallel:
         sharded = shard_map(
             step,
             mesh=self.mesh,
-            in_specs=(P(), self._rest_spec, P(self.axis_name)),
+            in_specs=(self._pspec, self._rest_spec, P(self.axis_name)),
             out_specs=(P(), P()),
             check_vma=self._check_vma,
         )
@@ -446,16 +535,39 @@ class DataParallel:
 
     # -- public API -------------------------------------------------------
 
+    @property
+    def params(self):
+        """The parameter pytree. Under ``zero`` the canonical storage is
+        flat + sharded; reading this property assembles the full tree on
+        the host (cheap relative to a checkpoint write, the main reader).
+        Assigning accepts a param tree in either mode."""
+        if self.zero:
+            return self._layout.unflatten_host(self._param_store)
+        return self._param_store
+
+    @params.setter
+    def params(self, tree):
+        if self.zero:
+            self._param_store = jax.device_put(
+                self._layout.flatten(tree), self._per_replica
+            )
+        else:
+            self._param_store = jax.device_put(tree, self._replicated)
+
     def train_step(self, batch) -> StepOutput:
         """One optimizer step on a *global* batch (sharded or shardable
         along axis 0 across the mesh)."""
-        self.params, self.rest, self.opt_state, loss, metrics = self._train_step(
-            self.params, self.rest, self.opt_state, batch
-        )
+        (
+            self._param_store,
+            self.rest,
+            self.opt_state,
+            loss,
+            metrics,
+        ) = self._train_step(self._param_store, self.rest, self.opt_state, batch)
         return StepOutput(loss=loss, metrics=metrics)
 
     def eval_step(self, batch) -> StepOutput:
-        loss, metrics = self._eval_step(self.params, self.rest, batch)
+        loss, metrics = self._eval_step(self._param_store, self.rest, batch)
         return StepOutput(loss=loss, metrics=metrics)
 
     def lowered_train_step(self, batch):
@@ -464,7 +576,7 @@ class DataParallel:
         reporting, or ``.as_text()`` for HLO inspection. Keeps the
         (params, rest, opt_state, batch) calling convention private."""
         return self._train_step.lower(
-            self.params, self.rest, self.opt_state, batch
+            self._param_store, self.rest, self.opt_state, batch
         )
 
     def sync_to_model(self) -> nnx.Module:
@@ -491,22 +603,36 @@ class DataParallel:
 
         Returns *copies*: with ``donate=True`` (the default) the live
         buffers are invalidated by the next train_step, so a snapshot that
-        merely referenced them would be unreadable afterwards."""
-        return jax.tree_util.tree_map(
-            jnp.copy,
-            {
-                "params": self.params,
-                "rest": self.rest,
-                "opt_state": self.opt_state,
-            },
-        )
+        merely referenced them would be unreadable afterwards. (Under
+        ``zero`` the params property already assembles fresh host arrays
+        — copying those again would double the full-model allocation.)"""
+        params = self.params
+        if not self.zero:
+            params = jax.tree_util.tree_map(jnp.copy, params)
+        return {
+            "params": params,
+            "rest": jax.tree_util.tree_map(jnp.copy, self.rest),
+            "opt_state": jax.tree_util.tree_map(jnp.copy, self.opt_state),
+        }
 
     def load_state_dict(self, state: dict) -> None:
         """Restore a pytree produced by :meth:`state_dict` (or deserialized
-        into its structure), re-placing it on the mesh."""
-        self.params = jax.device_put(state["params"], self._replicated)
+        into its structure), re-placing it on the mesh. The checkpoint
+        format is mode-independent for params (always the full tree);
+        opt_state structure differs between ``zero`` and replicated
+        trainers, so resume into a trainer built with the same ``zero``."""
+        self.params = state["params"]  # setter re-shards per mode
         rest_sharding = (
             self._replicated if self.broadcast_buffers else self._per_replica
         )
         self.rest = jax.device_put(state["rest"], rest_sharding)
-        self.opt_state = jax.device_put(state["opt_state"], self._replicated)
+        if self.zero:
+            shardings = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(self.mesh, spec), self._opt_spec,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            self.opt_state = jax.device_put(state["opt_state"], shardings)
+        else:
+            self.opt_state = jax.device_put(
+                state["opt_state"], self._replicated
+            )
