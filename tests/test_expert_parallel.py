@@ -1,0 +1,146 @@
+"""Expert-parallel MoE exactness: the all_to_all-sharded computation must
+equal the dense single-device oracle per token shard — forward, gradients,
+and the load-balance aux loss — on the 8-virtual-device CPU mesh.
+
+MoE is absent from the reference (SURVEY §2 parallelism inventory); the
+contract here is self-consistency of the beyond-reference EP extension.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_syncbn.parallel import expert as moe
+
+T, D, H = 16, 8, 32  # tokens per device, model dim, hidden dim
+
+
+def mesh_of(n):
+    return Mesh(np.array(jax.devices()[:n]), (moe.EXPERT_AXIS,))
+
+
+def make_weights(n_experts, seed=0):
+    rng = np.random.default_rng(seed)
+    router = jnp.asarray(rng.standard_normal((D, n_experts)).astype(np.float32))
+    w_in = jnp.asarray(
+        rng.standard_normal((n_experts, D, H)).astype(np.float32) * 0.1
+    )
+    w_out = jnp.asarray(
+        rng.standard_normal((n_experts, H, D)).astype(np.float32) * 0.1
+    )
+    return router, w_in, w_out
+
+
+def make_tokens(n_shards, seed=1):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        rng.standard_normal((n_shards * T, D)).astype(np.float32)
+    )
+
+
+def ep_fn(n, n_experts, capacity_factor=1.25):
+    spec_x = P(moe.EXPERT_AXIS, None)
+    spec_w = P(moe.EXPERT_AXIS, None, None)
+    return shard_map(
+        functools.partial(
+            moe.expert_parallel_moe, capacity_factor=capacity_factor
+        ),
+        mesh=mesh_of(n),
+        in_specs=(spec_x, P(None, None), spec_w, spec_w),
+        out_specs=(spec_x, P()),
+    )
+
+
+def dense_per_shard(x, router, w_in, w_out, n_shards, capacity_factor=1.25):
+    """Oracle: dense_moe applied independently to each token shard (the
+    routing/capacity unit), concatenated; aux averaged."""
+    ys, auxs = [], []
+    for s in range(n_shards):
+        y, a = moe.dense_moe(
+            x[s * T:(s + 1) * T], router, w_in, w_out,
+            capacity_factor=capacity_factor,
+        )
+        ys.append(y)
+        auxs.append(a)
+    return jnp.concatenate(ys), jnp.mean(jnp.stack(auxs))
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 8])
+@pytest.mark.parametrize("experts_per_device", [1, 2])
+def test_forward_matches_dense_oracle(n, experts_per_device):
+    n_experts = n * experts_per_device
+    router, w_in, w_out = make_weights(n_experts)
+    x = make_tokens(n)
+    want_y, want_aux = dense_per_shard(x, router, w_in, w_out, n)
+    got_y, got_aux = jax.jit(ep_fn(n, n_experts))(x, router, w_in, w_out)
+    np.testing.assert_allclose(np.asarray(got_y), np.asarray(want_y), atol=1e-5)
+    np.testing.assert_allclose(float(got_aux), float(want_aux), rtol=1e-5)
+
+
+def test_gradients_match_dense_oracle():
+    n, n_experts = 4, 8
+    router, w_in, w_out = make_weights(n_experts)
+    x = make_tokens(n)
+    w = jnp.asarray(
+        np.random.default_rng(2).standard_normal((n * T, D)).astype(np.float32)
+    )
+    ep = ep_fn(n, n_experts)
+
+    def loss_ep(x, router, w_in, w_out):
+        y, aux = ep(x, router, w_in, w_out)
+        return jnp.sum(w * y) + aux
+
+    def loss_dense(x, router, w_in, w_out):
+        y, aux = dense_per_shard(x, router, w_in, w_out, n)
+        return jnp.sum(w * y) + aux
+
+    g_got = jax.jit(jax.grad(loss_ep, argnums=(0, 1, 2, 3)))(
+        x, router, w_in, w_out
+    )
+    g_want = jax.grad(loss_dense, argnums=(0, 1, 2, 3))(x, router, w_in, w_out)
+    for a, b, name in zip(g_got, g_want, ("x", "router", "w_in", "w_out")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, err_msg=f"d{name}"
+        )
+
+
+def test_capacity_drops_overflow_tokens():
+    """With capacity_factor so small every expert has one slot per source,
+    overflowed tokens contribute zero output rows."""
+    n_experts = 2
+    router, w_in, w_out = make_weights(n_experts, seed=3)
+    # all tokens prefer the same expert: identical inputs
+    x = jnp.tile(jnp.asarray(np.random.default_rng(4).standard_normal((1, D)),
+                             dtype=jnp.float32), (T, 1))
+    y, _ = moe.dense_moe(x, router, w_in, w_out, capacity_factor=2 / T)
+    c = moe._capacity(T, n_experts, 2 / T)
+    nonzero_rows = int(jnp.sum(jnp.any(jnp.abs(y) > 0, axis=-1)))
+    assert nonzero_rows <= c, (nonzero_rows, c)
+    assert nonzero_rows >= 1
+
+
+def test_world_size_mismatch_raises():
+    router8, _, _ = make_weights(8)
+    _, w_in4, w_out4 = make_weights(4)  # 4 experts of weights, router says 8
+    x = make_tokens(4)
+    f = ep_fn(4, 8)
+    with pytest.raises(ValueError, match="experts"):
+        jax.jit(f)(x, router8, w_in4, w_out4)
+
+
+def test_expert_weights_stay_sharded_in_hlo():
+    """The compiled EP step must move token slots (all-to-all), never
+    gather the expert weights."""
+    n, n_experts = 8, 8
+    router, w_in, w_out = make_weights(n_experts)
+    x = make_tokens(n)
+    hlo = jax.jit(ep_fn(n, n_experts)).lower(
+        x, router, w_in, w_out
+    ).compile().as_text()
+    assert "all-to-all" in hlo
+    assert "all-gather" not in hlo

@@ -161,6 +161,30 @@ def test_zero_hlo_has_reduce_scatter_no_grad_allreduce():
     assert "all-gather" in hlo
 
 
+def test_zero_rejects_global_view_optimizer():
+    """clip_by_global_norm computes a statistic over ALL params; under
+    ZeRO it would see only a shard — must be rejected, not silently
+    wrong."""
+    mesh = mesh_of(4)
+    opt = optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-3))
+    with pytest.raises(ValueError, match="elementwise"):
+        parallel.DataParallel(make_model(), opt, loss_fn, mesh=mesh, zero=True)
+    # the same chain is fine without zero
+    parallel.DataParallel(make_model(), opt, loss_fn, mesh=mesh)
+
+
+def test_zero_load_rejects_world_size_mismatch():
+    dp4 = parallel.DataParallel(
+        make_model(), optax.adam(1e-3), loss_fn, mesh=mesh_of(4), zero=True
+    )
+    snap = dp4.state_dict()
+    dp2 = parallel.DataParallel(
+        make_model(), optax.adam(1e-3), loss_fn, mesh=mesh_of(2), zero=True
+    )
+    with pytest.raises(ValueError, match="world size"):
+        dp2.load_state_dict(snap)
+
+
 # -- checkpoint/resume and eval --------------------------------------------
 
 

@@ -24,6 +24,10 @@ from tpu_syncbn.parallel.sequence import (
     sharded_self_attention,
     ulysses_attention,
 )
+from tpu_syncbn.parallel.expert import (
+    dense_moe,
+    expert_parallel_moe,
+)
 
 __all__ = [
     "GANTrainer",
@@ -48,4 +52,6 @@ __all__ = [
     "ring_attention",
     "sharded_self_attention",
     "ulysses_attention",
+    "dense_moe",
+    "expert_parallel_moe",
 ]

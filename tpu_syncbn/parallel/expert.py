@@ -1,0 +1,158 @@
+"""Expert parallelism: Switch-style mixture-of-experts over an ``expert``
+mesh axis.
+
+The reference recipe has no MoE (absent from ``README.md:1-104``, SURVEY
+§2's parallelism inventory) — this is the expert-parallel member of the
+beyond-reference set (ring/Ulysses sequence parallelism, ZeRO), built on
+the same collective layer. The TPU-native shape:
+
+* tokens are sharded across the axis (data-parallel style);
+* expert weights are sharded across the SAME axis — device ``i`` owns
+  experts ``[i·E_loc, (i+1)·E_loc)`` and only ever materializes those;
+* routing is top-1 (Switch) with a per-(expert, source-device) capacity;
+  dispatch/combine are one-hot einsums (static shapes, MXU-friendly —
+  no gather/scatter, no dynamic shapes under jit);
+* two ``all_to_all``s move token slots to their expert's device and
+  back — O(capacity) traffic per device, the EP analogue of the
+  sequence module's resharding.
+
+Exactness contract: :func:`expert_parallel_moe` over N devices equals
+:func:`dense_moe` (full weights, zero collectives) applied per shard —
+the all_to_alls relocate compute without changing it. Pinned with
+gradients in ``tests/test_expert_parallel.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+EXPERT_AXIS = "expert"
+
+
+def switch_route(
+    x: jax.Array, router_w: jax.Array, capacity: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-1 routing with capacity. ``x``: (T, D); ``router_w``: (D, E).
+
+    Returns ``(dispatch, combine, aux)``:
+      dispatch (T, E, C) 0/1 — token t occupies slot c of expert e;
+      combine  (T, E, C) f32 — dispatch scaled by the router probability
+      (the Switch estimator: output is prob-weighted so the router gets
+      gradients); aux — the Switch load-balance loss
+      ``E * Σ_e fraction_e · mean_prob_e`` over these tokens.
+
+    Tokens beyond an expert's capacity are dropped (their combine row is
+    zero → they pass through as zeros; residual connections restore them
+    in a transformer block). Slot assignment is by token order — the
+    deterministic tie-break the exactness tests rely on.
+    """
+    t, _ = x.shape
+    e = router_w.shape[-1]
+    logits = (x.astype(jnp.float32)) @ router_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
+    idx = jnp.argmax(probs, axis=-1)  # (T,)
+    onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # (T, E)
+    # rank of each token within its expert's queue (>= 0 at the chosen
+    # expert since the cumsum includes the token itself; -1 elsewhere)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # (T, E)
+    rank = pos.max(axis=-1).astype(jnp.int32)  # (T,)
+    # one_hot is all-zeros for rank >= capacity: over-capacity tokens
+    # drop out of dispatch with no separate mask needed
+    slot = jax.nn.one_hot(rank, capacity, dtype=jnp.float32)
+    dispatch = onehot[:, :, None] * slot[:, None, :]  # (T, E, C)
+    gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)  # (T, 1)
+    combine = dispatch * gate[:, :, None]
+    fraction = onehot.mean(axis=0)  # tokens routed to each expert
+    mean_prob = probs.mean(axis=0)
+    aux = e * jnp.sum(fraction * mean_prob)
+    return dispatch, combine, aux
+
+
+def _expert_mlp(inputs: jax.Array, w_in: jax.Array, w_out: jax.Array):
+    """Batched per-expert 2-layer ReLU MLP: (E, C, D) @ (E, D, H) @ (E, H, D)."""
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", inputs, w_in))
+    return jnp.einsum("ech,ehd->ecd", h, w_out)
+
+
+def _capacity(t: int, e: int, capacity_factor: float) -> int:
+    return max(1, int(-(-t * capacity_factor // e)))  # ceil
+
+
+def dense_moe(
+    x: jax.Array,
+    router_w: jax.Array,
+    w_in: jax.Array,
+    w_out: jax.Array,
+    *,
+    capacity_factor: float = 1.25,
+) -> tuple[jax.Array, jax.Array]:
+    """Single-device MoE: full expert weights, zero collectives. The n=1
+    path and the exactness oracle for the expert-parallel version.
+    Returns ``(y, aux)`` with ``y`` shaped like ``x``."""
+    t = x.shape[0]
+    e = router_w.shape[-1]
+    c = _capacity(t, e, capacity_factor)
+    dispatch, combine, aux = switch_route(x, router_w, c)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    expert_out = _expert_mlp(expert_in, w_in, w_out)
+    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return y.astype(x.dtype), aux
+
+
+def expert_parallel_moe(
+    x: jax.Array,
+    router_w: jax.Array,
+    w_in: jax.Array,
+    w_out: jax.Array,
+    axis_name: str = EXPERT_AXIS,
+    *,
+    capacity_factor: float = 1.25,
+) -> tuple[jax.Array, jax.Array]:
+    """Shard-level expert-parallel MoE (call inside ``shard_map``).
+
+    ``x``: this device's tokens (T_local, D); ``router_w``: replicated
+    (D, E_total); ``w_in``/``w_out``: this device's expert shard
+    (E_local, D, H) / (E_local, H, D) with ``E_total = E_local · world``.
+
+    Flow: route locally against all experts → dispatch into per-expert
+    capacity slots → ``all_to_all`` sends each expert's slots to its
+    owning device → batched expert MLP over the local experts → inverse
+    ``all_to_all`` → combine. Per-source capacity makes the result
+    exactly :func:`dense_moe` per shard. Returns ``(y_local, aux)`` with
+    aux ``pmean``'d across the axis.
+    """
+    n = lax.axis_size(axis_name)
+    t, d = x.shape
+    e_local = w_in.shape[0]
+    e = router_w.shape[-1]
+    if e != e_local * n:
+        raise ValueError(
+            f"router has {e} experts but shard has {e_local} × world {n}"
+        )
+    c = _capacity(t, e, capacity_factor)
+    dispatch, combine, aux = switch_route(x, router_w, c)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+
+    if n == 1:
+        expert_out = _expert_mlp(expert_in, w_in, w_out)
+    else:
+        # (E, C, D) -> (world, E_local, C, D): send slots to expert owners;
+        # received leading axis = source device
+        grouped = expert_in.reshape(n, e_local, c, d)
+        inbound = lax.all_to_all(
+            grouped, axis_name, split_axis=0, concat_axis=0, tiled=False
+        )  # (world_src, E_local, C, D)
+        flat_in = jnp.moveaxis(inbound, 0, 1).reshape(e_local, n * c, d)
+        flat_out = _expert_mlp(flat_in, w_in, w_out)
+        outbound = jnp.moveaxis(
+            flat_out.reshape(e_local, n, c, d), 1, 0
+        )  # (world_src, E_local, C, D)
+        returned = lax.all_to_all(
+            outbound, axis_name, split_axis=0, concat_axis=0, tiled=False
+        )  # (world_expert_owner, E_local, C, D)
+        expert_out = returned.reshape(e, c, d)
+
+    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return y.astype(x.dtype), lax.pmean(aux, axis_name)

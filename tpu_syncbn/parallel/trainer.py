@@ -270,8 +270,9 @@ class DataParallel:
         # declaring divergent buffers "replicated" would let any host
         # read return an arbitrary replica's stats.
         if self.zero:
-            from tpu_syncbn.parallel.zero import FlatLayout
+            from tpu_syncbn.parallel.zero import FlatLayout, check_elementwise
 
+            check_elementwise(optimizer)
             self._layout = FlatLayout(params, self.world)
             self._pspec = {dt: P(axis_name) for dt in self._layout.groups}
             self._param_store = jax.device_put(
@@ -359,7 +360,7 @@ class DataParallel:
         """ZeRO path: rebuild the full (device-varying) param tree from
         this device's flat shards — ONE all_gather per dtype group."""
         full = {
-            dt: jax.lax.all_gather(v, self.axis_name, axis=0, tiled=True)
+            dt: collectives.all_gather(v, self.axis_name, axis=0, tiled=True)
             for dt, v in store.items()
         }
         return self._layout.unflatten(full)
@@ -444,14 +445,11 @@ class DataParallel:
                 def scatter(g):
                     if self.grad_compression == "bf16":
                         d = g.dtype
-                        g = jax.lax.psum_scatter(
-                            g.astype(jnp.bfloat16), axis,
-                            scatter_dimension=0, tiled=True,
+                        g = collectives.reduce_scatter(
+                            g.astype(jnp.bfloat16), axis
                         ).astype(d)
                     else:
-                        g = jax.lax.psum_scatter(
-                            g, axis, scatter_dimension=0, tiled=True
-                        )
+                        g = collectives.reduce_scatter(g, axis)
                     return g / self.world
 
                 gshard = {dt: scatter(g) for dt, g in flat_g.items()}
@@ -619,8 +617,23 @@ class DataParallel:
         """Restore a pytree produced by :meth:`state_dict` (or deserialized
         into its structure), re-placing it on the mesh. The checkpoint
         format is mode-independent for params (always the full tree);
-        opt_state structure differs between ``zero`` and replicated
-        trainers, so resume into a trainer built with the same ``zero``."""
+        opt_state is NOT — under ``zero`` its flat vectors carry the
+        world-size-dependent padded layout, so resume into a trainer
+        built with the same ``zero`` flag AND world size (checked)."""
+        if self.zero:
+            want = jax.tree_util.tree_map(
+                lambda l: l.shape, self.opt_state
+            )
+            got = jax.tree_util.tree_map(
+                lambda l: jnp.shape(l), state["opt_state"]
+            )
+            if want != got:
+                raise ValueError(
+                    "zero=True opt_state layout mismatch: this checkpoint "
+                    "was saved with a different world size (flat shard "
+                    "padding is world-dependent). Resume on the same "
+                    f"world ({self.world}) or retrain the optimizer state."
+                )
         self.params = state["params"]  # setter re-shards per mode
         rest_sharding = (
             self._replicated if self.broadcast_buffers else self._per_replica

@@ -31,6 +31,47 @@ import jax
 import jax.numpy as jnp
 
 
+def check_elementwise(optimizer) -> None:
+    """Reject optimizers whose update needs a global view across the
+    parameter vector (e.g. ``optax.clip_by_global_norm``): under ZeRO each
+    device updates only its 1/world shard, so such transforms would
+    compute their statistic per-shard and silently diverge from the
+    replicated trainer. Probe numerically: one update on a small vector
+    must equal the concatenation of shard-wise updates."""
+    # Multi-step probe with non-proportional gradients: a single step is
+    # not enough (Adam's first update is scale-invariant, so a uniform
+    # per-shard clip factor would cancel out and hide the divergence).
+    import optax as _optax
+
+    rng = np.random.default_rng(0)
+    gs = [
+        jnp.asarray(rng.standard_normal(16).astype(np.float32) * (k + 1))
+        for k in range(3)
+    ]
+    vec0 = jnp.asarray(rng.standard_normal(16).astype(np.float32))
+
+    def run(vec, grads):
+        state = optimizer.init(vec)
+        for g in grads:
+            up, state = optimizer.update(g, state, vec)
+            vec = _optax.apply_updates(vec, up)
+        return np.asarray(vec)
+
+    full = run(vec0, gs)
+    parts = [
+        run(vec0[i * 4:(i + 1) * 4], [g[i * 4:(i + 1) * 4] for g in gs])
+        for i in range(4)
+    ]
+    if not np.allclose(full, np.concatenate(parts), rtol=1e-5, atol=1e-7):
+        raise ValueError(
+            "zero=True requires an elementwise optimizer: this optimizer's "
+            "update on a vector differs from shard-wise updates (a "
+            "global-view transform like clip_by_global_norm?). Under ZeRO "
+            "each device sees only its 1/world parameter shard, so such a "
+            "transform would silently train differently than zero=False."
+        )
+
+
 class FlatLayout:
     """Dtype-grouped flat layout of a pytree.
 
