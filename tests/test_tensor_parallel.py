@@ -1,0 +1,152 @@
+"""Tensor-parallel exactness: Megatron column/row linears, MLP, and
+head-sharded attention vs the unsharded oracle (fwd + grads), plus the
+one-psum-per-block HLO property, on the 8-virtual-device CPU mesh.
+
+TP is absent from the reference (SURVEY §2); the contract is
+self-consistency of the beyond-reference extension.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_syncbn.parallel import tensor as tp
+from tpu_syncbn.parallel.sequence import _single_device_attention
+
+B, L, D, H = 2, 6, 16, 32
+N_HEADS, DH = 8, 4
+
+
+def mesh_of(n):
+    return Mesh(np.array(jax.devices()[:n]), (tp.MODEL_AXIS,))
+
+
+def rngs(seed=0):
+    return np.random.default_rng(seed)
+
+
+def test_mlp_matches_oracle_fwd_and_grad():
+    n = 4
+    r = rngs()
+    x = jnp.asarray(r.standard_normal((B, L, D)).astype(np.float32))
+    w1 = jnp.asarray(r.standard_normal((D, H)).astype(np.float32) * 0.1)
+    b1 = jnp.asarray(r.standard_normal((H,)).astype(np.float32) * 0.1)
+    w2 = jnp.asarray(r.standard_normal((H, D)).astype(np.float32) * 0.1)
+    b2 = jnp.asarray(r.standard_normal((D,)).astype(np.float32) * 0.1)
+
+    def oracle(x, w1, b1, w2, b2):
+        return jax.nn.gelu(x @ w1 + b1) @ w2 + b2
+
+    f = shard_map(
+        tp.tp_mlp,
+        mesh=mesh_of(n),
+        in_specs=(P(), P(None, tp.MODEL_AXIS), P(tp.MODEL_AXIS),
+                  P(tp.MODEL_AXIS, None), P()),
+        out_specs=P(),
+    )
+    got = jax.jit(f)(x, w1, b1, w2, b2)
+    want = oracle(x, w1, b1, w2, b2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def loss_tp(*args):
+        return jnp.sum(f(*args) ** 2)
+
+    def loss_oracle(*args):
+        return jnp.sum(oracle(*args) ** 2)
+
+    g_got = jax.jit(jax.grad(loss_tp, argnums=tuple(range(5))))(x, w1, b1, w2, b2)
+    g_want = jax.grad(loss_oracle, argnums=tuple(range(5)))(x, w1, b1, w2, b2)
+    for a, b, name in zip(g_got, g_want, ("x", "w1", "b1", "w2", "b2")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, err_msg=f"d{name}"
+        )
+
+
+@pytest.mark.parametrize("n", [1, 2, 8])
+@pytest.mark.parametrize("causal", [False, True])
+def test_attention_matches_oracle(n, causal):
+    r = rngs(1)
+    x = jnp.asarray(r.standard_normal((B, L, D)).astype(np.float32))
+    mk = lambda shape: jnp.asarray(r.standard_normal(shape).astype(np.float32) * 0.2)
+    wq, wk, wv = mk((D, N_HEADS * DH)), mk((D, N_HEADS * DH)), mk((D, N_HEADS * DH))
+    wo = mk((N_HEADS * DH, D))
+
+    def oracle(x, wq, wk, wv, wo):
+        h = lambda w: (x @ w).reshape(B, L, N_HEADS, DH)
+        o = _single_device_attention(h(wq), h(wk), h(wv), causal=causal, scale=None)
+        return o.reshape(B, L, N_HEADS * DH) @ wo
+
+    f = shard_map(
+        functools.partial(
+            tp.tp_attention, n_local_heads=N_HEADS // n, causal=causal
+        ),
+        mesh=mesh_of(n),
+        in_specs=(P(), P(None, tp.MODEL_AXIS), P(None, tp.MODEL_AXIS),
+                  P(None, tp.MODEL_AXIS), P(tp.MODEL_AXIS, None)),
+        out_specs=P(),
+    )
+    got = jax.jit(f)(x, wq, wk, wv, wo)
+    want = oracle(x, wq, wk, wv, wo)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=3e-5)
+
+
+def test_one_psum_per_block():
+    """The compiled TP MLP must contain exactly ONE all-reduce (the row
+    psum) — the Megatron communication contract."""
+    n = 8
+    r = rngs(2)
+    x = jnp.asarray(r.standard_normal((B, L, D)).astype(np.float32))
+    w1 = jnp.asarray(r.standard_normal((D, H)).astype(np.float32))
+    w2 = jnp.asarray(r.standard_normal((H, D)).astype(np.float32))
+    f = jax.jit(
+        shard_map(
+            lambda x, w1, w2: tp.tp_mlp(x, w1, None, w2, None),
+            mesh=mesh_of(n),
+            in_specs=(P(), P(None, tp.MODEL_AXIS), P(tp.MODEL_AXIS, None)),
+            out_specs=P(),
+        )
+    )
+    hlo = f.lower(x, w1, w2).compile().as_text()
+    n_allreduce = hlo.count("all-reduce-start") or hlo.count("all-reduce(")
+    assert n_allreduce == 1, hlo
+    assert "all-gather" not in hlo
+
+    # same contract for the attention block
+    r2 = rngs(3)
+    xa = jnp.asarray(r2.standard_normal((B, L, D)).astype(np.float32))
+    mk = lambda s: jnp.asarray(r2.standard_normal(s).astype(np.float32))
+    wq, wk, wv = (mk((D, N_HEADS * DH)) for _ in range(3))
+    wo = mk((N_HEADS * DH, D))
+    fa = jax.jit(
+        shard_map(
+            functools.partial(tp.tp_attention, n_local_heads=N_HEADS // n),
+            mesh=mesh_of(n),
+            in_specs=(P(), P(None, tp.MODEL_AXIS), P(None, tp.MODEL_AXIS),
+                      P(None, tp.MODEL_AXIS), P(tp.MODEL_AXIS, None)),
+            out_specs=P(),
+        )
+    )
+    hlo_a = fa.lower(xa, wq, wk, wv, wo).compile().as_text()
+    n_allreduce_a = hlo_a.count("all-reduce-start") or hlo_a.count("all-reduce(")
+    assert n_allreduce_a == 1, hlo_a
+    assert "all-gather" not in hlo_a
+
+
+def test_bad_head_split_raises():
+    x = jnp.zeros((1, 4, D))
+    w = jnp.zeros((D, 6))
+    wo = jnp.zeros((6, D))
+    f = shard_map(
+        functools.partial(tp.tp_attention, n_local_heads=4),
+        mesh=mesh_of(2),
+        in_specs=(P(), P(None, tp.MODEL_AXIS), P(None, tp.MODEL_AXIS),
+                  P(None, tp.MODEL_AXIS), P(tp.MODEL_AXIS, None)),
+        out_specs=P(),
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(f)(x, w, w, wo.T, wo)

@@ -173,6 +173,20 @@ def test_zero_rejects_global_view_optimizer():
     parallel.DataParallel(make_model(), opt, loss_fn, mesh=mesh)
 
 
+def test_load_rejects_zero_mode_mismatch():
+    mesh = mesh_of(4)
+    dpz = parallel.DataParallel(
+        make_model(), optax.adam(1e-3), loss_fn, mesh=mesh, zero=True
+    )
+    dpr = parallel.DataParallel(
+        make_model(), optax.adam(1e-3), loss_fn, mesh=mesh
+    )
+    with pytest.raises(ValueError, match="zero"):
+        dpr.load_state_dict(dpz.state_dict())
+    with pytest.raises(ValueError, match="zero"):
+        dpz.load_state_dict(dpr.state_dict())
+
+
 def test_zero_load_rejects_world_size_mismatch():
     dp4 = parallel.DataParallel(
         make_model(), optax.adam(1e-3), loss_fn, mesh=mesh_of(4), zero=True

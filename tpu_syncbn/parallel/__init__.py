@@ -28,6 +28,12 @@ from tpu_syncbn.parallel.expert import (
     dense_moe,
     expert_parallel_moe,
 )
+from tpu_syncbn.parallel.tensor import (
+    column_parallel,
+    row_parallel,
+    tp_attention,
+    tp_mlp,
+)
 
 __all__ = [
     "GANTrainer",
@@ -54,4 +60,8 @@ __all__ = [
     "ulysses_attention",
     "dense_moe",
     "expert_parallel_moe",
+    "column_parallel",
+    "row_parallel",
+    "tp_attention",
+    "tp_mlp",
 ]

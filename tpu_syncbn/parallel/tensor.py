@@ -1,0 +1,120 @@
+"""Tensor (model) parallelism: Megatron-style sharded linear layers over a
+``model`` mesh axis.
+
+Absent from the reference (SURVEY §2's parallelism inventory: DP only) —
+implemented as the tensor-parallel member of the beyond-reference set.
+The classic pairing keeps collectives to ONE psum per block:
+
+* :func:`column_parallel` — weight sharded on the *output* feature dim;
+  every device computes its slice of the activations. No communication.
+* :func:`row_parallel` — weight sharded on the *input* feature dim over
+  activations that are already feature-sharded (a column-parallel
+  output); each device holds a rank-deficient partial product and one
+  ``psum`` completes it.
+
+So ``column → nonlinearity → row`` (the Megatron MLP) and
+``column-QKV → per-head-group attention → row-out`` (the Megatron
+attention) each cost exactly one all-reduce — asserted on compiled HLO
+in ``tests/test_tensor_parallel.py`` along with exactness (fwd + grads)
+against the unsharded oracle.
+
+All functions are shard-level (call inside ``shard_map``); weights are
+passed pre-sharded (``P(None, "model")`` for column, ``P("model", None)``
+for row), which is also how a checkpoint should store them.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+MODEL_AXIS = "model"
+
+
+def column_parallel(
+    x: jax.Array,
+    w_shard: jax.Array,
+    b_shard: Optional[jax.Array] = None,
+) -> jax.Array:
+    """``y_shard = x @ W[:, shard] + b[shard]``. ``x`` is replicated
+    across the model axis; the output is feature-sharded. Zero
+    collectives — the point of the column half."""
+    y = x @ w_shard
+    if b_shard is not None:
+        y = y + b_shard
+    return y
+
+
+def row_parallel(
+    x_shard: jax.Array,
+    w_shard: jax.Array,
+    b: Optional[jax.Array] = None,
+    axis_name: str = MODEL_AXIS,
+) -> jax.Array:
+    """``y = psum_over_shards(x[shard] @ W[shard, :]) + b``. Input is
+    feature-sharded (a column-parallel output); ONE psum completes the
+    contraction. The bias is added once, after the reduction."""
+    y = lax.psum(x_shard @ w_shard, axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def tp_mlp(
+    x: jax.Array,
+    w1_shard: jax.Array,
+    b1_shard: Optional[jax.Array],
+    w2_shard: jax.Array,
+    b2: Optional[jax.Array],
+    axis_name: str = MODEL_AXIS,
+    activation: Callable[[jax.Array], jax.Array] = jax.nn.gelu,
+) -> jax.Array:
+    """The Megatron MLP: column-parallel up-projection, elementwise
+    nonlinearity on the local shard, row-parallel down-projection — one
+    psum total. ``w1``: (D, H) sharded on H; ``w2``: (H, D) sharded on H
+    (its input dim)."""
+    h = activation(column_parallel(x, w1_shard, b1_shard))
+    return row_parallel(h, w2_shard, b2, axis_name)
+
+
+def tp_attention(
+    x: jax.Array,
+    wq_shard: jax.Array,
+    wk_shard: jax.Array,
+    wv_shard: jax.Array,
+    wo_shard: jax.Array,
+    axis_name: str = MODEL_AXIS,
+    *,
+    n_local_heads: int,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Megatron attention: Q/K/V projections column-parallel by head
+    group (each device owns ``n_local_heads`` heads end-to-end), full
+    softmax attention over the local heads, output projection
+    row-parallel — one psum total.
+
+    ``x``: (B, L, D) replicated over the axis; ``wq/k/v_shard``:
+    (D, n_local_heads·Dh); ``wo_shard``: (n_local_heads·Dh, D).
+    """
+    from tpu_syncbn.parallel.sequence import _single_device_attention
+
+    b, l, _ = x.shape
+    hd = wq_shard.shape[-1]
+    if hd % n_local_heads:
+        raise ValueError(
+            f"shard width {hd} not divisible by n_local_heads {n_local_heads}"
+        )
+    dh = hd // n_local_heads
+
+    def heads(w):
+        return (x @ w).reshape(b, l, n_local_heads, dh)
+
+    o = _single_device_attention(
+        heads(wq_shard), heads(wk_shard), heads(wv_shard),
+        causal=causal, scale=scale,
+    )
+    return row_parallel(o.reshape(b, l, hd), wo_shard, None, axis_name)

@@ -620,10 +620,17 @@ class DataParallel:
         opt_state is NOT — under ``zero`` its flat vectors carry the
         world-size-dependent padded layout, so resume into a trainer
         built with the same ``zero`` flag AND world size (checked)."""
-        if self.zero:
-            want = jax.tree_util.tree_map(
-                lambda l: l.shape, self.opt_state
+        want_def = jax.tree_util.tree_structure(self.opt_state)
+        got_def = jax.tree_util.tree_structure(state["opt_state"])
+        if want_def != got_def:
+            raise ValueError(
+                "opt_state structure mismatch: this checkpoint was saved "
+                f"by a trainer with a different `zero` setting than this "
+                f"one (zero={self.zero}). Rebuild the trainer with the "
+                "same zero flag to resume the optimizer state."
             )
+        if self.zero:
+            want = jax.tree_util.tree_map(lambda l: l.shape, self.opt_state)
             got = jax.tree_util.tree_map(
                 lambda l: jnp.shape(l), state["opt_state"]
             )
