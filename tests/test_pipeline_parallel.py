@@ -1,0 +1,98 @@
+"""Pipeline-parallel exactness: the GPipe microbatch schedule over N
+stage-owning devices must equal running the stages sequentially —
+forward and gradients — on the 8-virtual-device CPU mesh.
+
+PP is absent from the reference (SURVEY §2); the contract is
+self-consistency of the beyond-reference extension.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tpu_syncbn.parallel import pipeline as pp
+
+MB, FEAT = 4, 8  # microbatch size, feature width
+
+
+def mesh_of(n):
+    return Mesh(np.array(jax.devices()[:n]), (pp.PIPE_AXIS,))
+
+
+def stage_fn(params, x):
+    w, b = params["w"], params["b"]
+    return jnp.tanh(x @ w + b)
+
+
+def make_stacked(n_stages, seed=0):
+    r = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(
+            r.standard_normal((n_stages, FEAT, FEAT)).astype(np.float32) * 0.5
+        ),
+        "b": jnp.asarray(
+            r.standard_normal((n_stages, FEAT)).astype(np.float32) * 0.1
+        ),
+    }
+
+
+def sequential(stacked, microbatches):
+    n = stacked["w"].shape[0]
+
+    def run_one(x):
+        for s in range(n):
+            x = stage_fn(jax.tree_util.tree_map(lambda p: p[s], stacked), x)
+        return x
+
+    return jax.vmap(run_one)(microbatches)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+@pytest.mark.parametrize("m", [1, 3, 8])
+def test_forward_matches_sequential(n, m):
+    stacked = make_stacked(n)
+    x = jnp.asarray(
+        np.random.default_rng(1).standard_normal((m, MB, FEAT)).astype(np.float32)
+    )
+    f = jax.jit(pp.pipeline_parallel(stage_fn, mesh_of(n)))
+    got = f(stacked, x)
+    want = sequential(stacked, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_gradients_match_sequential():
+    n, m = 4, 6
+    stacked = make_stacked(n, seed=2)
+    x = jnp.asarray(
+        np.random.default_rng(3).standard_normal((m, MB, FEAT)).astype(np.float32)
+    )
+    f = pp.pipeline_parallel(stage_fn, mesh_of(n))
+
+    def loss_pp(stacked, x):
+        return jnp.sum(f(stacked, x) ** 2)
+
+    def loss_seq(stacked, x):
+        return jnp.sum(sequential(stacked, x) ** 2)
+
+    g_got = jax.jit(jax.grad(loss_pp, argnums=(0, 1)))(stacked, x)
+    g_want = jax.grad(loss_seq, argnums=(0, 1))(stacked, x)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_got), jax.tree_util.tree_leaves(g_want)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_schedule_is_one_scan():
+    """Compile size must be O(1) in microbatch count: the schedule is a
+    single scan (one while-loop in HLO), not an unrolled tick sequence."""
+    n = 4
+    stacked = make_stacked(n)
+    f = jax.jit(pp.pipeline_parallel(stage_fn, mesh_of(n)))
+    x8 = jnp.zeros((8, MB, FEAT), jnp.float32)
+    x3 = jnp.zeros((3, MB, FEAT), jnp.float32)
+    hlo8 = f.lower(stacked, x8).compile().as_text()
+    hlo3 = f.lower(stacked, x3).compile().as_text()
+    assert hlo8.count("collective-permute") == hlo3.count("collective-permute")
+    assert "while" in hlo8

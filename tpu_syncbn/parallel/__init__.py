@@ -34,6 +34,10 @@ from tpu_syncbn.parallel.tensor import (
     tp_attention,
     tp_mlp,
 )
+from tpu_syncbn.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_parallel,
+)
 
 __all__ = [
     "GANTrainer",
@@ -64,4 +68,6 @@ __all__ = [
     "row_parallel",
     "tp_attention",
     "tp_mlp",
+    "pipeline_apply",
+    "pipeline_parallel",
 ]

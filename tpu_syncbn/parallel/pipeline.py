@@ -1,0 +1,138 @@
+"""Pipeline parallelism: a GPipe-style microbatch schedule over a ``pipe``
+mesh axis.
+
+Absent from the reference (SURVEY §2's parallelism inventory — the recipe
+is pure DP); implemented as the final member of the beyond-reference set
+(sequence, expert, tensor, ZeRO). The TPU-native shape:
+
+* each device owns ONE stage's parameters (sharded ``P("pipe", ...)``
+  with a leading stage axis — no device ever holds another stage);
+* microbatches stream through the ring: at schedule tick ``t`` device
+  ``s`` runs its stage on microbatch ``t - s`` (when in range) and
+  passes the activation to its right neighbor with ``ppermute`` — the
+  same neighbor cycle as ring attention and ``ring_all_reduce``;
+* the schedule is a single ``lax.scan`` of ``M + N - 1`` ticks (compile
+  size O(1) in both microbatch count and world size), every device
+  executing the identical program each tick — SPMD lockstep, the GPipe
+  "fill/drain bubble" appearing as masked ticks rather than idle
+  processes.
+
+Exactness: the pipeline output equals running the N stages sequentially
+on each microbatch — forward and gradients (autodiff transposes the
+``ppermute`` schedule into the reverse-direction backward pipeline
+automatically). Pinned in ``tests/test_pipeline_parallel.py``.
+
+Scope note: this is the *schedule* primitive (the hard SPMD part). It
+composes with the DP trainer the way the other axes do — a 2-D
+(data × pipe) mesh, DP outside, pipeline inside.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PIPE_AXIS = "pipe"
+
+Pytree = Any
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Pytree, jax.Array], jax.Array],
+    stage_params: Pytree,
+    microbatches: jax.Array,
+    axis_name: str = PIPE_AXIS,
+) -> jax.Array:
+    """Run ``N = axis_size`` stages over ``M`` microbatches, GPipe-style.
+
+    Shard-level function (call inside ``shard_map``):
+
+    Args:
+      stage_fn: ``(params_for_my_stage, x) -> y`` — one stage. Every
+        stage must map activations of the same shape/dtype (the shape
+        that travels the ring); project in/out around the pipeline.
+      stage_params: THIS device's stage parameters (under ``shard_map``,
+        pass the stacked ``(N, ...)`` tree with ``P(axis, ...)`` specs
+        and strip the local leading axis of 1 before calling, or pass
+        already-local params — see the wrapper in the tests).
+      microbatches: ``(M, mb, ...)`` — identical on every device
+        (replicated in-spec); device 0 consumes them in order.
+
+    Returns:
+      ``(M, mb, ...)`` outputs. Only stage ``N-1``'s copy is the true
+      pipeline output (under shard_map, use an out-spec of
+      ``P(axis, ...)`` on a leading stage axis and take the last row, or
+      psum-mask — the array-level helper below does the latter).
+    """
+    n = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    right = [(i, (i + 1) % n) for i in range(n)]
+
+    def tick(carry, t):
+        acc, inbound = carry
+        # device s works on microbatch t - s at tick t
+        mb_idx = t - s
+        active = (mb_idx >= 0) & (mb_idx < m)
+        # stage 0 reads from the feed; others read the neighbor hand-off
+        feed = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(mb_idx, 0, m - 1), keepdims=False
+        )
+        x = jnp.where(s == 0, feed, inbound)
+        y = stage_fn(stage_params, x)
+        # keep the ring clean: inactive ticks forward zeros
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        # last stage banks its result; every device runs the same update
+        acc = lax.dynamic_update_index_in_dim(
+            acc,
+            jnp.where(active & (s == n - 1), y, lax.dynamic_index_in_dim(
+                acc, jnp.clip(mb_idx, 0, m - 1), keepdims=False
+            )),
+            jnp.clip(mb_idx, 0, m - 1),
+            axis=0,
+        )
+        outbound = lax.ppermute(y, axis_name, right)
+        return (acc, outbound), None
+
+    from tpu_syncbn.parallel.collectives import pcast_varying
+
+    acc0 = jnp.zeros((m,) + mb_shape, microbatches.dtype)
+    inb0 = jnp.zeros(mb_shape, microbatches.dtype)
+    (acc, _), _ = lax.scan(
+        tick, pcast_varying((acc0, inb0), axis_name), jnp.arange(m + n - 1)
+    )
+    return acc
+
+
+def pipeline_parallel(
+    stage_fn: Callable[[Pytree, jax.Array], jax.Array],
+    mesh,
+    axis_name: str = PIPE_AXIS,
+):
+    """Array-level wrapper: returns ``f(stacked_params, microbatches)``
+    where ``stacked_params`` has a leading stage axis on every leaf and
+    ``microbatches`` is ``(M, mb, ...)``. The result is the true pipeline
+    output (stage ``N-1``'s), extracted with a psum over a one-hot stage
+    mask so the out-spec stays replicated."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def shardwise(stacked_local, microbatches):
+        params = jax.tree_util.tree_map(lambda x: x[0], stacked_local)
+        acc = pipeline_apply(stage_fn, params, microbatches, axis_name)
+        n = lax.axis_size(axis_name)
+        is_last = lax.axis_index(axis_name) == n - 1
+        return lax.psum(
+            jnp.where(is_last, acc, jnp.zeros_like(acc)), axis_name
+        )
+
+    return shard_map(
+        shardwise,
+        mesh=mesh,
+        in_specs=(P(axis_name), P()),  # spec broadcasts over the param tree
+        out_specs=P(),
+    )

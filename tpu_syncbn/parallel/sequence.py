@@ -109,14 +109,17 @@ def ring_attention(
     q_pos = me * l_q + jnp.arange(l_q)  # global query positions
     fwd = [(i, (i + 1) % n) for i in range(n)]
 
-    def varying(x):  # scan carries must match the body's device-varying type
-        if axis_name in getattr(jax.typeof(x), "vma", frozenset()):
-            return x
-        return lax.pcast(x, axis_name, to="varying")
+    from tpu_syncbn.parallel.collectives import pcast_varying
 
-    o0 = varying(jnp.zeros((b, l_q, h, d), jnp.float32))
-    l0 = varying(jnp.zeros((b, l_q, h), jnp.float32))
-    m0 = varying(jnp.full((b, l_q, h), _NEG_BIG, jnp.float32))
+    # scan carries must match the body's device-varying type
+    o0, l0, m0 = pcast_varying(
+        (
+            jnp.zeros((b, l_q, h, d), jnp.float32),
+            jnp.zeros((b, l_q, h), jnp.float32),
+            jnp.full((b, l_q, h), _NEG_BIG, jnp.float32),
+        ),
+        axis_name,
+    )
 
     def bias_for(src):
         """Additive mask for the KV block that started on device ``src``."""

@@ -625,9 +625,10 @@ class DataParallel:
         if want_def != got_def:
             raise ValueError(
                 "opt_state structure mismatch: this checkpoint was saved "
-                f"by a trainer with a different `zero` setting than this "
-                f"one (zero={self.zero}). Rebuild the trainer with the "
-                "same zero flag to resume the optimizer state."
+                "by a trainer with a different optimizer or a different "
+                f"`zero` setting than this one (zero={self.zero}). Rebuild "
+                "the trainer with the same optimizer and zero flag to "
+                "resume the optimizer state."
             )
         if self.zero:
             want = jax.tree_util.tree_map(lambda l: l.shape, self.opt_state)
