@@ -85,8 +85,9 @@ def test_gradients_match_sequential():
 
 
 def test_schedule_is_one_scan():
-    """Compile size must be O(1) in microbatch count: the schedule is a
-    single scan (one while-loop in HLO), not an unrolled tick sequence."""
+    """Compile size must be O(1) in BOTH microbatch count and world size:
+    the schedule is a single scan (one while-loop in HLO), not an
+    unrolled tick sequence."""
     n = 4
     stacked = make_stacked(n)
     f = jax.jit(pp.pipeline_parallel(stage_fn, mesh_of(n)))
@@ -96,3 +97,12 @@ def test_schedule_is_one_scan():
     hlo3 = f.lower(stacked, x3).compile().as_text()
     assert hlo8.count("collective-permute") == hlo3.count("collective-permute")
     assert "while" in hlo8
+
+    # world-size invariance: 2 stages vs 8 stages, same collective count
+    f2 = jax.jit(pp.pipeline_parallel(stage_fn, mesh_of(2)))
+    f8 = jax.jit(pp.pipeline_parallel(stage_fn, mesh_of(8)))
+    hlo_n2 = f2.lower(make_stacked(2), x8).compile().as_text()
+    hlo_n8 = f8.lower(make_stacked(8), x8).compile().as_text()
+    assert hlo_n2.count("collective-permute") == hlo_n8.count(
+        "collective-permute"
+    )

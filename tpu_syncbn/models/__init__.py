@@ -1,7 +1,9 @@
 """Model zoo: the architectures named by the reference's capability configs
-(ResNet-18/50, RetinaNet-R50-FPN, DCGAN/SNGAN — BASELINE.json)."""
+(ResNet-18/50, RetinaNet-R50-FPN, DCGAN/SNGAN — BASELINE.json), plus the
+transformer LM that exercises the long-context path."""
 
-from tpu_syncbn.models import detection, gan
+from tpu_syncbn.models import detection, gan, transformer
+from tpu_syncbn.models.transformer import init_transformer_lm, transformer_lm
 from tpu_syncbn.models.gan import (
     DCGANGenerator,
     DCGANDiscriminator,
@@ -41,4 +43,7 @@ __all__ = [
     "resnet101",
     "resnet152",
     "RESNETS",
+    "transformer",
+    "init_transformer_lm",
+    "transformer_lm",
 ]

@@ -1,0 +1,145 @@
+"""Minimal transformer LM composing the sequence-parallel primitives.
+
+The reference repo has no attention or sequence models (SURVEY §5.7);
+this family exists so the framework's long-context support is usable as
+a *model*, not just an op: the same forward runs dense on one device or
+sequence-sharded over a ``seq`` mesh axis (ring or Ulysses attention),
+with bit-compatible results — pinned in ``tests/test_transformer.py``.
+
+Functional style (params as a pytree, pure apply) to match the
+shard_map-level parallel primitives; pre-LN blocks, learned positional
+embeddings, weight-tied output head. Layers are stacked into leading-
+axis pytrees and applied with ``lax.scan`` so compile size is O(1) in
+depth.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_syncbn.parallel.sequence import (
+    _single_device_attention,
+    ring_attention,
+    ulysses_attention,
+)
+
+
+def init_transformer_lm(
+    rng: jax.Array,
+    *,
+    vocab: int,
+    d_model: int,
+    n_heads: int,
+    n_layers: int,
+    d_ff: int,
+    max_len: int,
+    dtype=jnp.float32,
+):
+    """Parameter pytree for :func:`transformer_lm`. Embedding is tied to
+    the output head."""
+    if d_model % n_heads:
+        raise ValueError(f"d_model {d_model} % n_heads {n_heads} != 0")
+    # exactly the keys consumed: embed, pos, and 4 matrices per layer
+    # (the LN scales init to ones)
+    k = iter(jax.random.split(rng, 2 + 4 * n_layers))
+
+    def dense(key, shape, scale=None):
+        scale = scale if scale is not None else shape[0] ** -0.5
+        return (jax.random.normal(key, shape) * scale).astype(dtype)
+
+    def stack(maker):
+        return jnp.stack([maker(next(k)) for _ in range(n_layers)])
+
+    return {
+        "embed": dense(next(k), (vocab, d_model), scale=0.02),
+        "pos": dense(next(k), (max_len, d_model), scale=0.02),
+        "blocks": {
+            "ln1_scale": jnp.ones((n_layers, d_model), dtype),
+            "ln2_scale": jnp.ones((n_layers, d_model), dtype),
+            "wqkv": stack(lambda key: dense(key, (d_model, 3 * d_model))),
+            "wo": stack(lambda key: dense(key, (d_model, d_model))),
+            "w1": stack(lambda key: dense(key, (d_model, d_ff))),
+            "w2": stack(lambda key: dense(key, (d_ff, d_model))),
+        },
+        "ln_f_scale": jnp.ones((d_model,), dtype),
+    }
+
+
+def _rms_norm(x, scale):
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+    return (y * scale).astype(x.dtype)
+
+
+def _attend(q, k, v, impl: Optional[str], axis_name: Optional[str]):
+    if impl is None or axis_name is None:
+        return _single_device_attention(q, k, v, causal=True, scale=None)
+    if impl == "ring":
+        return ring_attention(q, k, v, axis_name, causal=True)
+    if impl == "ulysses":
+        return ulysses_attention(q, k, v, axis_name, causal=True)
+    raise ValueError(f"unknown attention impl {impl!r}")
+
+
+def transformer_lm(
+    params,
+    tokens: jax.Array,
+    *,
+    n_heads: int,
+    attn_impl: Optional[str] = None,
+    axis_name: Optional[str] = None,
+    pos_offset: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Causal LM forward: ``tokens`` (B, L) int32 → logits (B, L, vocab).
+
+    Dense by default. Inside a ``shard_map`` over a ``seq`` axis, pass
+    ``attn_impl="ring"`` (or ``"ulysses"``) and the axis name; ``tokens``
+    is then the local sequence shard and ``pos_offset`` defaults to
+    ``axis_index * L_local`` so positional embeddings line up with the
+    global positions — attention is the only cross-shard op in a
+    transformer, so everything else needs no change. ``n_heads`` is
+    static (it shapes the reshape), so it rides as a kwarg, not a param
+    leaf.
+    """
+    b, l = tokens.shape
+    max_len = params["pos"].shape[0]
+    if pos_offset is None:
+        # dynamic_slice CLAMPS an out-of-range start, which would silently
+        # reuse trailing positions on far shards — check at trace time
+        # (axis_size is static) instead
+        n_shards = 1 if axis_name is None else lax.axis_size(axis_name)
+        if n_shards * l > max_len:
+            raise ValueError(
+                f"global sequence {n_shards * l} exceeds max_len {max_len}"
+            )
+        pos_offset = (
+            jnp.int32(0) if axis_name is None else lax.axis_index(axis_name) * l
+        )
+
+    x = params["embed"][tokens]
+    x = x + lax.dynamic_slice_in_dim(params["pos"], pos_offset, l)
+
+    d_model = x.shape[-1]
+    dh = d_model // n_heads
+
+    def block(x, p):
+        h = _rms_norm(x, p["ln1_scale"])
+        qkv = h @ p["wqkv"]
+        q, k_, v = jnp.split(qkv, 3, axis=-1)
+        shp = (b, l, n_heads, dh)
+        o = _attend(
+            q.reshape(shp), k_.reshape(shp), v.reshape(shp),
+            attn_impl, axis_name,
+        )
+        x = x + o.reshape(b, l, d_model) @ p["wo"]
+        h = _rms_norm(x, p["ln2_scale"])
+        x = x + jax.nn.gelu(h @ p["w1"]) @ p["w2"]
+        return x, None
+
+    x, _ = lax.scan(block, x, params["blocks"])
+    x = _rms_norm(x, params["ln_f_scale"])
+    return (x @ params["embed"].T).astype(jnp.float32)

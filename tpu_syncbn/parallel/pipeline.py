@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpu_syncbn.parallel.collectives import pcast_varying
+
 PIPE_AXIS = "pipe"
 
 Pytree = Any
@@ -97,8 +99,6 @@ def pipeline_apply(
         )
         outbound = lax.ppermute(y, axis_name, right)
         return (acc, outbound), None
-
-    from tpu_syncbn.parallel.collectives import pcast_varying
 
     acc0 = jnp.zeros((m,) + mb_shape, microbatches.dtype)
     inb0 = jnp.zeros(mb_shape, microbatches.dtype)

@@ -42,6 +42,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_syncbn.parallel.collectives import pcast_varying
+
 SEQ_AXIS = "seq"
 
 # finite stand-in for -inf in masked logits: keeps the online-softmax
@@ -108,8 +110,6 @@ def ring_attention(
     l_k = k.shape[1]
     q_pos = me * l_q + jnp.arange(l_q)  # global query positions
     fwd = [(i, (i + 1) % n) for i in range(n)]
-
-    from tpu_syncbn.parallel.collectives import pcast_varying
 
     # scan carries must match the body's device-varying type
     o0, l0, m0 = pcast_varying(
