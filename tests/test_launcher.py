@@ -35,6 +35,16 @@ def test_launch_example_simulated_chips():
     assert "loss" in res.stdout
 
 
+@pytest.mark.slow
+def test_launch_parallelism_tour():
+    """The tour example must pass every mode's oracle check end-to-end
+    through the launcher (4 simulated chips keeps it quick)."""
+    res = run_launch(["--simulate-chips", "4", "examples/parallelism_tour.py"])
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert "tour complete" in res.stdout
+    assert "FAIL" not in res.stdout
+
+
 def test_launch_bad_simulate_chips():
     res = run_launch(["--simulate-chips", "0", "examples/distributed_train.py"])
     assert res.returncode != 0
