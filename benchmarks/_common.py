@@ -4,6 +4,15 @@ repo-root imports."""
 
 import os
 import sys
+import time
+
+
+def log(*a, ts: bool = False) -> None:
+    """Stderr progress line (stdout is reserved for the final JSON);
+    ``ts=True`` prefixes a timestamp for long-running watchers."""
+    if ts:
+        a = (time.strftime("[%H:%M:%S]"),) + a
+    print(*a, file=sys.stderr, flush=True)
 
 
 def setup(simulate: int | None, *, needs_backend: bool = True) -> None:

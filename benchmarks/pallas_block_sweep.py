@@ -13,10 +13,11 @@ unless --allow-cpu).
 
 import argparse
 import json
+import os
 import sys
 import time
 
-from _common import setup
+from _common import log, setup
 
 
 def parse_args():
@@ -29,6 +30,14 @@ def parse_args():
                    help="clip each shape's M (CPU smoke runs: interpret "
                         "mode at full R50 sizes is impractical)")
     p.add_argument("--simulate", type=int, default=None)
+    p.add_argument("--budget-s", type=float, default=None,
+                   help="wall-clock budget: stop starting new blocks once "
+                        "exceeded and report whatever finished (tunnel "
+                        "compiles are slow; a killed sweep reports nothing)")
+    p.add_argument("--partial-out", default=None,
+                   help="write the running result JSON here after every "
+                        "shape so a timeout still leaves evidence; if the "
+                        "file already exists its timings seed a resume")
     return p.parse_args()
 
 
@@ -67,20 +76,89 @@ def main():
         shapes = [(min(m, args.max_rows), c) for m, c in shapes]
 
     default_block = pallas_bn._BLOCK_M
-    blocks = list(args.blocks)
-    if default_block not in blocks:
-        blocks.append(default_block)  # the baseline must be measured
+    # baseline first: under a wall-clock budget the blocks measured last
+    # are the first casualties, and a sweep without the default measured
+    # cannot report speedup_vs_default
+    blocks = [default_block] + [b for b in args.blocks if b != default_block]
 
     rng = np.random.RandomState(0)
     results: dict[int, float] = {}
     failures: dict[str, str] = {}
+    # per-shape timings, keyed "block:MxC" — this is the resume unit: a
+    # budget-killed run leaves them in --partial-out, and the next run
+    # (tunnel windows are scarce) skips every shape already measured.
+    # The config fingerprint (incl. a hash of the kernel source) keeps a
+    # stale file from silently replacing fresh measurements; recorded
+    # failures are NOT resumed — a tunnel death mid-compile looks the
+    # same as a real VMEM overflow, and only a retry can tell them apart.
+    import hashlib
+
+    kernel_sha = hashlib.sha256(
+        open(pallas_bn.__file__, "rb").read()
+    ).hexdigest()[:16]
+    # backend is part of the fingerprint: interpret-mode CPU timings must
+    # never seed a TPU sweep (or vice versa)
+    config = {"iters": args.iters, "max_rows": args.max_rows,
+              "kernel_sha": kernel_sha, "backend": jax.default_backend()}
+    shape_ms: dict[str, float] = {}
+    if args.partial_out and os.path.exists(args.partial_out):
+        try:
+            with open(args.partial_out) as f:
+                prev = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            # a hard kill mid-write used to be able to truncate the file;
+            # writes are atomic now, but stay loud rather than silent
+            log(f"[sweep] unreadable partial file {args.partial_out} "
+                f"({type(e).__name__}: {e}); starting fresh")
+            prev = {}
+        if prev.get("config") == config:
+            shape_ms.update(prev.get("shape_ms", {}))
+            if shape_ms:
+                log(f"[sweep] resuming: {len(shape_ms)} shape timing(s) "
+                    f"from {args.partial_out}")
+        elif prev:
+            log(f"[sweep] ignoring {args.partial_out}: config changed "
+                f"({prev.get('config')} -> {config})")
+    t_start = time.perf_counter()
+    budget_exhausted = False
+
+    def write_partial(done: bool = False):
+        if args.partial_out:
+            payload = {"by_block": {str(k): v for k, v in results.items()},
+                       "shape_ms": shape_ms, "config": config,
+                       "failures": failures, "partial": not done}
+            tmp = args.partial_out + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, args.partial_out)  # survive a mid-write SIGKILL
+
     try:
         for block in blocks:
+            if args.budget_s and time.perf_counter() - t_start > args.budget_s:
+                budget_exhausted = True
+                log(f"[sweep] budget {args.budget_s}s exhausted; stopping "
+                    f"after {len(results)} block(s)")
+                break
             pallas_bn._BLOCK_M = block
             jax.clear_caches()  # _BLOCK_M is baked into traced kernels
             total = 0.0
             ok = True
             for m, c in shapes:
+                key = f"{block}:{m}x{c}"
+                if key in shape_ms:
+                    total += shape_ms[key] / 1e3
+                    continue
+                # re-check inside the block: one block's five tunnel
+                # compiles can overshoot the budget into the caller's
+                # hard kill, which loses the final JSON entirely
+                if (args.budget_s
+                        and time.perf_counter() - t_start > args.budget_s):
+                    budget_exhausted = True
+                    log(f"[sweep] budget exhausted mid-block {block}; "
+                        "its measured shapes are saved for resume")
+                    ok = False
+                    break
+                log(f"[sweep] block={block} shape=({m},{c}) compiling...")
                 x = jnp.asarray(rng.randn(m, c).astype(np.float32) * 0.5)
                 w = jnp.ones((c,), jnp.float32)
                 b = jnp.zeros((c,), jnp.float32)
@@ -105,12 +183,18 @@ def main():
                 for _ in range(args.iters):
                     out = g(x)
                 out.block_until_ready()
-                total += (time.perf_counter() - t0) / args.iters
+                dt = (time.perf_counter() - t0) / args.iters
+                log(f"[sweep] block={block} shape=({m},{c}) {dt*1e3:.3f} ms")
+                shape_ms[key] = round(dt * 1e3, 4)
+                write_partial()  # every shape is tunnel time worth keeping
+                total += dt
             if ok:
                 results[block] = round(total * 1e3, 3)
+            write_partial()
     finally:
         pallas_bn._BLOCK_M = default_block
 
+    write_partial(done=not budget_exhausted)
     best = min(results, key=results.get) if results else None
     print(json.dumps({
         "metric": "pallas_block_sweep",
@@ -118,6 +202,9 @@ def main():
         "backend": jax.default_backend(),
         "by_block": {str(k): v for k, v in results.items()},
         "failures": failures,
+        "budget_exhausted": budget_exhausted,
+        "blocks_requested": args.blocks,
+        "blocks_planned": blocks,  # execution order: default first
         "best_block": best,
         "current_default": default_block,
         "speedup_vs_default": (

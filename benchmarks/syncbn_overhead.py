@@ -70,6 +70,7 @@ def main():
     print(json.dumps({
         "metric": "syncbn_overhead",
         "arch": args.arch,
+        "backend": jax.default_backend(),
         "chips": n,
         "sync_ms_per_step": round(sync_ms, 3),
         "local_bn_ms_per_step": round(local_ms, 3),

@@ -27,15 +27,13 @@ import subprocess
 import sys
 import time
 
+from _common import log
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ART = os.path.join(ROOT, "benchmarks", "artifacts")
 
 STAGES = ["pallas_parity", "pallas_sweep", "syncbn_overhead",
           "buffer_broadcast", "bench"]
-
-
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
 
 
 def save(name, payload):
@@ -55,9 +53,10 @@ def stage_pallas_parity():
     from tpu_syncbn.ops import batch_norm as bn_ops
     from tpu_syncbn.ops import pallas_bn as pb
 
-    results = {"backend": "tpu", "cases": []}
+    results = {"backend": "tpu", "cases": [], "complete": False}
     try:
         _pallas_parity_cases(jax, jnp, np, bn_ops, pb, results)
+        results["complete"] = True  # a mid-stage tunnel death stays retryable
     finally:
         # tunnel sessions are scarce: keep the evidence of cases that
         # already passed even when a later case fails
@@ -164,6 +163,13 @@ def run_sub(name, cmd):
             f"{name} ran on backend={backend!r}, not the TPU "
             "(tunnel dropped mid-battery?)"
         )
+    if parsed.get("budget_exhausted"):
+        # rc=0 so the partial evidence is saved, but the stage is NOT
+        # complete — a direct battery run must not report it passed
+        raise RuntimeError(
+            f"{name} ran out of wall-clock budget before measuring every "
+            "candidate; rerun to resume from the partial file"
+        )
 
 
 def main():
@@ -186,7 +192,9 @@ def main():
                 stage_pallas_parity()
             elif stage == "pallas_sweep":
                 run_sub(stage, [sys.executable, "benchmarks/pallas_block_sweep.py",
-                                "--iters", "20"])
+                                "--iters", "10", "--budget-s", "1400",
+                                "--partial-out",
+                                os.path.join(ART, "tpu_pallas_sweep_partial.json")])
             elif stage == "syncbn_overhead":
                 run_sub(stage, [sys.executable, "benchmarks/syncbn_overhead.py",
                                 "--arch", "resnet50", "--per-chip-batch", "32",

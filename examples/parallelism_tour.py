@@ -17,7 +17,6 @@ or directly on whatever devices the backend offers:
     python examples/parallelism_tour.py
 """
 
-import functools
 
 import numpy as np
 
@@ -61,6 +60,11 @@ def main():
     y = jnp.asarray(rng.integers(0, 10, (2 * n,)).astype(np.int32))
     dp = parallel.DataParallel(model, optax.sgd(0.1, momentum=0.9), loss_fn, mesh=mesh)
     out = dp.train_step((x, y))
+    # the ZeRO check below compares against this run, so a shared defect
+    # would pass both; at minimum the loss must be finite
+    if not bool(jnp.isfinite(out.loss)):
+        runtime.master_print(f"  [FAIL] DP + SyncBN loss = {float(out.loss)}")
+        raise SystemExit(1)
     runtime.master_print(f"  [PASS] {'DP + SyncBN':34s} loss = {float(out.loss):.4f}")
 
     # -- 2. ZeRO: sharded params + optimizer ------------------------------
