@@ -187,7 +187,9 @@ def main():
                 log(f"[sweep] block={block} shape=({m},{c}) {dt*1e3:.3f} ms")
                 shape_ms[key] = round(dt * 1e3, 4)
                 write_partial()  # every shape is tunnel time worth keeping
-                total += dt
+                # accumulate the ROUNDED value so a resumed run rebuilds a
+                # bit-identical by_block from the same shape_ms entries
+                total += shape_ms[key] / 1e3
             if ok:
                 results[block] = round(total * 1e3, 3)
             write_partial()

@@ -102,6 +102,10 @@ def main():
     # line, so one persistently-broken stage cannot starve the rest of a
     # live window; a full cycle of failures earns a sleep (no tight loop)
     demoted: list = []
+    # right after a successful stage the window is known-live, and the
+    # battery child re-probes at startup anyway — only pay the watcher's
+    # own probe when the last attempt failed or we just slept
+    window_live = False
     while time.time() < deadline:
         todo = [s for s in args.stages if not stage_done(s)]
         if not todo:
@@ -109,9 +113,10 @@ def main():
             return 0
         demoted = [s for s in demoted if s in todo]
         ordered = [s for s in todo if s not in demoted] + demoted
-        if probe_live(args.probe_timeout_s):
+        if window_live or probe_live(args.probe_timeout_s):
             stage = ordered[0]
-            if not run_stage(stage, args.stage_timeout_s):
+            window_live = run_stage(stage, args.stage_timeout_s)
+            if not window_live:
                 demoted.append(stage)
                 if set(ordered) == set(demoted):
                     log(f"every pending stage failed this window; "

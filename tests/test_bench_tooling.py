@@ -1,0 +1,139 @@
+"""Pin the TPU-window tooling semantics (watcher stage gating + sweep
+resume) on CPU, so the logic that spends scarce tunnel time is itself
+under test.
+
+Reference parity note: the torch recipe has no benchmark tooling (the
+reference is a 104-line README); this guards OUR hardware-validation
+harness (benchmarks/tpu_watcher.py, benchmarks/pallas_block_sweep.py).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_watcher(tmp_art):
+    spec = importlib.util.spec_from_file_location(
+        "tpu_watcher_under_test",
+        os.path.join(ROOT, "benchmarks", "tpu_watcher.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.ART = str(tmp_art)
+    return mod
+
+
+def _write(tmp_art, stage, payload):
+    with open(os.path.join(str(tmp_art), f"tpu_{stage}.json"), "w") as f:
+        json.dump(payload, f)
+
+
+class TestStageDone:
+    def test_missing_artifact_is_not_done(self, tmp_path):
+        w = _load_watcher(tmp_path)
+        assert not w.stage_done("bench")
+
+    def test_cpu_fallback_artifact_is_not_done(self, tmp_path):
+        # the bench child exits 0 on CPU fallback so the DRIVER always gets
+        # its artifact, but the watcher must keep retrying for a TPU number
+        w = _load_watcher(tmp_path)
+        _write(tmp_path, "bench", {"rc": 0, "parsed": {"backend": "cpu"}})
+        assert not w.stage_done("bench")
+
+    def test_tpu_artifact_is_done(self, tmp_path):
+        w = _load_watcher(tmp_path)
+        _write(tmp_path, "bench", {"rc": 0, "parsed": {"backend": "tpu"}})
+        assert w.stage_done("bench")
+
+    def test_nonzero_rc_is_not_done(self, tmp_path):
+        w = _load_watcher(tmp_path)
+        _write(tmp_path, "bench", {"rc": 1, "parsed": {"backend": "tpu"}})
+        assert not w.stage_done("bench")
+
+    def test_budget_exhausted_sweep_is_retried(self, tmp_path):
+        w = _load_watcher(tmp_path)
+        _write(tmp_path, "pallas_sweep",
+               {"rc": 0, "parsed": {"backend": "tpu",
+                                    "budget_exhausted": True}})
+        assert not w.stage_done("pallas_sweep")
+        _write(tmp_path, "pallas_sweep",
+               {"rc": 0, "parsed": {"backend": "tpu",
+                                    "budget_exhausted": False}})
+        assert w.stage_done("pallas_sweep")
+
+    def test_parity_requires_completion_flag(self, tmp_path):
+        # a window that dies after case 1 of 5 must stay retryable
+        w = _load_watcher(tmp_path)
+        _write(tmp_path, "pallas_parity",
+               {"backend": "tpu", "cases": [{"ok": True}], "complete": False})
+        assert not w.stage_done("pallas_parity")
+        _write(tmp_path, "pallas_parity",
+               {"backend": "tpu", "cases": [{"ok": True}], "complete": True})
+        assert w.stage_done("pallas_parity")
+
+    def test_parity_legacy_artifact_counts_five_cases(self, tmp_path):
+        # artifacts written before the "complete" flag carry all 5 cases
+        w = _load_watcher(tmp_path)
+        _write(tmp_path, "pallas_parity",
+               {"backend": "tpu", "cases": [{"ok": True}] * 5})
+        assert w.stage_done("pallas_parity")
+
+    def test_skipped_artifact_is_not_done(self, tmp_path):
+        w = _load_watcher(tmp_path)
+        _write(tmp_path, "syncbn_overhead",
+               {"rc": 0, "parsed": {"backend": "tpu", "skipped": "no chip"}})
+        assert not w.stage_done("syncbn_overhead")
+
+
+SWEEP_CMD = [
+    sys.executable, os.path.join(ROOT, "benchmarks", "pallas_block_sweep.py"),
+    "--allow-cpu", "--simulate", "1", "--max-rows", "64", "--iters", "1",
+    "--blocks", "128",
+]
+
+
+def _run_sweep(partial, extra=()):
+    proc = subprocess.run(
+        SWEEP_CMD + ["--partial-out", partial] + list(extra),
+        cwd=os.path.join(ROOT, "benchmarks"),
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1]), proc.stderr
+
+
+@pytest.mark.slow
+class TestSweepResume:
+    def test_resume_skips_measured_shapes_and_matches(self, tmp_path):
+        partial = str(tmp_path / "partial.json")
+        first, err1 = _run_sweep(partial)
+        assert "resuming" not in err1
+        assert first["by_block"] and not first["budget_exhausted"]
+        # file is marked complete and carries the config fingerprint
+        saved = json.load(open(partial))
+        assert saved["partial"] is False and "config" in saved
+
+        second, err2 = _run_sweep(partial)
+        assert "resuming" in err2
+        assert "compiling" not in err2  # zero re-measurement
+        assert second["by_block"] == first["by_block"]
+
+    def test_config_change_invalidates_partial(self, tmp_path):
+        partial = str(tmp_path / "partial.json")
+        _run_sweep(partial)
+        _, err = _run_sweep(partial, extra=["--iters", "2"])
+        assert "ignoring" in err and "config changed" in err
+
+    def test_corrupt_partial_is_loud_not_fatal(self, tmp_path):
+        partial = str(tmp_path / "partial.json")
+        with open(partial, "w") as f:
+            f.write('{"trunc')
+        out, err = _run_sweep(partial)
+        assert "unreadable partial file" in err
+        assert out["by_block"]  # sweep still completed from scratch
