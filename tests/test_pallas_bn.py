@@ -147,13 +147,10 @@ def test_module_bn_with_pallas_mode_on():
     x = rand(20)
     outs = {}
     for mode in ("off", "on"):
-        ops.set_pallas_mode(mode)
-        try:
+        with ops.pallas_mode(mode):
             bn = tnn.BatchNorm2d(C)
             y = bn(x)
             outs[mode] = (np.asarray(y), np.asarray(bn.running_var[...]))
-        finally:
-            ops.set_pallas_mode("auto")
     np.testing.assert_allclose(outs["on"][0], outs["off"][0], rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(outs["on"][1], outs["off"][1], rtol=1e-5, atol=1e-6)
 
@@ -232,20 +229,16 @@ def test_trainer_with_pallas_kernels_matches_xla_path():
         jnp.asarray(rng.randint(0, 10, 16).astype(np.int32)),
     )
 
-    mode_before = xops._PALLAS_MODE
-    try:
-        xops.set_pallas_mode("on")
+    with xops.pallas_mode("on"):
         dp_pallas = build()
         assert not dp_pallas._check_vma  # pallas ⇒ checker off
         out_p = dp_pallas.train_step(batch)
-        # the XLA oracle is forced explicitly (ambient mode could be
-        # pallas-active on a TPU host or under TPU_SYNCBN_PALLAS=on)
-        xops.set_pallas_mode("off")
+    # the XLA oracle is forced explicitly (ambient mode could be
+    # pallas-active on a TPU host or under TPU_SYNCBN_PALLAS=on)
+    with xops.pallas_mode("off"):
         dp_xla = build()
         assert dp_xla._check_vma
         out_x = dp_xla.train_step(batch)
-    finally:
-        xops.set_pallas_mode(mode_before)
 
     np.testing.assert_allclose(
         float(out_p.loss), float(out_x.loss), rtol=1e-5
@@ -268,9 +261,7 @@ def test_group_scoped_model_keeps_vma_checker_under_pallas_mode():
     from tpu_syncbn import models, nn, parallel
     from tpu_syncbn.ops import batch_norm as xops
 
-    mode_before = xops._PALLAS_MODE
-    try:
-        xops.set_pallas_mode("on")
+    with xops.pallas_mode("on"):
         m = nn.convert_sync_batchnorm(
             models.resnet18(num_classes=10, small_input=True,
                             rngs=nnx.Rngs(0)),
@@ -293,5 +284,3 @@ def test_group_scoped_model_keeps_vma_checker_under_pallas_mode():
         )
         out = dp.train_step(batch)
         assert np.isfinite(float(out.loss))
-    finally:
-        xops.set_pallas_mode(mode_before)

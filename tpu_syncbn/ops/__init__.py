@@ -2,6 +2,8 @@
 Pallas TPU fast path in pallas_bn)."""
 
 from tpu_syncbn.ops.batch_norm import (
+    get_pallas_mode,
+    pallas_mode,
     set_pallas_mode,
     batch_norm_stats,
     moments_from_stats,
@@ -13,6 +15,8 @@ from tpu_syncbn.ops.batch_norm import (
 )
 
 __all__ = [
+    "get_pallas_mode",
+    "pallas_mode",
     "set_pallas_mode",
     "batch_norm_stats",
     "moments_from_stats",

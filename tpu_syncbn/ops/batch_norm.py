@@ -30,6 +30,7 @@ Layout: channel-last (NHWC / N...C) by default — the TPU-friendly layout
 
 from __future__ import annotations
 
+import contextlib
 import math
 import os
 
@@ -53,6 +54,24 @@ def set_pallas_mode(mode: str) -> None:
     if mode not in ("auto", "on", "off"):
         raise ValueError(f"pallas mode must be auto/on/off, got {mode!r}")
     _PALLAS_MODE = mode
+
+
+def get_pallas_mode() -> str:
+    """The active BN kernel-backend mode ('auto'/'on'/'off')."""
+    return _PALLAS_MODE
+
+
+@contextlib.contextmanager
+def pallas_mode(mode: str):
+    """Scoped :func:`set_pallas_mode`: restores the previous mode on exit.
+    The same trace-time/construction-time caveats apply — build trainers
+    INSIDE the block for the override to take effect."""
+    prev = _PALLAS_MODE
+    set_pallas_mode(mode)
+    try:
+        yield
+    finally:
+        set_pallas_mode(prev)
 
 
 _PALLAS_MODE = "auto"
