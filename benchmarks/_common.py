@@ -28,6 +28,9 @@ def setup(simulate: int | None, *, needs_backend: bool = True) -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        from tpu_syncbn.runtime import probe
+
+        probe.enable_persistent_compilation_cache()
     elif needs_backend:
         # no simulation requested: the accelerator is the target, but a
         # registered-but-dead TPU plugin HANGS jax.devices() — probe it

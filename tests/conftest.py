@@ -25,6 +25,13 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
+# Persistent compilation cache: the suite's wall-clock is dominated by
+# XLA compiles of the same sharded programs every run; cache keys are
+# HLO+options+backend hashes, so reuse is correctness-safe.
+from tpu_syncbn.runtime.probe import enable_persistent_compilation_cache  # noqa: E402
+
+enable_persistent_compilation_cache()
+
 
 def pytest_report_header(config):
     return f"jax devices: {jax.device_count()} ({jax.default_backend()})"

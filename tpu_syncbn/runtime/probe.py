@@ -144,13 +144,48 @@ def _merge_device_count_flag(flags: str, min_devices: int) -> str:
     return " ".join(parts)
 
 
+def enable_persistent_compilation_cache() -> Optional[str]:
+    """Point XLA's persistent compilation cache at a stable directory so
+    recompiles of identical programs are disk hits. This is what makes
+    short accelerator-tunnel windows usable: a benchmark that compiled
+    ResNet-50 in one window re-loads the binary in the next instead of
+    burning the window compiling again. Keyed by HLO + compile options +
+    backend, so it is correctness-safe by construction.
+
+    Honors an explicit ``JAX_COMPILATION_CACHE_DIR``; set
+    ``TPU_SYNCBN_NO_COMPILE_CACHE=1`` to disable. Returns the directory
+    in use, or None when disabled.
+    """
+    if os.environ.get("TPU_SYNCBN_NO_COMPILE_CACHE") == "1":
+        return None
+    # uid-suffixed: a fixed world-shared /tmp path would break (and worse,
+    # be plantable) for the second user on a shared machine
+    path = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        f"/tmp/tpu_syncbn_xla_cache_{os.getuid()}",
+    )
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    # jax's default floor (1s) skips every mid-size program; 0.25s catches
+    # the suite's sharded-step compiles without persisting thousands of
+    # trivial sub-ms jits
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(os.environ.get("TPU_SYNCBN_CACHE_MIN_COMPILE_S", "0.25")),
+    )
+    return path
+
+
 def ensure_backend(min_devices: int = 1) -> BackendInfo:
     """Guarantee a *usable* jax backend with >= ``min_devices`` devices,
     probing the accelerator first and falling back to (virtual) CPU
     devices when it is dead, hung, or too small. Returns what the probe
     (or the fallback decision) established; call before first jax backend
-    touch in the process.
+    touch in the process. Also enables the persistent compilation cache
+    (see :func:`enable_persistent_compilation_cache`).
     """
+    enable_persistent_compilation_cache()
     if os.environ.get("TPU_SYNCBN_FORCE_CPU") == "1":
         force_cpu(min_devices)
         return BackendInfo("cpu", min_devices)
