@@ -1,0 +1,238 @@
+"""Direct tests for exported API that was previously only exercised
+indirectly (or not at all): small samplers, collective reducers, data
+utilities, the profiler context, and model-zoo aliases.
+
+Torch (CPU) is the oracle where the reference stack defines semantics
+(BatchNorm3d vs ``[torch] nn/modules/batchnorm.py``; sampler shapes vs
+``[torch] utils/data/sampler.py``).
+"""
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from tpu_syncbn import data as tdata
+from tpu_syncbn import models, nn, parallel, runtime, utils
+
+
+# ---------------------------------------------------------------- samplers
+def test_sequential_sampler_is_identity_order():
+    s = tdata.SequentialSampler(7)
+    assert list(s) == list(range(7))
+    assert len(s) == 7
+
+
+def test_random_sampler_permutes_and_reshuffles_per_epoch():
+    s = tdata.RandomSampler(32, seed=3)
+    first = list(s)
+    assert sorted(first) == list(range(32))  # a permutation
+    assert list(s) == first  # same epoch -> same order (deterministic)
+    s.set_epoch(1)
+    second = list(s)
+    assert sorted(second) == list(range(32))
+    assert second != first  # reshuffled like DistributedSampler.set_epoch
+
+
+# ------------------------------------------------------------- collectives
+def test_pmax_pmin_across_mesh():
+    mesh = runtime.data_parallel_mesh()
+    n = mesh.devices.size
+    x = jnp.arange(n, dtype=jnp.float32) * 3.0 - 5.0
+
+    def body(xs):
+        return parallel.pmax(xs), parallel.pmin(xs)
+
+    hi, lo = jax.jit(
+        shard_map(body, mesh=mesh, in_specs=P("data"),
+                  out_specs=(P("data"), P("data")))
+    )(x)
+    np.testing.assert_allclose(np.asarray(hi), float(x.max()))
+    np.testing.assert_allclose(np.asarray(lo), float(x.min()))
+
+
+def test_column_then_row_parallel_equals_dense():
+    mesh = runtime.data_parallel_mesh()
+    n = mesh.devices.size
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+    w1 = jnp.asarray(rng.randn(8, 4 * n).astype(np.float32))
+    b1 = jnp.asarray(rng.randn(4 * n).astype(np.float32))
+    w2 = jnp.asarray(rng.randn(4 * n, 8).astype(np.float32))
+    b2 = jnp.asarray(rng.randn(8).astype(np.float32))
+
+    def body(x, w1s, b1s, w2s, b2):
+        h = parallel.column_parallel(x, w1s, b1s)
+        return parallel.row_parallel(h, w2s, b2, axis_name="data")
+
+    y = jax.jit(
+        shard_map(body, mesh=mesh,
+                  in_specs=(P(), P(None, "data"), P("data"),
+                            P("data", None), P()),
+                  out_specs=P())
+    )(x, w1, b1, w2, b2)
+    ref = (x @ w1 + b1) @ w2 + b2
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_sync_module_states_single_host_noop():
+    from flax import nnx
+
+    m = nn.BatchNorm2d(4, rngs=nnx.Rngs(0))
+    before = np.asarray(m.weight[...])
+    parallel.sync_module_states(m)  # process_count()==1 -> no-op
+    np.testing.assert_array_equal(np.asarray(m.weight[...]), before)
+
+
+def test_step_output_fields():
+    import dataclasses
+
+    assert {f.name for f in dataclasses.fields(parallel.StepOutput)} >= {
+        "loss", "metrics"}
+    assert {f.name for f in dataclasses.fields(parallel.GANStepOutput)} >= {
+        "g_loss", "d_loss"}
+
+
+# ---------------------------------------------------------------- nn: BN3d
+def test_batchnorm3d_matches_torch():
+    import torch
+    from flax import nnx
+
+    x = np.random.RandomState(0).randn(2, 3, 4, 5, 6).astype(np.float32)
+    bn = nn.BatchNorm3d(6, rngs=nnx.Rngs(0))
+    y = np.asarray(bn(jnp.asarray(x)))
+
+    tbn = torch.nn.BatchNorm3d(6)
+    # torch is NCDHW; ours is channel-last NDHWC
+    ty = tbn(torch.from_numpy(x.transpose(0, 4, 1, 2, 3)))
+    ty = ty.detach().numpy().transpose(0, 2, 3, 4, 1)
+    np.testing.assert_allclose(y, ty, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(bn.running_var[...]), tbn.running_var.numpy(),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_batchnorm3d_rejects_wrong_rank():
+    from flax import nnx
+
+    bn = nn.BatchNorm3d(6, rngs=nnx.Rngs(0))
+    with pytest.raises(ValueError):
+        bn(jnp.zeros((2, 4, 5, 6)))  # 4-D input into the 5-D variant
+
+
+# ------------------------------------------------------------- data utils
+def test_decode_image_png_roundtrip(tmp_path):
+    from PIL import Image
+
+    arr = np.random.RandomState(0).randint(0, 255, (5, 7, 3), np.uint8)
+    p = str(tmp_path / "x.png")
+    Image.fromarray(arr).save(p)
+    out = tdata.decode_image(p)
+    assert out.shape == (5, 7, 3) and out.dtype == np.uint8
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_decode_image_grayscale_promoted_to_rgb(tmp_path):
+    from PIL import Image
+
+    arr = np.random.RandomState(1).randint(0, 255, (4, 4), np.uint8)
+    p = str(tmp_path / "g.png")
+    Image.fromarray(arr, mode="L").save(p)
+    out = tdata.decode_image(p)
+    assert out.shape == (4, 4, 3)
+    np.testing.assert_array_equal(out[..., 0], out[..., 1])
+
+
+def test_pad_ground_truth_pads_and_truncates():
+    boxes = np.arange(8, dtype=np.float32).reshape(2, 4)
+    labels = np.array([3, 5], np.int64)
+    b, l, v = tdata.pad_ground_truth(boxes, labels, max_boxes=4)
+    assert b.shape == (4, 4) and l.shape == (4,) and v.shape == (4,)
+    np.testing.assert_array_equal(v, [True, True, False, False])
+    np.testing.assert_array_equal(b[:2], boxes)
+    assert b[2:].sum() == 0
+    # truncation: cap below the number of boxes
+    b2, l2, v2 = tdata.pad_ground_truth(boxes, labels, max_boxes=1)
+    assert v2.tolist() == [True] and l2[0] == 3
+
+
+def test_load_cifar10_absent_and_present(tmp_path):
+    assert tdata.load_cifar10(str(tmp_path)) is None  # no dir -> fallback
+
+    base = tmp_path / "cifar-10-batches-py"
+    base.mkdir()
+    rng = np.random.RandomState(0)
+    for i in range(1, 6):
+        batch = {
+            b"data": rng.randint(0, 255, (4, 3072), np.uint8),
+            b"labels": rng.randint(0, 10, 4).tolist(),
+        }
+        with open(base / f"data_batch_{i}", "wb") as f:
+            pickle.dump(batch, f)
+    ds = tdata.load_cifar10(str(tmp_path), train=True)
+    assert ds is not None and len(ds) == 20
+    x, y = ds[0]
+    assert x.shape == (32, 32, 3) and x.dtype == np.float32
+    assert float(x.max()) <= 1.0 and float(x.min()) >= -1.0
+    assert tdata.load_cifar10(str(tmp_path), train=False) is None  # no test_batch
+
+
+def test_worker_info_contract():
+    sentinel = object()
+    info = tdata.WorkerInfo(id=1, num_workers=4, dataset=sentinel)
+    assert (info.id, info.num_workers) == (1, 4)
+    assert info.dataset is sentinel  # the worker's OWN dataset copy
+
+
+# ------------------------------------------------------------------ utils
+def test_profiler_trace_writes_a_trace(tmp_path):
+    log_dir = str(tmp_path / "trace")
+    with utils.profiler_trace(log_dir):
+        jnp.ones(8).block_until_ready()
+    found = []
+    for root, _, files in os.walk(log_dir):
+        found += files
+    assert found, "profiler_trace produced no trace files"
+
+
+def test_profiler_trace_disabled_is_noop(tmp_path):
+    log_dir = str(tmp_path / "trace2")
+    with utils.profiler_trace(log_dir, enabled=False):
+        pass
+    assert not os.path.exists(log_dir) or not os.listdir(log_dir)
+
+
+# ---------------------------------------------------------------- runtime
+def test_distributed_config_defaults_autodetect():
+    cfg = runtime.DistributedConfig()
+    assert cfg.coordinator_address is None
+    assert cfg.num_processes is None and cfg.process_id is None
+
+
+def test_shutdown_is_idempotent_single_host():
+    runtime.shutdown()
+    runtime.shutdown()  # second call must not raise
+    runtime.initialize()  # and the world comes back for later tests
+
+
+# ------------------------------------------------------------------ models
+# (resnet152's torchvision param-count check lives in test_models.py's
+# TORCHVISION_COUNTS table with the rest of the zoo)
+def test_retina_head_shapes():
+    from flax import nnx
+
+    head = models.RetinaHead(
+        channels=8, num_anchors=9, num_classes=5, rngs=nnx.Rngs(0)
+    )
+    # the head runs over a LIST of FPN levels and concatenates anchors
+    cls, box = head([jnp.zeros((2, 4, 4, 8)), jnp.zeros((2, 2, 2, 8))])
+    n_anchors = (4 * 4 + 2 * 2) * 9
+    assert cls.shape == (2, n_anchors, 5)
+    assert box.shape == (2, n_anchors, 4)

@@ -21,6 +21,7 @@ TORCHVISION_COUNTS = {
     "resnet34": 21_797_672,
     "resnet50": 25_557_032,
     "resnet101": 44_549_160,
+    "resnet152": 60_192_808,
 }
 
 
