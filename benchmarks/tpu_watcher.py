@@ -20,9 +20,10 @@ Run it in the background for hours:
     python benchmarks/tpu_watcher.py --max-hours 8
 
 Priority: the headline bench first (one number unblocks BENCH_r{N}),
-then the overhead/broadcast measurements, then the block sweep (longest,
-least critical — budgeted + partial-output so even a dead window leaves
-evidence).
+then entry_compile (pre-warms the driver's end-of-round compile check
+into the persistent cache), then the overhead/broadcast measurements,
+then the block sweep (longest, least critical — budgeted +
+partial-output so even a dead window leaves evidence).
 """
 
 import argparse
@@ -42,7 +43,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ART = os.path.join(ROOT, "benchmarks", "artifacts")
 
 # priority order, not the battery's didactic order
-STAGES = ["bench", "syncbn_overhead", "buffer_broadcast",
+STAGES = ["bench", "entry_compile", "syncbn_overhead", "buffer_broadcast",
           "pallas_parity", "pallas_sweep"]
 
 
@@ -58,6 +59,8 @@ def stage_done(stage: str) -> bool:
         # death; artifacts predating the flag carry all 5 shape cases
         complete = payload.get("complete", len(payload.get("cases", [])) >= 5)
         return bool(complete) and payload.get("backend") == "tpu"
+    if stage == "entry_compile":  # also written in-process (no subprocess)
+        return bool(payload.get("complete")) and payload.get("backend") == "tpu"
     if payload.get("rc") not in (0,):
         return False
     parsed = payload.get("parsed") or {}

@@ -84,6 +84,16 @@ class TestStageDone:
                {"backend": "tpu", "cases": [{"ok": True}] * 5})
         assert w.stage_done("pallas_parity")
 
+    def test_entry_compile_artifact_is_done(self, tmp_path):
+        # shape written by tpu_validation.stage_entry_compile (in-process)
+        w = _load_watcher(tmp_path)
+        _write(tmp_path, "entry_compile",
+               {"backend": "tpu", "compile_s": 12.3, "complete": True})
+        assert w.stage_done("entry_compile")
+        _write(tmp_path, "entry_compile",
+               {"backend": "tpu", "complete": False})  # died mid-compile
+        assert not w.stage_done("entry_compile")
+
     def test_skipped_artifact_is_not_done(self, tmp_path):
         w = _load_watcher(tmp_path)
         _write(tmp_path, "syncbn_overhead",
