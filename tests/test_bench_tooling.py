@@ -90,8 +90,11 @@ class TestStageDone:
         _write(tmp_path, "entry_compile",
                {"backend": "tpu", "compile_s": 12.3, "complete": True})
         assert w.stage_done("entry_compile")
+        # defensive gating: the producer only writes complete:True today
+        # (a mid-compile death leaves NO artifact), but anything short of
+        # complete:True must read as incomplete
         _write(tmp_path, "entry_compile",
-               {"backend": "tpu", "complete": False})  # died mid-compile
+               {"backend": "tpu", "complete": False})
         assert not w.stage_done("entry_compile")
 
     def test_skipped_artifact_is_not_done(self, tmp_path):
