@@ -56,7 +56,17 @@ def stage_pallas_parity():
     from tpu_syncbn.ops import batch_norm as bn_ops
     from tpu_syncbn.ops import pallas_bn as pb
 
+    # Seed with cases a previous window already passed: a watcher-timeout
+    # kill is SIGKILL (no finally runs), so the only evidence that
+    # survives a hang is what was written to disk *per case*.
     results = {"backend": "tpu", "cases": [], "complete": False}
+    try:
+        with open(os.path.join(ART, "tpu_pallas_parity.json")) as f:
+            prev = json.load(f)
+        if prev.get("backend") == "tpu":
+            results["cases"] = [c for c in prev.get("cases", []) if c.get("ok")]
+    except (OSError, json.JSONDecodeError):
+        pass
     try:
         _pallas_parity_cases(jax, jnp, np, bn_ops, pb, results)
         results["complete"] = True  # a mid-stage tunnel death stays retryable
@@ -68,7 +78,11 @@ def stage_pallas_parity():
 
 def _pallas_parity_cases(jax, jnp, np, bn_ops, pb, results):
     rng = np.random.default_rng(0)
+    done = {(c["m"], c["c"]) for c in results["cases"]}
     for (m, c) in [(256, 128), (1024, 64), (4096, 256), (37, 8), (8192, 512)]:
+        if (m, c) in done:
+            log(f"[pallas_parity] (M={m}, C={c}) already passed; skipping")
+            continue
         x = rng.standard_normal((m, c)).astype(np.float32)
         xj = jnp.asarray(x)
         t0 = time.perf_counter()
@@ -124,6 +138,9 @@ def _pallas_parity_cases(jax, jnp, np, bn_ops, pb, results):
             "m": m, "c": c, "ok": True,
             "elapsed_s": round(time.perf_counter() - t0, 2),
         })
+        # per-case write: the watcher's stage timeout is a SIGKILL, which
+        # skips every finally — only what is already on disk survives
+        save("pallas_parity", results)
         log(f"[pallas_parity] (M={m}, C={c}) ok")
 
 

@@ -19,16 +19,26 @@ Run it in the background for hours:
 
     python benchmarks/tpu_watcher.py --max-hours 8
 
-Priority: the headline bench first (one number unblocks BENCH_r{N}),
-then entry_compile (pre-warms the driver's end-of-round compile check
-into the persistent cache), then the overhead/broadcast measurements,
-then the block sweep (longest, least critical — budgeted +
-partial-output so even a dead window leaves evidence).
+Priority: entry_compile FIRST — one window spent pre-warming the
+persistent compilation cache makes every later ``bench`` attempt a
+disk-hit compile instead of a window-sized fresh compile (round 2's
+lesson: bench-first burned the only window on compilation and landed
+nothing). Then the headline bench (one number unblocks BENCH_r{N}),
+then the overhead/broadcast measurements, then the block sweep
+(longest, least critical — budgeted + partial-output so even a dead
+window leaves evidence).
+
+End-of-round discipline: the watcher takes a hard ``--max-hours``
+deadline and will not *start* a stage whose timeout could overrun it
+(the chip must be free when the driver runs bench.py at round end —
+a watcher/driver collision over the single chip is the suspected
+cause of round 1's rc=124). No manual pkill required.
 """
 
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -42,8 +52,9 @@ log = functools.partial(_log, ts=True)
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ART = os.path.join(ROOT, "benchmarks", "artifacts")
 
-# priority order, not the battery's didactic order
-STAGES = ["bench", "entry_compile", "syncbn_overhead", "buffer_broadcast",
+# priority order, not the battery's didactic order: cache prewarm first
+# (amortizes every later stage's compile), then the headline number
+STAGES = ["entry_compile", "bench", "syncbn_overhead", "buffer_broadcast",
           "pallas_parity", "pallas_sweep"]
 
 
@@ -77,17 +88,27 @@ def probe_live(timeout_s: float) -> bool:
 
 
 def run_stage(stage: str, timeout_s: float) -> bool:
-    log(f"TPU live -> running stage {stage!r}")
+    log(f"TPU live -> running stage {stage!r} (budget {timeout_s:.0f}s)")
+    # own session: 4 of 6 stages spawn a grandchild via run_sub, and a
+    # plain child-only kill would leave it holding the chip past the
+    # deadline — the exact collision the deadline exists to prevent
+    proc = subprocess.Popen(
+        [sys.executable, "benchmarks/tpu_validation.py", "--stages", stage],
+        cwd=ROOT, start_new_session=True,
+    )
     try:
-        proc = subprocess.run(
-            [sys.executable, "benchmarks/tpu_validation.py", "--stages", stage],
-            cwd=ROOT, timeout=timeout_s,
-        )
+        rc = proc.wait(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        log(f"stage {stage!r} timed out after {timeout_s}s")
+        log(f"stage {stage!r} timed out after {timeout_s:.0f}s; "
+            "killing its process group")
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        proc.wait()
         return False
-    log(f"stage {stage!r} rc={proc.returncode}")
-    return proc.returncode == 0
+    log(f"stage {stage!r} rc={rc}")
+    return rc == 0
 
 
 def main():
@@ -116,19 +137,30 @@ def main():
             return 0
         demoted = [s for s in demoted if s in todo]
         ordered = [s for s in todo if s not in demoted] + demoted
+        # never START a stage whose timeout could overrun the deadline:
+        # the chip must be free when the driver's end-of-round runs begin.
+        # Coarse pre-probe check, then recompute AFTER the probe (which
+        # itself can take probe_timeout_s out of the margin).
+        if deadline - time.time() - 60 < 120:
+            break
         if window_live or probe_live(args.probe_timeout_s):
+            stage_budget = min(args.stage_timeout_s,
+                               deadline - time.time() - 60)
+            if stage_budget < 120:
+                break
             stage = ordered[0]
-            window_live = run_stage(stage, args.stage_timeout_s)
+            window_live = run_stage(stage, stage_budget)
             if not window_live:
-                demoted.append(stage)
+                if stage not in demoted:
+                    demoted.append(stage)
                 if set(ordered) == set(demoted):
                     log(f"every pending stage failed this window; "
                         f"sleeping {args.poll_s:.0f}s")
                     demoted.clear()
-                    time.sleep(args.poll_s)
+                    time.sleep(min(args.poll_s, max(0.0, deadline - time.time())))
         else:
             log(f"tunnel down (todo: {ordered}); sleeping {args.poll_s:.0f}s")
-            time.sleep(args.poll_s)
+            time.sleep(min(args.poll_s, max(0.0, deadline - time.time())))
     log("max watch time reached; remaining: "
         f"{[s for s in args.stages if not stage_done(s)]}")
     return 1

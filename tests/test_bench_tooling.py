@@ -104,6 +104,42 @@ class TestStageDone:
         assert not w.stage_done("syncbn_overhead")
 
 
+class TestWatcherPolicy:
+    def test_cache_prewarm_precedes_bench(self, tmp_path):
+        # one window of entry_compile makes every later bench attempt a
+        # disk-hit compile; bench-first burned round 2's only window
+        w = _load_watcher(tmp_path)
+        assert w.STAGES.index("entry_compile") < w.STAGES.index("bench")
+
+    def test_stage_order_matches_battery_inventory(self, tmp_path):
+        spec = importlib.util.spec_from_file_location(
+            "tpu_validation_under_test",
+            os.path.join(ROOT, "benchmarks", "tpu_validation.py"),
+        )
+        sys.path.insert(0, os.path.join(ROOT, "benchmarks"))
+        try:
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        finally:
+            sys.path.pop(0)
+        w = _load_watcher(tmp_path)
+        assert set(w.STAGES) == set(mod.STAGES)
+
+
+class TestBenchSemantics:
+    def test_vs_baseline_null_off_tpu(self):
+        spec = importlib.util.spec_from_file_location(
+            "bench_under_test", os.path.join(ROOT, "bench.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        # the TPU line defines the baseline; a fallback line must carry
+        # null so it can never read as a hardware baseline ratio
+        assert mod._vs_baseline("tpu") == 1.0
+        assert mod._vs_baseline("cpu") is None
+        assert mod._vs_baseline("METAL") is None
+
+
 SWEEP_CMD = [
     sys.executable, os.path.join(ROOT, "benchmarks", "pallas_block_sweep.py"),
     "--allow-cpu", "--simulate", "1", "--max-rows", "64", "--iters", "1",

@@ -158,12 +158,31 @@ def enable_persistent_compilation_cache() -> Optional[str]:
     """
     if os.environ.get("TPU_SYNCBN_NO_COMPILE_CACHE") == "1":
         return None
-    # uid-suffixed: a fixed world-shared /tmp path would break (and worse,
-    # be plantable) for the second user on a shared machine
-    path = os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR",
-        f"/tmp/tpu_syncbn_xla_cache_{os.getuid()}",
-    )
+    path = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if path is None:
+        # Cached entries are deserialized compiled executables, so the
+        # directory must not be plantable by another local user. A /tmp
+        # path (even uid-suffixed) can be pre-created by anyone; default
+        # to a user-owned location instead and refuse anything we don't
+        # exclusively own.
+        base = os.environ.get("XDG_CACHE_HOME") or os.path.expanduser(
+            "~/.cache"
+        )
+        path = os.path.join(base, "tpu_syncbn", "xla")
+        try:
+            os.makedirs(path, mode=0o700, exist_ok=True)
+            st = os.stat(path)
+            if st.st_uid != os.getuid() or (st.st_mode & 0o022):
+                print(
+                    f"[tpu_syncbn.probe] compile cache dir {path} is not "
+                    "exclusively user-owned (uid/permission check failed); "
+                    "persistent cache disabled",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                return None
+        except OSError:
+            return None
     import jax
 
     jax.config.update("jax_compilation_cache_dir", path)
