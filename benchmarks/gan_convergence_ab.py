@@ -1,0 +1,187 @@
+"""GAN SyncBN-vs-per-replica-BN convergence A/B at tiny per-chip batch.
+
+GANs are one of the two workload classes the reference recipe *names* as
+needing SyncBN (``README.md:3``: the per-device-BN convergence drop "is
+known to happen for object detection models and GANs"). This benchmark
+runs that named case directly — DCGAN with BatchNorm in both G and D,
+per-chip batch 2 over R replicas — as a three-arm trajectory experiment
+with identical init, data order, and noise streams:
+
+* **oracle**    — 1 device, global batch R*B, plain BN: the statistics
+                  every arm is trying to realize;
+* **syncbn**    — R devices x per-chip B with ``convert_sync_batchnorm``
+                  applied to G and D: cross-replica moments equal the
+                  oracle's batch moments, so both loss curves (D and G)
+                  must track the oracle to float noise;
+* **perreplica**— R devices x per-chip B with plain BN: every shard
+                  normalizes G's fakes and D's activations by 2-sample
+                  statistics — the destabilization the recipe warns about.
+
+Prints one JSON line: mean |loss - oracle| over training for the D and G
+curves of both arms plus the headline divergence ratio
+(perreplica_mae / syncbn_mae over the combined curves).
+
+    python benchmarks/gan_convergence_ab.py --simulate 8 --steps 200 \
+        --per-chip-batch 2 [--curves out.json]
+"""
+
+import argparse
+import json
+
+from _common import ab_divergence_blocks, log, running_stats_vector, setup
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--simulate", type=int, default=8,
+                   help="virtual host devices (the replica count)")
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--per-chip-batch", type=int, default=2)  # config 5 regime
+    p.add_argument("--latent", type=int, default=16)
+    p.add_argument("--width-g", type=int, default=32)
+    p.add_argument("--width-d", type=int, default=16)
+    p.add_argument("--lr", type=float, default=2e-4)
+    p.add_argument("--beta1", type=float, default=0.5)  # DCGAN Adam recipe
+    p.add_argument("--dataset-size", type=int, default=256)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--curves", default=None,
+                   help="write full per-step D/G loss curves to this JSON")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    setup(args.simulate)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax import nnx
+    from jax.sharding import Mesh
+
+    from tpu_syncbn import models, nn, parallel
+
+    R = args.simulate
+    B = args.per_chip_batch
+    global_batch = R * B
+    steps_per_epoch = args.dataset_size // global_batch
+
+    # structured multi-modal "real" data in [-1, 1] (tanh range): smooth
+    # 2-D sinusoid patterns with per-image random frequency/phase — enough
+    # signal that D's task (and therefore its BN statistics) is non-trivial
+    rng = np.random.RandomState(args.seed)
+    t = np.linspace(0, 2 * np.pi, 32, dtype=np.float32)
+    xs = np.empty((args.dataset_size, 32, 32, 3), np.float32)
+    for i in range(args.dataset_size):
+        fx, fy = rng.randint(1, 4, 2)
+        px, py = rng.uniform(0, 2 * np.pi, 2)
+        base = np.outer(np.sin(fx * t + px), np.sin(fy * t + py))
+        xs[i] = np.tanh(
+            base[..., None] + 0.15 * rng.randn(32, 32, 3)
+        ).astype(np.float32)
+
+    def make_models():
+        return (
+            models.DCGANGenerator(
+                latent_dim=args.latent, width=args.width_g,
+                rngs=nnx.Rngs(args.seed),
+            ),
+            models.DCGANDiscriminator(
+                width=args.width_d, rngs=nnx.Rngs(args.seed + 1)
+            ),
+        )
+
+    def batches():
+        """Identical epoch-shuffled real batches + per-step noise pairs
+        for every arm (fresh z for the G sub-step, as in the torch loop)."""
+        order_rng = np.random.RandomState(args.seed + 2)
+        z_rng = np.random.RandomState(args.seed + 3)
+        while True:
+            perm = order_rng.permutation(args.dataset_size)
+            for s in range(steps_per_epoch):
+                idx = perm[s * global_batch : (s + 1) * global_batch]
+                z_d = z_rng.randn(global_batch, args.latent).astype(np.float32)
+                z_g = z_rng.randn(global_batch, args.latent).astype(np.float32)
+                yield xs[idx], z_d, z_g
+
+    def run(sync: bool, n_devices: int):
+        mesh = Mesh(np.asarray(jax.devices()[:n_devices]), ("data",))
+        G, D = make_models()
+        if sync:
+            G = nn.convert_sync_batchnorm(G)
+            D = nn.convert_sync_batchnorm(D)
+        opt = lambda: optax.adam(args.lr, b1=args.beta1)
+        trainer = parallel.GANTrainer(G, D, opt(), opt(), loss="bce",
+                                      mesh=mesh)
+        d_losses, g_losses = [], []
+        stream = batches()
+        for _ in range(args.steps):
+            real, z_d, z_g = next(stream)
+            put = lambda a: jax.device_put(
+                jnp.asarray(a), trainer.batch_sharding
+            )
+            out = trainer.train_step(put(real), put(z_d), put(z_g))
+            d_losses.append(float(out.d_loss))
+            g_losses.append(float(out.g_loss))
+        stats = np.concatenate([
+            running_stats_vector(trainer.g_rest),
+            running_stats_vector(trainer.d_rest),
+        ])
+        return np.asarray(d_losses), np.asarray(g_losses), stats
+
+    log("arm 1/3: oracle (1 device, global batch)")
+    od, og, oracle_stats = run(sync=False, n_devices=1)
+    log("arm 2/3: syncbn (R devices, SyncBN in G and D)")
+    sd, sg, sync_stats = run(sync=True, n_devices=R)
+    log("arm 3/3: per-replica BN (R devices)")
+    ld, lg, local_stats = run(sync=False, n_devices=R)
+
+    sync_d = float(np.abs(sd - od).mean())
+    sync_g = float(np.abs(sg - og).mean())
+    local_d = float(np.abs(ld - od).mean())
+    local_g = float(np.abs(lg - og).mean())
+    # adversarial dynamics amplify float noise chaotically, so past the
+    # first ~tens of steps every arm drifts from the oracle; the
+    # pre-chaos window and the BN running-stats distance (the object
+    # SyncBN synchronizes, immune to trajectory chaos) carry the signal
+    blocks = ab_divergence_blocks(
+        {"d": (od, sd, ld), "g": (og, sg, lg)},
+        oracle_stats, sync_stats, local_stats,
+    )
+    result = {
+        "metric": "gan_syncbn_vs_perreplica_bn_loss_curve_mae_vs_oracle",
+        "replicas": R,
+        "per_chip_batch": B,
+        "steps": args.steps,
+        "syncbn_d_loss_mae": round(sync_d, 6),
+        "syncbn_g_loss_mae": round(sync_g, 6),
+        "perreplica_d_loss_mae": round(local_d, 6),
+        "perreplica_g_loss_mae": round(local_g, 6),
+        "divergence_ratio": round(
+            (local_d + local_g) / max(sync_d + sync_g, 1e-12), 2
+        ),
+        **blocks,
+        "final_loss": {
+            "oracle": {"d": round(float(od[-1]), 4), "g": round(float(og[-1]), 4)},
+            "syncbn": {"d": round(float(sd[-1]), 4), "g": round(float(sg[-1]), 4)},
+            "perreplica": {"d": round(float(ld[-1]), 4),
+                           "g": round(float(lg[-1]), 4)},
+        },
+    }
+    if args.curves:
+        with open(args.curves, "w") as f:
+            json.dump(
+                {
+                    "oracle": {"d": od.tolist(), "g": og.tolist()},
+                    "syncbn": {"d": sd.tolist(), "g": sg.tolist()},
+                    "perreplica": {"d": ld.tolist(), "g": lg.tolist()},
+                    **result,
+                },
+                f,
+            )
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -42,9 +42,26 @@ STAGES = ["pallas_parity", "pallas_sweep", "syncbn_overhead",
 def save(name, payload):
     os.makedirs(ART, exist_ok=True)
     path = os.path.join(ART, f"tpu_{name}.json")
-    with open(path, "w") as f:
+    # atomic: the watcher's stage timeout is a process-group SIGKILL that
+    # can land mid-write — a truncated JSON would destroy the per-case
+    # evidence these writes exist to preserve
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(payload, f, indent=2)
+    os.replace(tmp, path)
     log(f"[{name}] artifact -> {path}")
+
+
+def _bn_code_version():
+    """Fingerprint of the kernel sources a parity artifact validated —
+    seeded (skipped) cases must not survive a kernel edit."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for rel in ("tpu_syncbn/ops/pallas_bn.py", "tpu_syncbn/ops/batch_norm.py"):
+        with open(os.path.join(ROOT, rel), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
 
 
 def stage_pallas_parity():
@@ -58,12 +75,17 @@ def stage_pallas_parity():
 
     # Seed with cases a previous window already passed: a watcher-timeout
     # kill is SIGKILL (no finally runs), so the only evidence that
-    # survives a hang is what was written to disk *per case*.
-    results = {"backend": "tpu", "cases": [], "complete": False}
+    # survives a hang is what was written to disk *per case*. Seeds are
+    # only honored when the kernel sources are unchanged — a passed case
+    # validates a binary, not a file name.
+    version = _bn_code_version()
+    results = {"backend": "tpu", "code_version": version,
+               "cases": [], "complete": False}
     try:
         with open(os.path.join(ART, "tpu_pallas_parity.json")) as f:
             prev = json.load(f)
-        if prev.get("backend") == "tpu":
+        if (prev.get("backend") == "tpu"
+                and prev.get("code_version") == version):
             results["cases"] = [c for c in prev.get("cases", []) if c.get("ok")]
     except (OSError, json.JSONDecodeError):
         pass
