@@ -155,6 +155,13 @@ def main():
         return loss, {"top1": (logits.argmax(-1) == y).mean()}
 
     steps_per_epoch = len(train_ds) // global_batch
+    if steps_per_epoch < 1 or len(val_ds) < global_batch:
+        raise SystemExit(
+            f"splits too small for global batch {global_batch}: "
+            f"{len(train_ds)} train / {len(val_ds)} val images "
+            "(drop_last train loader would yield nothing, or eval would "
+            "report a fake 0.0)"
+        )
 
     def run(sync: bool):
         mesh = Mesh(np.asarray(jax.devices()[:R]), ("data",))

@@ -54,14 +54,13 @@ def save(name, payload):
 
 def _bn_code_version():
     """Fingerprint of the kernel sources a parity artifact validated —
-    seeded (skipped) cases must not survive a kernel edit."""
-    import hashlib
+    seeded (skipped) cases must not survive a kernel edit. Shared with
+    the evidence gate in ops.batch_norm (same rule: evidence validates a
+    binary, not a file name)."""
+    sys.path.insert(0, ROOT)
+    from tpu_syncbn.ops.batch_norm import kernel_code_version
 
-    h = hashlib.sha256()
-    for rel in ("tpu_syncbn/ops/pallas_bn.py", "tpu_syncbn/ops/batch_norm.py"):
-        with open(os.path.join(ROOT, rel), "rb") as f:
-            h.update(f.read())
-    return h.hexdigest()[:16]
+    return kernel_code_version()
 
 
 def stage_pallas_parity():

@@ -40,9 +40,12 @@ import jax.numpy as jnp
 from tpu_syncbn.parallel.collectives import moments_from_stats, reduce_moments
 
 def set_pallas_mode(mode: str) -> None:
-    """Select the BN kernel backend: 'auto' (Pallas on TPU, XLA fusion
-    elsewhere), 'on' (always Pallas; interpret mode off-TPU), 'off'
-    (always the XLA-fusion path).
+    """Select the BN kernel backend: 'auto' (on TPU, Pallas if — and only
+    if — the committed hardware measurement
+    ``benchmarks/artifacts/tpu_syncbn_overhead.json`` shows
+    ``pallas_speedup_vs_xla >= 1``; the XLA-fusion path otherwise and on
+    every non-TPU backend), 'on' (always Pallas; interpret mode off-TPU),
+    'off' (always the XLA-fusion path).
 
     Read at *trace* time for direct functional calls; the trainers
     (``DataParallel``/``GANTrainer``) additionally snapshot the
@@ -92,12 +95,67 @@ else:
     )
 
 
+def kernel_code_version() -> str:
+    """Fingerprint of the BN kernel sources. Hardware evidence (parity
+    cases, the overhead measurement gating 'auto') validates a *binary*,
+    not a file name — artifacts carry this and are ignored on mismatch."""
+    import hashlib
+
+    h = hashlib.sha256()
+    here = os.path.dirname(os.path.abspath(__file__))
+    for name in ("pallas_bn.py", "batch_norm.py"):
+        with open(os.path.join(here, name), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _measured_pallas_speedup(path: str | None = None) -> float | None:
+    """The committed hardware evidence for the Pallas-vs-XLA decision:
+    ``benchmarks/artifacts/tpu_syncbn_overhead.json``'s
+    ``pallas_speedup_vs_xla`` (model-level step-time ratio measured on a
+    real chip by ``benchmarks/tpu_validation.py``). None when the
+    artifact hasn't landed, wasn't TPU-tagged, or measured a different
+    kernel version than the one about to trace."""
+    import json
+
+    if path is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        path = os.path.join(root, "benchmarks", "artifacts",
+                            "tpu_syncbn_overhead.json")
+    try:
+        with open(path) as f:
+            parsed = (json.load(f).get("parsed") or {})
+    except (OSError, ValueError):
+        return None
+    if parsed.get("backend") != "tpu":
+        return None
+    if parsed.get("kernel_code_version") != kernel_code_version():
+        return None
+    speedup = parsed.get("pallas_speedup_vs_xla")
+    return float(speedup) if isinstance(speedup, (int, float)) else None
+
+
+_AUTO_PALLAS_CACHE: list = []  # lazily-resolved 'auto' decision, per process
+
+
 def _use_pallas() -> bool:
     if _PALLAS_MODE == "on":
         return True
     if _PALLAS_MODE == "off":
         return False
-    return jax.default_backend() == "tpu"
+    # 'auto' is evidence-gated: a hand kernel that loses to the XLA
+    # fusion it gates out would be a perf regression shipped as the
+    # default, so Pallas becomes the TPU default only once the committed
+    # hardware measurement shows it >= the XLA path. Until that artifact
+    # lands, 'auto' means the XLA-fusion path; Pallas stays one
+    # set_pallas_mode("on") away (parity-validated on chip either way).
+    if jax.default_backend() != "tpu":
+        return False
+    if not _AUTO_PALLAS_CACHE:
+        speedup = _measured_pallas_speedup()
+        _AUTO_PALLAS_CACHE.append(speedup is not None and speedup >= 1.0)
+    return _AUTO_PALLAS_CACHE[0]
 
 
 def _reduction_axes(ndim: int, channel_axis: int) -> tuple[int, ...]:
