@@ -217,9 +217,10 @@ def test_psum_in_groups_butterfly_matches_oracle():
         np.testing.assert_allclose(out, expect, rtol=1e-6)
 
 
-def test_psum_in_groups_non_pow2_fallback():
-    """Non-power-of-two group sizes use the gather+slice fallback (6-device
-    submesh, groups of 3)."""
+def test_psum_in_groups_non_pow2_mixed_radix():
+    """A non-power-of-two group size (g=3, two groups on a 6-device
+    submesh) takes the radix-3 mixed-radix butterfly stage — still
+    ppermute-only, asserted gather-free in the compiled HLO."""
     from jax.sharding import Mesh
 
     mesh = Mesh(np.asarray(jax.devices()[:6]), ("data",))
@@ -236,6 +237,48 @@ def test_psum_in_groups_non_pow2_fallback():
         np.tile(v[:3].sum(0), (3, 1)), np.tile(v[3:].sum(0), (3, 1))
     ])
     np.testing.assert_allclose(out, expect, rtol=1e-6)
+    hlo = f.lower(vals).compile().as_text()
+    assert "all-gather" not in hlo, "mixed-radix path must not gather"
+
+
+def test_psum_in_groups_mixed_radix_six_of_twelve():
+    """g=6 = 2x3 strict subgroups need world=12 (more host devices than
+    the suite forces), so simulate the stages on numpy — driving the REAL
+    production perm builder (collectives._stage_perm) so an edit to the
+    index construction fails here, not only on a 12-device mesh."""
+    world, g = 12, 6
+    vals = np.arange(float(world)).reshape(world, 1)
+
+    flat = vals.copy()
+    stride = 1
+    for f in collectives._prime_factors(g):
+        acc = flat.copy()
+        for k in range(1, f):
+            perm = collectives._stage_perm(world, g, stride, f, k)
+            assert sorted(d for _, d in perm) == list(range(world))
+            assert sorted(s for s, _ in perm) == list(range(world))
+            permuted = np.empty_like(flat)
+            for src, dst in perm:
+                permuted[dst] = flat[src]
+            acc = acc + permuted
+        flat = acc
+        stride *= f
+
+    expect = np.concatenate([
+        np.tile(vals[b * g:(b + 1) * g].sum(0), (g, 1))
+        for b in range(world // g)
+    ])
+    np.testing.assert_allclose(flat, expect)
+
+
+def test_prime_factors():
+    from tpu_syncbn.parallel.collectives import _prime_factors
+
+    assert _prime_factors(1) == []
+    assert _prime_factors(2) == [2]
+    assert _prime_factors(12) == [2, 2, 3]
+    assert _prime_factors(7) == [7]
+    assert _prime_factors(360) == [2, 2, 2, 3, 3, 5]
 
 
 def test_psum_in_groups_tree_payload_fused():

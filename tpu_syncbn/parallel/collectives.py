@@ -160,6 +160,34 @@ def reduce_scatter(
     )
 
 
+def _prime_factors(n: int) -> list:
+    """Ascending prime factorization (with multiplicity); empty for 1."""
+    fs, f = [], 2
+    while n > 1:
+        while n % f == 0:
+            fs.append(f)
+            n //= f
+        f += 1 if f == 2 else 2
+    return fs
+
+
+def _stage_perm(
+    world: int, group_size: int, stride: int, f: int, k: int
+) -> list:
+    """(source, dest) ppermute pairs for shift ``k`` of a radix-``f``
+    mixed-radix butterfly stage at ``stride``, within contiguous groups
+    of ``group_size``: dest ``i`` receives from the group member whose
+    digit at this stride is ``k`` ahead (mod f)."""
+    perm = []
+    for i in range(world):
+        base = (i // group_size) * group_size
+        pos = i - base
+        d = (pos // stride) % f
+        src = pos + (((d + k) % f) - d) * stride
+        perm.append((base + src, i))
+    return perm
+
+
 def psum_in_groups(
     tree: Pytree, axis_name: str, group_size: int
 ) -> Pytree:
@@ -169,14 +197,16 @@ def psum_in_groups(
 
     ``lax.psum(axis_index_groups=...)`` is unimplemented under shard_map's
     VMA checker (jax 0.9: the type system cannot express a group-varying
-    reduce result), so a power-of-two ``group_size`` uses a
-    recursive-doubling butterfly of ``ppermute``s — O(payload · log g)
-    traffic, VMA-legal, CollectivePermute HLOs that XLA schedules over the
-    direct ICI neighbor links the contiguous groups sit on. Other group
-    sizes fall back to one full-world all_gather + group slice
-    (O(payload · world) — fine for the 2C+1-float stat vectors this
-    serves). Either way the whole tree moves as ONE fused payload,
-    keeping the "one collective per BN layer" property.
+    reduce result), so this is a **mixed-radix butterfly** of
+    ``ppermute``s: ``group_size`` is factorized and each prime factor
+    ``f`` contributes one stage of ``f - 1`` shifted exchanges —
+    O(payload · Σ(fᵢ − 1)) traffic for ANY group size (log₂ g messages
+    when g is a power of two, where radix-2 stages reduce to the classic
+    recursive-doubling XOR butterfly), never an O(world) gather. All
+    perms are compile-time constants, VMA-legal CollectivePermute HLOs
+    that XLA schedules over the direct ICI neighbor links the contiguous
+    groups sit on. The whole tree moves as ONE fused payload, keeping
+    the "one collective per BN layer" property.
     """
     world = lax.axis_size(axis_name)
     if group_size < 1 or world % group_size:
@@ -190,22 +220,19 @@ def psum_in_groups(
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     flat = jnp.concatenate([jnp.ravel(l).astype(jnp.float32) for l in leaves])
 
-    if group_size & (group_size - 1) == 0:
-        # butterfly: partner = own index XOR 2^k within the group
-        step = 1
-        while step < group_size:
-            perm = [
-                (i, (i // group_size) * group_size + ((i % group_size) ^ step))
-                for i in range(world)
-            ]
-            flat = flat + lax.ppermute(flat, axis_name, perm)
-            step *= 2
-        summed = flat
-    else:
-        group_start = (lax.axis_index(axis_name) // group_size) * group_size
-        g = lax.all_gather(flat, axis_name, axis=0)  # (world, total)
-        mine = lax.dynamic_slice_in_dim(g, group_start, group_size, axis=0)
-        summed = mine.sum(axis=0)
+    stride = 1
+    for f in _prime_factors(group_size):
+        # radix-f stage: each member sums the f values whose mixed-radix
+        # digit at this stride differs — after the stage, every member
+        # holds the sum over its digit group; after all stages, the full
+        # contiguous-group sum
+        acc = flat
+        for k in range(1, f):
+            perm = _stage_perm(world, group_size, stride, f, k)
+            acc = acc + lax.ppermute(flat, axis_name, perm)
+        flat = acc
+        stride *= f
+    summed = flat
 
     out = []
     offset = 0
