@@ -36,6 +36,9 @@ def parse_args():
     p.add_argument("--simulate", type=int, default=8,
                    help="virtual host devices (the replica count)")
     p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--arch", choices=["dcgan", "sngan"], default="dcgan",
+                   help="BASELINE config 5 names both: DCGAN (BCE loss) or "
+                        "SNGAN (spectral-norm D with BN, hinge loss)")
     p.add_argument("--per-chip-batch", type=int, default=2)  # config 5 regime
     p.add_argument("--latent", type=int, default=16)
     p.add_argument("--width-g", type=int, default=32)
@@ -82,15 +85,22 @@ def main():
         ).astype(np.float32)
 
     def make_models():
-        return (
-            models.DCGANGenerator(
-                latent_dim=args.latent, width=args.width_g,
-                rngs=nnx.Rngs(args.seed),
-            ),
-            models.DCGANDiscriminator(
-                width=args.width_d, rngs=nnx.Rngs(args.seed + 1)
-            ),
+        G = models.DCGANGenerator(
+            latent_dim=args.latent, width=args.width_g,
+            rngs=nnx.Rngs(args.seed),
         )
+        if args.arch == "sngan":
+            # use_bn=True: the capability config is "SyncBN in G *and* D"
+            D = models.SNGANDiscriminator(
+                width=args.width_d, use_bn=True, rngs=nnx.Rngs(args.seed + 1)
+            )
+        else:
+            D = models.DCGANDiscriminator(
+                width=args.width_d, rngs=nnx.Rngs(args.seed + 1)
+            )
+        return G, D
+
+    gan_loss = "hinge" if args.arch == "sngan" else "bce"
 
     def batches():
         """Identical epoch-shuffled real batches + per-step noise pairs
@@ -112,7 +122,7 @@ def main():
             G = nn.convert_sync_batchnorm(G)
             D = nn.convert_sync_batchnorm(D)
         opt = lambda: optax.adam(args.lr, b1=args.beta1)
-        trainer = parallel.GANTrainer(G, D, opt(), opt(), loss="bce",
+        trainer = parallel.GANTrainer(G, D, opt(), opt(), loss=gan_loss,
                                       mesh=mesh)
         d_losses, g_losses = [], []
         stream = batches()
@@ -151,6 +161,7 @@ def main():
     )
     result = {
         "metric": "gan_syncbn_vs_perreplica_bn_loss_curve_mae_vs_oracle",
+        "arch": args.arch,
         "replicas": R,
         "per_chip_batch": B,
         "steps": args.steps,

@@ -53,6 +53,10 @@ def parse_args():
     # requires serial decode. Raise for throughput, not for A/B rigor.
     p.add_argument("--num-workers", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--noise", type=float, default=0.35,
+                   help="pixel-noise sigma baked into the generated JPEGs "
+                        "(0.35 ~ saturating-easy; 0.8+ keeps accuracy off "
+                        "the ceiling so the BN-statistics gap is visible)")
     p.add_argument("--data-root", default=None,
                    help="reuse/create the JPEG tree here (default: tmp dir)")
     p.add_argument("--keep-data", action="store_true")
@@ -60,13 +64,16 @@ def parse_args():
     return p.parse_args()
 
 
-def generate_tree(root, num_classes, train_per_class, val_per_class, seed):
+def generate_tree(root, num_classes, train_per_class, val_per_class, seed,
+                  noise=0.35):
     """Write a train/val ImageFolder tree of 32x32 JPEGs. Each class is a
     spatial-frequency signature (3 fixed (fx, fy, channel-amplitude)
     components); each image draws random phases, amplitude jitter, and
     pixel noise, so class identity is spectral, not pixel-template."""
     import numpy as np
     from PIL import Image
+
+    import json as _json
 
     t = np.arange(32, dtype=np.float32)
     X, Y = np.meshgrid(t, t, indexing="ij")
@@ -81,6 +88,10 @@ def generate_tree(root, num_classes, train_per_class, val_per_class, seed):
         components.append(comps)
 
     rng = np.random.RandomState(seed + 2000)
+    os.makedirs(root, exist_ok=True)
+    with open(os.path.join(root, "meta.json"), "w") as f:
+        _json.dump({"noise": noise, "num_classes": num_classes,
+                    "seed": seed}, f)
     for split, per_class in (("train", train_per_class), ("val", val_per_class)):
         for k in range(num_classes):
             d = os.path.join(root, split, f"class_{k:02d}")
@@ -92,7 +103,7 @@ def generate_tree(root, num_classes, train_per_class, val_per_class, seed):
                     jitter = rng.uniform(0.6, 1.4)
                     wave = np.sin(fx * X + fy * Y + phase)
                     img += jitter * wave[..., None] * amp
-                img += 0.35 * rng.randn(32, 32, 3)
+                img += noise * rng.randn(32, 32, 3)
                 img = (np.tanh(img * 0.7) + 1.0) * 127.5
                 Image.fromarray(img.astype(np.uint8)).save(
                     os.path.join(d, f"im_{i:04d}.jpg"), quality=92
@@ -116,9 +127,21 @@ def main():
     root = args.data_root or tempfile.mkdtemp(prefix="realdata_ab_")
     made_tmp = args.data_root is None
     if not os.path.isdir(os.path.join(root, "train")):
-        log(f"generating JPEG tree under {root}")
+        log(f"generating JPEG tree under {root} (noise={args.noise})")
         generate_tree(root, args.num_classes, args.train_per_class,
-                      args.val_per_class, args.seed)
+                      args.val_per_class, args.seed, noise=args.noise)
+    else:
+        # reusing an existing tree: the artifact must record the noise
+        # the JPEGs were actually generated with, not the CLI value
+        try:
+            with open(os.path.join(root, "meta.json")) as f:
+                actual = json.load(f).get("noise")
+        except (OSError, ValueError):
+            actual = None
+        if actual is not None and actual != args.noise:
+            log(f"WARNING: reusing tree generated at noise={actual}; "
+                f"recording that (CLI asked for {args.noise})")
+            args.noise = actual
 
     R = args.simulate
     global_batch = R * args.per_chip_batch
@@ -231,6 +254,7 @@ def main():
         "replicas": R,
         "per_chip_batch": args.per_chip_batch,
         "epochs": args.epochs,
+        "noise": args.noise,
         "train_images": len(train_ds),
         "val_images": len(val_ds),
         "syncbn_val_top1_curve": sync_curve,

@@ -207,6 +207,14 @@ def psum_in_groups(
     that XLA schedules over the direct ICI neighbor links the contiguous
     groups sit on. The whole tree moves as ONE fused payload, keeping
     the "one collective per BN layer" property.
+
+    Latency note: a large *prime* factor f contributes f-1 dependent
+    exchange rounds (ring-like latency), so e.g. g=13 pays 12 round
+    trips where a gather would pay one. Real stat-sync groups are
+    topology-shaped (2/4/8 replicas per host, occasionally 3/6), where
+    Σ(fᵢ−1) ≤ 4 — the design targets those; for exotic large-prime
+    groups prefer ``group_size=None`` (full-world psum) or a custom
+    path.
     """
     world = lax.axis_size(axis_name)
     if group_size < 1 or world % group_size:
