@@ -77,6 +77,9 @@ def parse_args():
     p.add_argument("--eval-every", type=int, default=0,
                    help="eval every N epochs (0 = only at the end)")
     p.add_argument("--profile-dir", default=None)
+    p.add_argument("--metrics-log", default=None,
+                   help="append per-log-interval scalars (loss/top1/img-s) "
+                        "to this JSONL file, master only")
     return p.parse_args()
 
 
@@ -165,11 +168,21 @@ def main():
             meter.update(float(out.metrics["top1"]), n=args.batch_size)
         return meter.avg
 
+    import contextlib
+
     tput = utils.ThroughputMeter()
-    step = 0
+    # resume restarts from a checkpointed epoch: keep the logged step
+    # monotonic across runs (the JSONL file is append-mode)
+    step = start_epoch * steps_per_epoch
     last_eval = None
-    with utils.profiler_trace(args.profile_dir or "",
-                              enabled=bool(args.profile_dir)):
+    with contextlib.ExitStack() as stack:
+        scalars = stack.enter_context(
+            utils.ScalarLogger(args.metrics_log)
+        ) if args.metrics_log else None
+        stack.enter_context(
+            utils.profiler_trace(args.profile_dir or "",
+                                 enabled=bool(args.profile_dir))
+        )
         for epoch in range(start_epoch, args.epochs):
             sampler.set_epoch(epoch)
             for batch in tdata.device_prefetch(iter(loader),
@@ -184,15 +197,23 @@ def main():
                         f"top1 {float(out.metrics['top1']):.3f} "
                         f"{tput.samples_per_sec:.0f} img/s"
                     )
+                    if scalars:
+                        scalars.log(step, epoch=epoch, loss=out.loss,
+                                    top1=out.metrics["top1"],
+                                    img_per_sec=tput.samples_per_sec)
             if args.ckpt_dir:
                 utils.save_checkpoint(args.ckpt_dir, epoch + 1, dp.state_dict())
             if args.eval_every and (epoch + 1) % args.eval_every == 0:
                 last_eval = run_eval()
                 runtime.master_print(f"epoch {epoch}: val top1 {last_eval:.4f}")
+                if scalars:
+                    scalars.log(step, epoch=epoch, val_top1=last_eval)
             else:
                 last_eval = None  # model changed since the last eval
 
-    final_top1 = last_eval if last_eval is not None else run_eval()
+        final_top1 = last_eval if last_eval is not None else run_eval()
+        if scalars:
+            scalars.log(step, final_val_top1=final_top1)
     runtime.master_print(
         f"done: {step} steps, final val top1 {final_top1:.4f}, "
         f"throughput {tput.samples_per_sec:.0f} img/s"

@@ -137,3 +137,27 @@ def test_step_timer():
     with utils.step_timer() as t:
         pass
     assert t["seconds"] >= 0
+
+
+def test_scalar_logger_jsonl(tmp_path):
+    """Master-gated JSONL curve log: one parseable row per call, device
+    arrays coerced at log time, append-across-instances (resume)."""
+    import json
+
+    path = str(tmp_path / "curves" / "train.jsonl")
+    with utils.ScalarLogger(path) as log:
+        log.log(10, loss=jnp.float32(1.5), top1=0.25)
+        log.log(20, loss=0.75)
+    with utils.ScalarLogger(path) as log:  # resume appends, not truncates
+        log.log(30, loss=0.5)
+        log.log(40, loss=float("nan"), top1=float("inf"))  # diverged run
+    rows = [json.loads(l, parse_constant=_reject) for l in open(path)]
+    assert [r["step"] for r in rows] == [10, 20, 30, 40]
+    assert rows[0]["loss"] == 1.5 and rows[0]["top1"] == 0.25
+    assert all("wall_time" in r for r in rows)
+    # non-finite scalars become null — every line stays strict JSON
+    assert rows[3]["loss"] is None and rows[3]["top1"] is None
+
+
+def _reject(token):
+    raise AssertionError(f"non-strict JSON token {token!r} in log")

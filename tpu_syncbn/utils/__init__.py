@@ -7,6 +7,7 @@ from tpu_syncbn.utils.checkpoint import (
 )
 from tpu_syncbn.utils.metrics import (
     AverageMeter,
+    ScalarLogger,
     ThroughputMeter,
     profiler_trace,
     step_timer,
@@ -19,6 +20,7 @@ __all__ = [
     "load_checkpoint",
     "available_steps",
     "AverageMeter",
+    "ScalarLogger",
     "ThroughputMeter",
     "profiler_trace",
     "step_timer",

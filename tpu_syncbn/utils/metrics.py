@@ -9,6 +9,9 @@ master-gated logger) and add the cheap idiomatic extras SURVEY §5.1 notes:
 from __future__ import annotations
 
 import contextlib
+import json
+import math
+import os
 import time
 
 import jax
@@ -88,3 +91,48 @@ def step_timer():
         yield out
     finally:
         out["seconds"] = time.perf_counter() - t0
+
+
+class ScalarLogger:
+    """Append-only JSONL training-curve log, written by the master process
+    only (the reference's rank-0 convention, ``README.md:9``, applied to
+    files instead of the console). One line per ``log()`` call:
+    ``{"step": N, "wall_time": ..., **scalars}`` — trivially consumed by
+    pandas/jq, no TensorBoard dependency.
+
+    Non-master processes construct successfully and no-op, so the call
+    site needs no rank gating. Values are coerced with ``float()`` at log
+    time (device arrays sync here, not at write time).
+    """
+
+    def __init__(self, path: str):
+        from tpu_syncbn.runtime import distributed as dist
+
+        self.path = path
+        self._fh = None
+        if dist.is_master():
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+            self._fh = open(path, "a", buffering=1)  # line-buffered
+
+    def log(self, step: int, **scalars) -> None:
+        if self._fh is None:
+            return
+        row = {"step": int(step), "wall_time": round(time.time(), 3)}
+        # non-finite -> null: bare NaN/Infinity tokens are not JSON and
+        # would abort strict consumers (jq, JSON.parse) mid-file
+        for k, v in scalars.items():
+            f = float(v)
+            row[k] = f if math.isfinite(f) else None
+        self._fh.write(json.dumps(row, allow_nan=False) + "\n")
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
