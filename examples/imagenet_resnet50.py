@@ -14,6 +14,7 @@ sharding, and step math are identical.
 """
 
 import argparse
+import contextlib
 import os
 
 import jax.numpy as jnp
@@ -168,18 +169,21 @@ def main():
             meter.update(float(out.metrics["top1"]), n=args.batch_size)
         return meter.avg
 
-    import contextlib
-
     tput = utils.ThroughputMeter()
     # resume restarts from a checkpointed epoch: keep the logged step
-    # monotonic across runs (the JSONL file is append-mode)
-    step = start_epoch * steps_per_epoch
+    # monotonic across runs (the JSONL file is append-mode). len(loader)
+    # is the loader's real per-epoch step count (sampler padding +
+    # drop_last applied), which dataset_size // batch_size is not.
+    step = start_epoch * len(loader)
     last_eval = None
     with contextlib.ExitStack() as stack:
         scalars = stack.enter_context(
             utils.ScalarLogger(args.metrics_log)
         ) if args.metrics_log else None
-        stack.enter_context(
+        # profiler scope is its own nested context: it must close before
+        # the final eval below so --profile-dir traces training only
+        prof = stack.enter_context(contextlib.ExitStack())
+        prof.enter_context(
             utils.profiler_trace(args.profile_dir or "",
                                  enabled=bool(args.profile_dir))
         )
@@ -211,6 +215,7 @@ def main():
             else:
                 last_eval = None  # model changed since the last eval
 
+        prof.close()  # end the profile before the final eval pass
         final_top1 = last_eval if last_eval is not None else run_eval()
         if scalars:
             scalars.log(step, final_val_top1=final_top1)

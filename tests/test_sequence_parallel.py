@@ -136,3 +136,86 @@ def test_ring_no_full_sequence_materialization():
     hlo_bwd = grad.lower(q, k, v).compile().as_text()
     assert "collective-permute" in hlo_bwd
     assert "all-gather" not in hlo_bwd
+
+
+class TestZigzag:
+    def test_shard_roundtrip_and_layout(self):
+        x = jnp.arange(16.0).reshape(1, 16, 1, 1)
+        z = sequence.zigzag_shard(x, 4)
+        # device shards (contiguous quarters) hold chunk pairs (i, 2n-1-i)
+        assert np.asarray(z[0, :, 0, 0]).tolist() == [
+            0, 1, 14, 15, 2, 3, 12, 13, 4, 5, 10, 11, 6, 7, 8, 9
+        ]
+        back = sequence.zigzag_unshard(z, 4)
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+    @pytest.mark.parametrize("n", [1, 2, 4, 8])
+    def test_forward_matches_oracle(self, n):
+        q, k, v = make_qkv(seed=5)
+        want = sequence._single_device_attention(
+            q, k, v, causal=True, scale=None
+        )
+        got = sequence.sharded_self_attention(
+            mesh_of(n), q, k, v, causal=True, impl="ring_zigzag"
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
+
+    def test_gradients_match_oracle(self):
+        q, k, v = make_qkv(seed=6)
+        w = jnp.asarray(
+            np.random.default_rng(7)
+            .standard_normal((B, L, H, D)).astype(np.float32)
+        )
+        n = 4
+        spec = P(None, sequence.SEQ_AXIS, None, None)
+        attn = shard_map(
+            sequence.ring_attention_zigzag,
+            mesh=mesh_of(n), in_specs=(spec, spec, spec), out_specs=spec,
+        )
+
+        def loss_zigzag(q, k, v):
+            zz = lambda x: sequence.zigzag_shard(x, n)
+            out = sequence.zigzag_unshard(attn(zz(q), zz(k), zz(v)), n)
+            return jnp.sum(w * out)
+
+        def loss_oracle(q, k, v):
+            return jnp.sum(w * sequence._single_device_attention(
+                q, k, v, causal=True, scale=None))
+
+        g_want = jax.grad(loss_oracle, argnums=(0, 1, 2))(q, k, v)
+        g_got = jax.jit(jax.grad(loss_zigzag, argnums=(0, 1, 2)))(q, k, v)
+        for a, b, name in zip(g_got, g_want, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-5, err_msg=f"d{name}"
+            )
+
+    def test_non_causal_rejected(self):
+        q, k, v = make_qkv()
+        with pytest.raises(ValueError, match="causal"):
+            sequence.sharded_self_attention(
+                mesh_of(2), q, k, v, causal=False, impl="ring_zigzag"
+            )
+
+    def test_length_must_divide(self):
+        with pytest.raises(ValueError, match="divide"):
+            sequence.zigzag_shard(jnp.zeros((1, 12, 1, 1)), 8)
+
+    def test_no_full_sequence_materialization(self):
+        """Like the contiguous ring: zigzag must move KV by
+        collective-permute only, fwd and bwd — never an all-gather."""
+        q, k, v = make_qkv(seed=8)
+        spec = P(None, sequence.SEQ_AXIS, None, None)
+        attn = shard_map(
+            sequence.ring_attention_zigzag,
+            mesh=mesh_of(8), in_specs=(spec, spec, spec), out_specs=spec,
+        )
+        hlo = jax.jit(attn).lower(q, k, v).compile().as_text()
+        assert "collective-permute" in hlo and "all-gather" not in hlo
+        grad = jax.jit(
+            jax.grad(lambda q, k, v: jnp.sum(attn(q, k, v)),
+                     argnums=(0, 1, 2))
+        )
+        hlo_bwd = grad.lower(q, k, v).compile().as_text()
+        assert "collective-permute" in hlo_bwd
+        assert "all-gather" not in hlo_bwd

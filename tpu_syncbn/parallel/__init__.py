@@ -21,8 +21,11 @@ from tpu_syncbn.parallel.collectives import (
 )
 from tpu_syncbn.parallel.sequence import (
     ring_attention,
+    ring_attention_zigzag,
     sharded_self_attention,
     ulysses_attention,
+    zigzag_shard,
+    zigzag_unshard,
 )
 from tpu_syncbn.parallel.expert import (
     dense_moe,
@@ -60,6 +63,9 @@ __all__ = [
     "psum_in_groups",
     "ring_all_reduce",
     "ring_attention",
+    "ring_attention_zigzag",
+    "zigzag_shard",
+    "zigzag_unshard",
     "sharded_self_attention",
     "ulysses_attention",
     "dense_moe",

@@ -17,6 +17,14 @@ SyncBN path uses:
   neighbor link. Compute per step is uniform across devices (SPMD
   lockstep: no load imbalance, no dynamic shapes).
 
+* :func:`ring_attention_zigzag` — the causal ring with the **zigzag
+  layout** (device ``i`` holds global chunks ``i`` and ``2n-1-i``):
+  fully-masked chunk pairs are skipped *without* unbalancing the ring,
+  ~2× the causal throughput of the contiguous ring. Use
+  :func:`zigzag_shard`/:func:`zigzag_unshard` (or
+  ``sharded_self_attention(impl="ring_zigzag")``) to move between
+  position order and the zigzag layout.
+
 * :func:`ulysses_attention` — DeepSpeed-Ulysses-style sequence
   parallelism: two ``all_to_all``s trade the sequence sharding for a
   *head* sharding, run ordinary full attention on the complete sequence
@@ -148,6 +156,163 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
+def zigzag_chunk_permutation(n_shards: int) -> list:
+    """Chunk order realizing the zigzag layout: the global sequence is cut
+    into ``2n`` chunks and device ``i`` holds chunks ``(i, 2n-1-i)`` — one
+    early, one late — so causal work is balanced across the ring (the
+    contiguous layout gives device 0 almost nothing unmasked and device
+    n-1 everything)."""
+    return [c for i in range(n_shards) for c in (i, 2 * n_shards - 1 - i)]
+
+
+def zigzag_shard(x: jax.Array, n_shards: int, axis: int = 1) -> jax.Array:
+    """Reorder a *global* sequence axis into the zigzag layout, so that a
+    plain contiguous ``P(axis_name)`` sharding lands chunk pair
+    ``(i, 2n-1-i)`` on device ``i``. Length must divide by ``2·n_shards``.
+    Inverse: :func:`zigzag_unshard`."""
+    length = x.shape[axis]
+    if length % (2 * n_shards):
+        raise ValueError(
+            f"sequence length {length} must divide by 2*n_shards "
+            f"({2 * n_shards})"
+        )
+    chunks = jnp.split(x, 2 * n_shards, axis=axis)
+    return jnp.concatenate(
+        [chunks[c] for c in zigzag_chunk_permutation(n_shards)], axis=axis
+    )
+
+
+def zigzag_unshard(x: jax.Array, n_shards: int, axis: int = 1) -> jax.Array:
+    """Inverse of :func:`zigzag_shard`."""
+    perm = zigzag_chunk_permutation(n_shards)
+    inverse = [0] * len(perm)
+    for pos, c in enumerate(perm):
+        inverse[c] = pos
+    chunks = jnp.split(x, 2 * n_shards, axis=axis)
+    return jnp.concatenate([chunks[p] for p in inverse], axis=axis)
+
+
+def ring_attention_zigzag(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = SEQ_AXIS,
+    *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Causal ring attention over the **zigzag** layout — ~2× the causal
+    throughput of :func:`ring_attention` by skipping fully-masked work
+    while keeping every device equally busy.
+
+    Shard-level function: this device's block is ``concat(chunk_e,
+    chunk_l)`` with ``e = me`` and ``l = 2n-1-me`` of the ``2n`` global
+    chunks (produce it with :func:`zigzag_shard` + contiguous sharding).
+
+    Why it's fast AND balanced: under the contiguous layout, causal
+    masking makes hop work proportional to the device index (device 0:
+    almost all KV masked; device n-1: none) — skipping masked blocks
+    would leave the ring gated by the busiest device every hop. In the
+    zigzag layout each device owns one early and one late chunk, and at
+    every non-self hop exactly TWO chunk-pair attends are live per
+    device, both *fully* unmasked:
+
+    * ``q_l × kv_e_incoming`` — a late query chunk against any early
+      chunk is always allowed;
+    * one of ``q_e × kv_e`` (when the incoming block originated earlier
+      on the ring) or ``q_l × kv_l`` (when it originated later) —
+      selected with ``jnp.where`` on same-shaped operands, so the
+      compiled step stays branch-free and uniform.
+
+    The self block (before the scan) adds the two in-chunk causal
+    diagonals. Total: ``2(n-1) + 3`` chunk-attends of the ``4n`` the
+    contiguous layout computes. Exact (online softmax, order-free):
+    output ≡ the causal oracle on the zigzag-ordered sequence.
+    """
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    if n == 1:
+        return _single_device_attention(q, k, v, causal=True, scale=scale)
+    b, l_local, h, d = q.shape
+    if l_local % 2:
+        raise ValueError(
+            f"zigzag local length must be even (chunk pair), got {l_local}"
+        )
+    c = l_local // 2
+    qf = q.astype(jnp.float32) * _qk_scale(d, scale)
+    q_e, q_l = qf[:, :c], qf[:, c:]
+
+    def fresh_state():
+        return pcast_varying(
+            (
+                jnp.zeros((b, c, h, d), jnp.float32),
+                jnp.zeros((b, c, h), jnp.float32),
+                jnp.full((b, c, h), _NEG_BIG, jnp.float32),
+            ),
+            axis_name,
+        )
+
+    # in-chunk causal diagonal: both chunks attend themselves causally
+    # (global positions inside one chunk are consecutive, so the mask is
+    # the ordinary lower triangle regardless of which chunk it is)
+    tri = jnp.where(
+        jnp.arange(c)[:, None] >= jnp.arange(c)[None, :], 0.0, _NEG_BIG
+    )[None, :, None, :]
+    zero_bias = jnp.zeros((1, 1, 1, c), jnp.float32)
+
+    k_e, k_l = k[:, :c], k[:, c:]
+    v_e, v_l = v[:, :c], v[:, c:]
+
+    # self block: e×e diagonal, l×e full (e is always earlier), l×l diagonal
+    e_state = _block_attend(q_e, k_e, v_e, tri, *fresh_state())
+    l_state = _block_attend(q_l, k_e, v_e, zero_bias, *fresh_state())
+    l_state = _block_attend(q_l, k_l, v_l, tri, *l_state)
+
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop(carry, s):
+        (o_e, l_e, m_e), (o_l, l_l, m_l), k_blk, v_blk = carry
+        k_blk, v_blk = lax.ppermute((k_blk, v_blk), axis_name, fwd)
+        src = (me - s) % n
+        ke_in, kl_in = k_blk[:, :c], k_blk[:, c:]
+        ve_in, vl_in = v_blk[:, :c], v_blk[:, c:]
+        # late queries vs the incoming early chunk: always fully allowed
+        o_l, l_l, m_l = _block_attend(
+            q_l, ke_in, ve_in, zero_bias, o_l, l_l, m_l
+        )
+        # the other live pair: q_e×kv_e when src rode from earlier on the
+        # ring, else q_l×kv_l — same shapes, operand-selected
+        pred = src < me
+        q_sel = jnp.where(pred, q_e, q_l)
+        k_sel = jnp.where(pred, ke_in, kl_in)
+        v_sel = jnp.where(pred, ve_in, vl_in)
+        o_t = jnp.where(pred, o_e, o_l)
+        l_t = jnp.where(pred, l_e, l_l)
+        m_t = jnp.where(pred, m_e, m_l)
+        o_t, l_t, m_t = _block_attend(q_sel, k_sel, v_sel, zero_bias,
+                                      o_t, l_t, m_t)
+        o_e = jnp.where(pred, o_t, o_e)
+        l_e = jnp.where(pred, l_t, l_e)
+        m_e = jnp.where(pred, m_t, m_e)
+        o_l = jnp.where(pred, o_l, o_t)
+        l_l = jnp.where(pred, l_l, l_t)
+        m_l = jnp.where(pred, m_l, m_t)
+        return ((o_e, l_e, m_e), (o_l, l_l, m_l), k_blk, v_blk), None
+
+    (e_state, l_state, _, _), _ = lax.scan(
+        hop, (e_state, l_state, k, v), jnp.arange(1, n)
+    )
+    o_e, l_e, _ = e_state
+    o_l, l_l, _ = l_state
+    out = jnp.concatenate(
+        [
+            o_e / jnp.maximum(l_e, 1e-30)[..., None],
+            o_l / jnp.maximum(l_l, 1e-30)[..., None],
+        ],
+        axis=1,
+    )
+    return out.astype(q.dtype)
+
+
 def _single_device_attention(q, k, v, *, causal, scale):
     """Plain full-softmax attention — the n=1 path and the test oracle."""
     d = q.shape[-1]
@@ -223,19 +388,44 @@ def sharded_self_attention(
     impl: str = "ring",
 ) -> jax.Array:
     """Array-level convenience wrapper: shard global ``(B, L, H, D)``
-    arrays along ``L`` over ``mesh[axis_name]`` and run ring or Ulysses
-    attention under ``shard_map`` (select with ``impl``)."""
-    fns = {"ring": ring_attention, "ulysses": ulysses_attention}
-    try:
-        fn = fns[impl]
-    except KeyError:
-        raise ValueError(f"impl must be one of {sorted(fns)}, got {impl!r}")
+    arrays along ``L`` over ``mesh[axis_name]`` and run ring, zigzag-ring
+    or Ulysses attention under ``shard_map`` (select with ``impl``).
+    ``"ring_zigzag"`` (causal only) reorders the sequence into the
+    zigzag layout on the way in and back on the way out, so callers keep
+    ordinary position order end to end."""
+    if impl == "ring_zigzag":
+        if not causal:
+            raise ValueError(
+                "ring_zigzag is the causal load-balanced layout; use "
+                "impl='ring' for non-causal attention (every block is "
+                "live there, so zigzag has nothing to skip)"
+            )
+        n = int(mesh.shape[axis_name])
+        fn = functools.partial(
+            ring_attention_zigzag, axis_name=axis_name, scale=scale
+        )
+        q, k, v = (zigzag_shard(x, n) for x in (q, k, v))
+    else:
+        fns = {"ring": ring_attention, "ulysses": ulysses_attention}
+        try:
+            base = fns[impl]
+        except KeyError:
+            raise ValueError(
+                f"impl must be one of {sorted(fns) + ['ring_zigzag']}, "
+                f"got {impl!r}"
+            )
+        fn = functools.partial(
+            base, axis_name=axis_name, causal=causal, scale=scale
+        )
     seq_sharded = P(None, axis_name, None, None)
     shard_fn = jax.shard_map(
-        functools.partial(fn, axis_name=axis_name, causal=causal, scale=scale),
+        fn,
         mesh=mesh,
         in_specs=(seq_sharded, seq_sharded, seq_sharded),
         out_specs=seq_sharded,
     )
     put = lambda x: jax.device_put(x, NamedSharding(mesh, seq_sharded))
-    return shard_fn(put(q), put(k), put(v))
+    out = shard_fn(put(q), put(k), put(v))
+    if impl == "ring_zigzag":
+        out = zigzag_unshard(out, n)
+    return out
