@@ -180,8 +180,9 @@ def main():
         scalars = stack.enter_context(
             utils.ScalarLogger(args.metrics_log)
         ) if args.metrics_log else None
-        # profiler scope is its own nested context: it must close before
-        # the final eval below so --profile-dir traces training only
+        # profiler scope is its own nested context, closed before the
+        # final eval below (per-epoch --eval-every evals remain in scope;
+        # only the end-of-training eval pass is excluded from the trace)
         prof = stack.enter_context(contextlib.ExitStack())
         prof.enter_context(
             utils.profiler_trace(args.profile_dir or "",

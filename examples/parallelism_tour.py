@@ -87,7 +87,7 @@ def main():
 
     oracle = _single_device_attention(q, k, v, causal=True, scale=None)
     smesh = Mesh(np.array(devices), ("seq",))
-    for impl in ("ring", "ulysses"):
+    for impl in ("ring", "ring_zigzag", "ulysses"):
         got = parallel.sharded_self_attention(smesh, q, k, v, causal=True, impl=impl)
         check(f"{impl} attention ≡ full attention", got, oracle)
 

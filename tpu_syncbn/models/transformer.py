@@ -82,6 +82,16 @@ def _attend(q, k, v, impl: Optional[str], axis_name: Optional[str]):
         return ring_attention(q, k, v, axis_name, causal=True)
     if impl == "ulysses":
         return ulysses_attention(q, k, v, axis_name, causal=True)
+    if impl == "ring_zigzag":
+        raise ValueError(
+            "ring_zigzag is not supported at the LM layer: it requires the "
+            "token stream, position embeddings, and next-token labels to "
+            "all use the zigzag chunk order, which transformer_lm's "
+            "contiguous pos_offset plumbing does not provide. Use "
+            "parallel.ring_attention_zigzag / "
+            "sharded_self_attention(impl='ring_zigzag') at the attention "
+            "level, or attn_impl='ring' here."
+        )
     raise ValueError(f"unknown attention impl {impl!r}")
 
 

@@ -32,9 +32,9 @@ SyncBN path uses:
   when heads ≥ devices and the full sequence fits in HBM; the ring wins
   when it does not.
 
-Both are exact (not approximations): output ≡ single-device softmax
+All three are exact (not approximations): output ≡ single-device softmax
 attention on the gathered sequence, forward and gradients — pinned by
-``tests/test_sequence_parallel.py`` on the 8-virtual-device mesh. Both
+``tests/test_sequence_parallel.py`` on the 8-virtual-device mesh. All
 are shard_map-level functions: arguments are this device's *local*
 sequence shard, shaped ``(batch, seq_local, heads, head_dim)``; use
 :func:`sharded_self_attention` for the array-level convenience wrapper.
