@@ -75,6 +75,71 @@ got_local = np.concatenate(
 np.testing.assert_allclose(got_local, ref_local, rtol=1e-4, atol=1e-5)
 print(f"[{pid}] syncbn-golden ok", flush=True)
 
+# --- ring attention across processes -------------------------------------
+# the ppermute KV ring crossing a real process boundary (the CPU stand-in
+# for ICI hops between hosts), contiguous and zigzag layouts
+import functools
+
+from jax.sharding import Mesh
+
+from tpu_syncbn.parallel import sequence
+
+smesh = Mesh(np.asarray(jax.devices()), ("seq",))
+B, H, D = 1, 2, 8
+L = world_dev * 4
+rng2 = np.random.RandomState(7)  # same on every process: full global view
+q_g, k_g, v_g = (
+    rng2.randn(B, L, H, D).astype(np.float32) for _ in range(3)
+)
+sspec = P(None, "seq", None, None)
+ssharding = NamedSharding(smesh, sspec)
+
+
+def put_seq(x_global):
+    per = np.asarray(x_global).reshape(
+        B, runtime.process_count(), -1, H, D
+    )[:, pid]
+    return jax.make_array_from_process_local_data(
+        ssharding, jnp.asarray(per)
+    )
+
+
+def local_rows(global_out, arr):
+    shards = sorted(arr.addressable_shards, key=lambda s: s.index[1].start)
+    got = np.concatenate([np.asarray(s.data) for s in shards], axis=1)
+    lo = shards[0].index[1].start
+    return got, np.asarray(global_out)[:, lo : lo + got.shape[1]]
+
+
+oracle = sequence._single_device_attention(
+    jnp.asarray(q_g), jnp.asarray(k_g), jnp.asarray(v_g),
+    causal=True, scale=None,
+)
+ring = jax.jit(
+    shard_map(
+        functools.partial(sequence.ring_attention, causal=True),
+        mesh=smesh, in_specs=(sspec,) * 3, out_specs=sspec,
+    )
+)
+out_ring = ring(put_seq(q_g), put_seq(k_g), put_seq(v_g))
+got, want = local_rows(oracle, out_ring)
+np.testing.assert_allclose(got, want, atol=2e-5)
+print(f"[{pid}] ring-attention ok", flush=True)
+
+n_seq = int(smesh.shape["seq"])
+zz = jax.jit(
+    shard_map(
+        sequence.ring_attention_zigzag,
+        mesh=smesh, in_specs=(sspec,) * 3, out_specs=sspec,
+    )
+)
+zput = lambda xg: put_seq(np.asarray(sequence.zigzag_shard(jnp.asarray(xg), n_seq)))
+out_zz = zz(zput(q_g), zput(k_g), zput(v_g))
+oracle_zz = sequence.zigzag_shard(oracle, n_seq)  # same layout as output
+got, want = local_rows(oracle_zz, out_zz)
+np.testing.assert_allclose(got, want, atol=2e-5)
+print(f"[{pid}] zigzag-attention ok", flush=True)
+
 # --- master convention ---------------------------------------------------
 runtime.master_print(f"MASTER-ONLY-LINE from {pid}")
 runtime.barrier("end")

@@ -50,6 +50,8 @@ def test_two_process_world():
         assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
         assert f"[{pid}] psum ok" in out
         assert f"[{pid}] syncbn-golden ok" in out
+        assert f"[{pid}] ring-attention ok" in out
+        assert f"[{pid}] zigzag-attention ok" in out
         assert f"[{pid}] done" in out
     # master convention: the rank-0 line appears ONLY in process 0's output
     assert "MASTER-ONLY-LINE from 0" in outs[0]
