@@ -57,6 +57,19 @@ def test_sequence_sharded_forward_matches_dense(impl, n):
     )
 
 
+def test_flash_attn_impl_matches_dense():
+    """attn_impl='flash' (the Pallas fused kernel, interpret mode on CPU)
+    must reproduce the dense forward exactly."""
+    params = make_params()
+    tokens = make_tokens()
+    dense = tfm.transformer_lm(params, tokens, n_heads=HEADS)
+    flash = tfm.transformer_lm(params, tokens, n_heads=HEADS,
+                               attn_impl="flash", axis_name=None)
+    np.testing.assert_allclose(
+        np.asarray(flash), np.asarray(dense), atol=3e-4
+    )
+
+
 def test_dense_lm_trains():
     params = make_params(seed=2)
     tokens = make_tokens(seed=3)
@@ -124,3 +137,11 @@ def test_depth_is_scanned_not_unrolled():
         return f.lower(p, tokens).compile().as_text()
 
     assert hlo_for(2).count(" dot(") == hlo_for(4).count(" dot(")
+
+
+def test_flash_attn_impl_rejects_sharded_axis():
+    """flash is the dense kernel: under a live sequence axis it would
+    silently attend only the local shard — the dispatch must refuse."""
+    x = jnp.zeros((1, 8, 2, 4), jnp.float32)
+    with pytest.raises(ValueError, match="local shard"):
+        tfm._attend(x, x, x, "flash", "seq")

@@ -76,6 +76,20 @@ def _rms_norm(x, scale):
 
 
 def _attend(q, k, v, impl: Optional[str], axis_name: Optional[str]):
+    if impl == "flash":
+        # fused Pallas kernel over the FULL sequence — the dense
+        # counterpart of the SP impls; opt-in pending hardware timing
+        # (the ops.batch_norm evidence-gating stance)
+        if axis_name is not None:
+            raise ValueError(
+                "attn_impl='flash' is the dense single-device kernel; it "
+                "would silently attend only the local shard under a "
+                "sequence-sharded axis. Use attn_impl='ring'/'ulysses' "
+                "with axis_name, or flash with axis_name=None."
+            )
+        from tpu_syncbn.ops.pallas_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
     if impl is None or axis_name is None:
         return _single_device_attention(q, k, v, causal=True, scale=None)
     if impl == "ring":

@@ -1,5 +1,6 @@
 """Numerical ops: functional batch-norm kernels (XLA-fused reference path;
-Pallas TPU fast path in pallas_bn)."""
+Pallas TPU fast path in pallas_bn) and the Pallas flash-attention kernel
+(pallas_attention)."""
 
 from tpu_syncbn.ops.batch_norm import (
     get_pallas_mode,
@@ -15,6 +16,7 @@ from tpu_syncbn.ops.batch_norm import (
 )
 
 __all__ = [
+    "flash_attention",
     "get_pallas_mode",
     "pallas_mode",
     "set_pallas_mode",
@@ -26,3 +28,14 @@ __all__ = [
     "batch_norm_train",
     "batch_norm_inference",
 ]
+
+
+def __getattr__(name):
+    # lazy: importing tpu_syncbn must not pay the Pallas/Mosaic import
+    # cost unless the kernel is actually used (the same convention as the
+    # function-local pallas_bn imports in batch_norm)
+    if name == "flash_attention":
+        from tpu_syncbn.ops.pallas_attention import flash_attention
+
+        return flash_attention
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

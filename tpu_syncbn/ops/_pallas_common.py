@@ -1,0 +1,20 @@
+"""Shared bits for the Pallas kernel modules (pallas_bn,
+pallas_attention) — one home so the interpret heuristic and the finite
+-inf stand-in cannot silently diverge between kernels.
+(``parallel.sequence`` keeps its own ``_NEG_BIG`` copy deliberately:
+the parallel layer does not import from ops.)"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# finite stand-in for -inf in masked logits: exp(_NEG_BIG - m) == 0
+# without the NaN that a true -inf produces when a whole row is masked
+NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def interpret() -> bool:
+    """Run kernels in interpret mode off-TPU so the CPU test mesh
+    exercises the same code path the TPU compiles."""
+    return jax.default_backend() != "tpu"

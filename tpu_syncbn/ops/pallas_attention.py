@@ -1,0 +1,261 @@
+"""Pallas TPU kernel for the attention hot op (flash-style fused softmax).
+
+The reference recipe has no attention (SURVEY §5.7), but this framework
+ships sequence parallelism as first-class (``parallel.sequence``), and
+the per-device inner loop of every SP scheme is plain causal attention —
+the transformer path's hot op, and the natural second Pallas target
+after the BN kernels (``ops/pallas_bn.py``).
+
+:func:`flash_attention` computes exact softmax attention in one fused
+kernel: the (L, L) score matrix is never materialized — each grid step
+holds one (block_q, D) query tile and streams (block_k, D) KV tiles
+through VMEM, carrying the online-softmax running (max, denominator,
+accumulator) in f32 scratch — the same algorithm
+``parallel.sequence._block_attend`` runs at the ring level, pushed down
+to the tile level. Under ``causal=True``, KV tiles strictly above the
+diagonal skip their matmuls via ``pl.when`` (no wasted MXU work; note
+the BlockSpec pipeline still streams every tile through VMEM — bounding
+the ki sweep per query block to also skip the dead DMA is deferred
+until hardware timing exists to justify the scalar-prefetch grid it
+needs); the diagonal tile masks with a 2-D iota.
+
+Backward is a ``jax.custom_vjp`` in plain XLA: one ``lax.scan`` over KV
+blocks recomputes P column-block by column-block from the saved
+logsumexp (O(L·block_k) live memory, never (L, L)) and accumulates
+dQ/dK/dV with the standard flash backward identities.
+
+Like the BN kernels, everything runs under ``interpret=True`` off-TPU
+(the CPU suite exercises the real kernel code path), and the kernel is
+an *opt-in* backend (``models.transformer``'s ``attn_impl="flash"``)
+until a hardware measurement justifies a default — the same
+evidence-gating stance as ``ops.batch_norm``'s ``auto``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_syncbn.ops._pallas_common import NEG_BIG as _NEG_BIG
+from tpu_syncbn.ops._pallas_common import interpret as _interpret
+
+_BLOCK_Q = 128
+_BLOCK_K = 128
+
+
+# -- forward kernel -------------------------------------------------------
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                 acc_ref, m_ref, l_ref, *,
+                 scale, causal, block_q, block_k, n_k, l_real):
+    """Grid (BH, n_q, n_k); ki is innermost (sequential on TPU), so the
+    VMEM scratch carries the online-softmax state across the ki sweep of
+    one (bh, qi) tile."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_BIG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    # causal: a KV tile strictly right of this query tile's last row
+    # touches nothing — skip its matmuls entirely
+    live = (k_start <= q_start + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        cols = k_start + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = cols < l_real  # right-pad KV rows are dead
+        if causal:
+            rows = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            mask = mask & (rows >= cols)
+        s = jnp.where(mask, s, _NEG_BIG)
+
+        m_prev = m_ref[...]  # (block_q, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = m_new
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[...] + jnp.log(l))[:, 0]
+
+
+def _flash_fwd_2d(q, k, v, *, causal, scale, block_q, block_k):
+    """(BH, L, D) in → ((BH, L, D) out, (BH, L) logsumexp)."""
+    bh, l_real, d = q.shape
+    n_q = pl.cdiv(l_real, block_q)
+    n_k = pl.cdiv(l_real, block_k)
+    pad_q = n_q * block_q - l_real
+    pad_k = n_k * block_k - l_real
+    qp = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0))) if pad_q else q
+    kp = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0))) if pad_k else k
+    vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0))) if pad_k else v
+
+    kernel = functools.partial(
+        _attn_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, n_k=n_k, l_real=l_real,
+    )
+    vmem = pltpu.VMEM
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=vmem),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=vmem),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=vmem),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=vmem),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+                         memory_space=vmem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, n_q * block_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, n_q * block_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),   # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running denom
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp)
+    return o[:, :l_real], lse[:, :l_real]
+
+
+# -- backward (XLA, blockwise scan — O(L·block_k) live memory) ------------
+
+
+def _flash_bwd_2d(res, do, *, causal, scale, block_k):
+    q, k, v, o, lse = res  # (BH, L, D)*4, (BH, L)
+    bh, l_real, d = q.shape
+    n_k = -(-l_real // block_k)
+    pad = n_k * block_k - l_real
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0)))
+    kb = k.reshape(bh, n_k, block_k, d)
+    vb = v.reshape(bh, n_k, block_k, d)
+
+    qf = q.astype(jnp.float32) * scale
+    dof = do.astype(jnp.float32)
+    # D_i = rowsum(dO ∘ O): the softmax-jacobian diagonal correction
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (BH, L)
+    rows = jnp.arange(l_real)
+
+    def kv_block(carry, blk):
+        dq_acc = carry
+        k_blk, v_blk, ki = blk  # (BH, block_k, D) ×2, scalar
+        cols = ki * block_k + jnp.arange(block_k)
+        s = jnp.einsum("bqd,bkd->bqk", qf, k_blk.astype(jnp.float32))
+        mask = cols[None, :] < l_real
+        if causal:
+            mask = mask & (rows[:, None] >= cols[None, :])
+        s = jnp.where(mask[None], s, _NEG_BIG)
+        p = jnp.exp(s - lse[..., None])  # (BH, L, block_k)
+        dv_blk = jnp.einsum("bqk,bqd->bkd", p, dof)
+        dp = jnp.einsum("bqd,bkd->bqk", dof, v_blk.astype(jnp.float32))
+        ds = p * (dp - delta[..., None])
+        dq_acc = dq_acc + jnp.einsum(
+            "bqk,bkd->bqd", ds, k_blk.astype(jnp.float32)
+        )
+        dk_blk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        return dq_acc, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros((bh, l_real, d), jnp.float32)
+    dq, (dk_blocks, dv_blocks) = lax.scan(
+        kv_block, dq0,
+        (kb.transpose(1, 0, 2, 3), vb.transpose(1, 0, 2, 3),
+         jnp.arange(n_k)),
+    )
+    dk = dk_blocks.transpose(1, 0, 2, 3).reshape(bh, n_k * block_k, d)
+    dv = dv_blocks.transpose(1, 0, 2, 3).reshape(bh, n_k * block_k, d)
+    return (
+        (dq * scale).astype(q.dtype),
+        dk[:, :l_real].astype(q.dtype),
+        dv[:, :l_real].astype(q.dtype),
+    )
+
+
+# -- public API -----------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_2d(q, k, v, causal, scale, block_q, block_k):
+    o, _ = _flash_fwd_2d(q, k, v, causal=causal, scale=scale,
+                         block_q=block_q, block_k=block_k)
+    return o
+
+
+def _flash_2d_fwd(q, k, v, causal, scale, block_q, block_k):
+    o, lse = _flash_fwd_2d(q, k, v, causal=causal, scale=scale,
+                           block_q=block_q, block_k=block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_2d_bwd(causal, scale, block_q, block_k, res, do):
+    return _flash_bwd_2d(res, do, causal=causal, scale=scale,
+                         block_k=block_k)
+
+
+_flash_2d.defvjp(_flash_2d_fwd, _flash_2d_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = _BLOCK_Q,
+    block_k: int = _BLOCK_K,
+) -> jax.Array:
+    """Exact fused softmax attention, ``(B, L, H, D) → (B, L, H, D)``.
+
+    Drop-in for ``parallel.sequence._single_device_attention`` (same
+    semantics, tolerances at f32 rounding); differentiable via the
+    blockwise custom VJP above. ``scale`` defaults to ``D**-0.5``.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected (B, L, H, D), got {q.shape}")
+    b, l, h, d = q.shape
+    s = float(scale) if scale is not None else d ** -0.5
+    to2d = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, l, x.shape[-1])
+    o = _flash_2d(to2d(q), to2d(k), to2d(v), causal, s, block_q, block_k)
+    return o.reshape(b, h, l, d).transpose(0, 2, 1, 3)

@@ -45,8 +45,7 @@ from tpu_syncbn.parallel.collectives import moments_from_stats
 _BLOCK_M = 256
 
 
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+from tpu_syncbn.ops._pallas_common import interpret as _interpret
 
 
 def _sds(shape, dtype, like: jax.Array):
