@@ -35,8 +35,8 @@ from _common import log
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ART = os.path.join(ROOT, "benchmarks", "artifacts")
 
-STAGES = ["pallas_parity", "pallas_sweep", "syncbn_overhead",
-          "buffer_broadcast", "bench", "entry_compile"]
+STAGES = ["pallas_parity", "flash_parity", "pallas_sweep",
+          "syncbn_overhead", "buffer_broadcast", "bench", "entry_compile"]
 
 
 def save(name, payload):
@@ -165,6 +165,106 @@ def _pallas_parity_cases(jax, jnp, np, bn_ops, pb, results):
         log(f"[pallas_parity] (M={m}, C={c}) ok")
 
 
+def _attn_code_version():
+    """Fingerprint of the attention-kernel sources (same rule as
+    ``_bn_code_version``: evidence validates a binary)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for rel in ("tpu_syncbn/ops/pallas_attention.py",
+                "tpu_syncbn/ops/_pallas_common.py"):
+        with open(os.path.join(ROOT, rel), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def stage_flash_parity():
+    """The flash-attention kernel COMPILED on the chip (not interpret
+    mode) vs the softmax oracle — fwd and grads, per-case incremental
+    save like pallas_parity."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    from tpu_syncbn.ops import pallas_attention as pa
+    from tpu_syncbn.parallel import sequence
+
+    version = _attn_code_version()
+    results = {"backend": "tpu", "code_version": version,
+               "cases": [], "complete": False}
+    try:
+        with open(os.path.join(ART, "tpu_flash_parity.json")) as f:
+            prev = json.load(f)
+        if (prev.get("backend") == "tpu"
+                and prev.get("code_version") == version):
+            results["cases"] = [c for c in prev.get("cases", []) if c.get("ok")]
+    except (OSError, json.JSONDecodeError):
+        pass
+    done = {(c["l"], c["d"], c["causal"], c["dtype"])
+            for c in results["cases"]}
+    rng = np.random.default_rng(0)
+    cases = [
+        (256, 64, True, "float32"),
+        (256, 64, False, "float32"),
+        (1000, 128, True, "float32"),   # ragged final blocks
+        (2048, 128, True, "bfloat16"),
+    ]
+    try:
+        for (l, d, causal, dtype) in cases:
+            if (l, d, causal, dtype) in done:
+                log(f"[flash_parity] L={l} d={d} already passed; skipping")
+                continue
+            t0 = time.perf_counter()
+            jt = jnp.dtype(dtype)
+            q, k, v = (
+                jnp.asarray(rng.standard_normal((1, l, 4, d)),
+                            jnp.float32).astype(jt)
+                for _ in range(3)
+            )
+            got = jax.jit(
+                lambda q, k, v: pa.flash_attention(q, k, v, causal=causal)
+            )(q, k, v)
+            got.block_until_ready()
+            want = sequence._single_device_attention(
+                q, k, v, causal=causal, scale=None
+            )
+            atol = 3e-2 if dtype == "bfloat16" else 2e-4
+            np.testing.assert_allclose(
+                np.asarray(got, np.float32), np.asarray(want, np.float32),
+                atol=atol,
+            )
+            if dtype == "float32":  # grads once per f32 case
+                # vs the ORACLE's grads: a compiled-path bug in the lse
+                # output corrupts only the backward (p = exp(s - lse)),
+                # so finiteness alone would certify nothing
+                wgt = jnp.asarray(
+                    rng.standard_normal(got.shape), jnp.float32
+                )
+                g = jax.jit(jax.grad(
+                    lambda q: jnp.sum(wgt * pa.flash_attention(
+                        q, k, v, causal=causal))
+                ))(q)
+                g_ref = jax.grad(
+                    lambda q: jnp.sum(
+                        wgt * sequence._single_device_attention(
+                            q, k, v, causal=causal, scale=None))
+                )(q)
+                np.testing.assert_allclose(
+                    np.asarray(g), np.asarray(g_ref), atol=5e-4
+                )
+            results["cases"].append({
+                "l": l, "d": d, "causal": causal, "dtype": dtype,
+                "ok": True,
+                "elapsed_s": round(time.perf_counter() - t0, 2),
+            })
+            save("flash_parity", results)
+            log(f"[flash_parity] L={l} d={d} causal={causal} {dtype} ok")
+        results["complete"] = True
+    finally:
+        save("flash_parity", results)
+
+
 def stage_entry_compile():
     """Compile the driver's ``entry()`` program on the chip so its
     end-of-round compile check is a persistent-cache hit instead of a
@@ -248,6 +348,8 @@ def main():
         try:
             if stage == "pallas_parity":
                 stage_pallas_parity()
+            elif stage == "flash_parity":
+                stage_flash_parity()
             elif stage == "entry_compile":
                 stage_entry_compile()
             elif stage == "pallas_sweep":

@@ -55,7 +55,7 @@ ART = os.path.join(ROOT, "benchmarks", "artifacts")
 # priority order, not the battery's didactic order: cache prewarm first
 # (amortizes every later stage's compile), then the headline number
 STAGES = ["entry_compile", "bench", "syncbn_overhead", "buffer_broadcast",
-          "pallas_parity", "pallas_sweep"]
+          "pallas_parity", "flash_parity", "pallas_sweep"]
 
 
 def stage_done(stage: str) -> bool:
@@ -65,7 +65,7 @@ def stage_done(stage: str) -> bool:
             payload = json.load(f)
     except (OSError, json.JSONDecodeError):
         return False
-    if stage == "pallas_parity":  # written by the battery in-process
+    if stage in ("pallas_parity", "flash_parity"):  # battery in-process
         # "complete" distinguishes all-cases-passed from a mid-stage tunnel
         # death; artifacts predating the flag carry all 5 shape cases
         complete = payload.get("complete", len(payload.get("cases", [])) >= 5)

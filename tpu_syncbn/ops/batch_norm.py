@@ -103,7 +103,9 @@ def kernel_code_version() -> str:
 
     h = hashlib.sha256()
     here = os.path.dirname(os.path.abspath(__file__))
-    for name in ("pallas_bn.py", "batch_norm.py"):
+    # _pallas_common is part of the binary under test (pallas_bn imports
+    # its interpret heuristic), so it participates in the fingerprint
+    for name in ("pallas_bn.py", "batch_norm.py", "_pallas_common.py"):
         with open(os.path.join(here, name), "rb") as f:
             h.update(f.read())
     return h.hexdigest()[:16]
