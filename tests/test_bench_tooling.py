@@ -186,3 +186,20 @@ class TestSweepResume:
         out, err = _run_sweep(partial)
         assert "unreadable partial file" in err
         assert out["by_block"]  # sweep still completed from scratch
+
+
+@pytest.mark.slow
+def test_zigzag_flops_benchmark_contract():
+    """The zigzag FLOP comparison must report a real reduction (>1) and
+    carry the structural prediction beside the measurement."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks", "zigzag_flops.py"),
+         "--simulate", "2", "--seq-per-device", "64"],
+        cwd=os.path.join(ROOT, "benchmarks"),
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["reduction_x"] > 1.0
+    assert out["predicted_x"] == round(4 * 2 / (2 * 1 + 3), 4)
+    assert out["zigzag_flops"] < out["contiguous_flops"]
