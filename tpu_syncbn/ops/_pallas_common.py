@@ -18,3 +18,13 @@ def interpret() -> bool:
     """Run kernels in interpret mode off-TPU so the CPU test mesh
     exercises the same code path the TPU compiles."""
     return jax.default_backend() != "tpu"
+
+
+def sds(shape, dtype, like: jax.Array):
+    """ShapeDtypeStruct whose varying-axes type matches ``like``: inside
+    a ``check_vma=True`` shard_map, pallas_call outputs must declare
+    their vma explicitly or lowering fails."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)

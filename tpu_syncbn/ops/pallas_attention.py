@@ -49,6 +49,9 @@ _BLOCK_Q = 128
 _BLOCK_K = 128
 
 
+from tpu_syncbn.ops._pallas_common import sds as _sds
+
+
 # -- forward kernel -------------------------------------------------------
 
 
@@ -145,8 +148,8 @@ def _flash_fwd_2d(q, k, v, *, causal, scale, block_q, block_k):
                          memory_space=vmem),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, n_q * block_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, n_q * block_q), jnp.float32),
+            _sds((bh, n_q * block_q, d), q.dtype, qp),
+            _sds((bh, n_q * block_q), jnp.float32, qp),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),   # acc
@@ -197,7 +200,10 @@ def _flash_bwd_2d(res, do, *, causal, scale, block_k):
         dk_blk = jnp.einsum("bqk,bqd->bkd", ds, qf)
         return dq_acc, (dk_blk, dv_blk)
 
-    dq0 = jnp.zeros((bh, l_real, d), jnp.float32)
+    # derive the carry init from a varying operand (qf * 0), not a fresh
+    # constant: under check_vma=True a scan carry must keep the same
+    # varying type as the body output or lowering fails
+    dq0 = qf * 0.0
     dq, (dk_blocks, dv_blocks) = lax.scan(
         kv_block, dq0,
         (kb.transpose(1, 0, 2, 3), vb.transpose(1, 0, 2, 3),

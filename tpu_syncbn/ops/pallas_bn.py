@@ -48,14 +48,7 @@ _BLOCK_M = 256
 from tpu_syncbn.ops._pallas_common import interpret as _interpret
 
 
-def _sds(shape, dtype, like: jax.Array):
-    """ShapeDtypeStruct whose varying-axes type matches ``like``: inside a
-    ``check_vma=True`` shard_map (the trainer default), pallas_call
-    outputs must declare their vma explicitly or lowering fails."""
-    vma = getattr(jax.typeof(like), "vma", None)
-    if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-    return jax.ShapeDtypeStruct(shape, dtype)
+from tpu_syncbn.ops._pallas_common import sds as _sds
 
 
 def _as_2d(x: jax.Array) -> tuple[jax.Array, int]:

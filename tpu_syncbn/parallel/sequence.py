@@ -339,6 +339,7 @@ def ulysses_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
+    local_impl: Optional[str] = None,
 ) -> jax.Array:
     """Sequence parallelism by head redistribution (DeepSpeed-Ulysses).
 
@@ -353,11 +354,35 @@ def ulysses_attention(
     2·(N-1)/N of (Q,K,V,O) per device vs the ring's (N-1)/N of (K,V),
     but the attention itself is one big local matmul over the full
     sequence (best MXU shape) instead of N accumulation steps.
+
+    ``local_impl="flash"`` runs the local full-sequence attention
+    through the fused Pallas kernel (``ops.flash_attention``) instead of
+    the score-matrix oracle: the (L, L) scores — Ulysses' memory ceiling
+    for long context — are then never materialized. Default None keeps
+    the oracle (the evidence-gating stance: kernels are opt-in until
+    timed on hardware). Like every Pallas path (DESIGN.md §3), the
+    kernel body mixes unvarying scratch with varying blocks, so the
+    enclosing ``shard_map`` needs ``check_vma=False`` when flash is
+    selected.
     """
+    if local_impl not in (None, "flash"):
+        raise ValueError(
+            f"local_impl must be None or 'flash', got {local_impl!r}"
+        )
     n = lax.axis_size(axis_name)
     h = q.shape[2]
+    if local_impl == "flash":
+        from tpu_syncbn.ops.pallas_attention import flash_attention
+
+        local_attn = functools.partial(
+            flash_attention, causal=causal, scale=scale
+        )
+    else:
+        local_attn = functools.partial(
+            _single_device_attention, causal=causal, scale=scale
+        )
     if n == 1:
-        return _single_device_attention(q, k, v, causal=causal, scale=scale)
+        return local_attn(q, k, v)
     if h % n:
         raise ValueError(f"heads ({h}) must be divisible by axis size ({n})")
 
@@ -372,7 +397,7 @@ def ulysses_attention(
         )
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    oh = _single_device_attention(qh, kh, vh, causal=causal, scale=scale)
+    oh = local_attn(qh, kh, vh)
     return to_seq(oh)
 
 
@@ -386,13 +411,17 @@ def sharded_self_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     impl: str = "ring",
+    local_impl: Optional[str] = None,
 ) -> jax.Array:
     """Array-level convenience wrapper: shard global ``(B, L, H, D)``
     arrays along ``L`` over ``mesh[axis_name]`` and run ring, zigzag-ring
     or Ulysses attention under ``shard_map`` (select with ``impl``).
     ``"ring_zigzag"`` (causal only) reorders the sequence into the
     zigzag layout on the way in and back on the way out, so callers keep
-    ordinary position order end to end."""
+    ordinary position order end to end. ``local_impl="flash"`` (Ulysses
+    only) runs the local attention through the Pallas kernel; the
+    wrapper then builds the shard_map with ``check_vma=False`` (pallas
+    bodies mix unvarying scratch with varying blocks, DESIGN.md §3)."""
     if impl == "ring_zigzag":
         if not causal:
             raise ValueError(
@@ -414,15 +443,24 @@ def sharded_self_attention(
                 f"impl must be one of {sorted(fns) + ['ring_zigzag']}, "
                 f"got {impl!r}"
             )
-        fn = functools.partial(
-            base, axis_name=axis_name, causal=causal, scale=scale
-        )
+        kw = dict(axis_name=axis_name, causal=causal, scale=scale)
+        if impl == "ulysses":
+            kw["local_impl"] = local_impl
+        elif local_impl is not None:
+            raise ValueError(
+                f"local_impl applies to impl='ulysses' only, got "
+                f"impl={impl!r}"
+            )
+        fn = functools.partial(base, **kw)
+    if local_impl is not None and impl == "ring_zigzag":
+        raise ValueError("local_impl applies to impl='ulysses' only")
     seq_sharded = P(None, axis_name, None, None)
     shard_fn = jax.shard_map(
         fn,
         mesh=mesh,
         in_specs=(seq_sharded, seq_sharded, seq_sharded),
         out_specs=seq_sharded,
+        check_vma=local_impl != "flash",
     )
     put = lambda x: jax.device_put(x, NamedSharding(mesh, seq_sharded))
     out = shard_fn(put(q), put(k), put(v))
