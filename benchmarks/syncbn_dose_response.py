@@ -1,0 +1,106 @@
+"""Dose-response: SyncBN-vs-per-replica divergence as per-chip batch shrinks.
+
+The reference's claim is not just "per-device BN hurts" but that it
+hurts *at small per-device batches* (``README.md:3``). This sweep runs
+the classification convergence A/B (``syncbn_convergence_ab.py``) at
+several per-chip batch sizes on the same 8-replica mesh and reports the
+per-replica arm's absolute trajectory damage (loss-curve MAE) alongside
+the divergence ratio, as one JSON line — the dose-response curve behind
+the single-point A/Bs. NOTE each dose has its OWN oracle (the
+single-device arm trains at global batch = replicas × b, which varies
+with the dose), so each point records its ``global_batch`` and the
+oracle's final loss; compare ratios across points, and absolute MAEs
+only with that caveat in mind. Points are written to ``--out``
+incrementally: a mid-sweep failure keeps every completed dose.
+
+    python benchmarks/syncbn_dose_response.py --batches 1 2 4 8
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+from _common import log
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--simulate", type=int, default=8)
+    p.add_argument("--batches", type=int, nargs="+", default=[1, 2, 4, 8])
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--out", default=None, help="also write the JSON here")
+    return p.parse_args()
+
+
+def _last_json_line(stdout: str):
+    """First parseable JSON line scanning from the end — tolerates any
+    trailing library chatter on stdout (the tpu_validation.run_sub
+    pattern)."""
+    for line in reversed(stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+    raise RuntimeError("child produced no JSON line")
+
+
+def main():
+    args = parse_args()
+    result = {
+        "metric": "syncbn_dose_response_per_chip_batch",
+        "replicas": args.simulate,
+        "steps": args.steps,
+        "points": [],
+        "failed": [],
+    }
+
+    def save():
+        if args.out:
+            tmp = args.out + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(result, f, indent=2)
+            os.replace(tmp, args.out)
+
+    for b in args.batches:
+        log(f"per-chip batch {b}...")
+        try:
+            proc = subprocess.run(
+                [sys.executable,
+                 os.path.join(HERE, "syncbn_convergence_ab.py"),
+                 "--simulate", str(args.simulate),
+                 "--per-chip-batch", str(b), "--steps", str(args.steps)],
+                cwd=HERE, capture_output=True, text=True, timeout=3600,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"rc={proc.returncode}: {proc.stderr[-1000:]}"
+                )
+            d = _last_json_line(proc.stdout)
+        except (subprocess.TimeoutExpired, RuntimeError) as e:
+            # completed doses are training hours — keep them
+            log(f"  batch {b} FAILED: {e}")
+            result["failed"].append(b)
+            save()
+            continue
+        result["points"].append({
+            "per_chip_batch": b,
+            "global_batch": args.simulate * b,  # = this dose's oracle batch
+            "oracle_final_loss": d["final_loss"]["oracle"],
+            "syncbn_loss_mae": d["syncbn_loss_mae"],
+            "perreplica_loss_mae": d["perreplica_loss_mae"],
+            "divergence_ratio": d["divergence_ratio"],
+        })
+        save()
+        log(f"  perreplica MAE {d['perreplica_loss_mae']}, "
+            f"ratio {d['divergence_ratio']}")
+    print(json.dumps(result))
+    if result["failed"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
