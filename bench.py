@@ -17,6 +17,12 @@ touches the backend in-process: the environment's known failure mode is a
 plugin), which an in-process except clause can never catch. On CPU
 fallback the workload shrinks (batch 8, 2 steps, 64x64 images) so the
 JSON line always lands inside the driver budget.
+
+``build_program`` / ``prewarm`` exist so the TPU watcher's
+``bench_compile`` stage compiles *this exact program* into the
+persistent cache ahead of time: the AOT ``lower().compile()`` goes
+through the same jit instance as ``train_step``, so a later bench run's
+first step is a disk-hit compile instead of a window-sized fresh one.
 """
 
 import json
@@ -59,6 +65,148 @@ def _peak_flops(device) -> float | None:
     return None
 
 
+def bench_config(on_accel: bool) -> dict:
+    """The workload bench times, resolved from the environment once.
+
+    Shared with the ``bench_compile`` prewarm stage — the prewarmed
+    program must be *this* config, not an approximation of it (round 3's
+    lesson: ``entry_compile`` warmed a different program and the cache
+    never amortized bench's first compile)."""
+    batch, steps, side = (64, 10, 224) if on_accel else (8, 2, 64)
+    return {
+        "per_chip_batch": int(os.environ.get("BENCH_PER_CHIP_BATCH", batch)),
+        "steps": int(os.environ.get("BENCH_STEPS", steps)),
+        "side": int(os.environ.get("BENCH_IMAGE_SIDE", side)),
+    }
+
+
+def _build_with_demotion(builder):
+    """Run ``builder()`` under bench's BN-backend policy: evidence-gated
+    Pallas when the gate selects it, demoted once to the XLA-fusion path
+    if Pallas fails its first hardware contact. ONE copy of this policy,
+    shared by main() and prewarm() — if they drifted, the prewarmed
+    program would silently diverge from what bench traces and the
+    persistent-cache hit would be lost.
+
+    Returns ``(builder_result, bn_backend_label)``."""
+    from tpu_syncbn.ops import batch_norm as bn_ops
+
+    pallas_active = bn_ops._use_pallas()  # what the trace will pick
+    bn_backend = "pallas" if pallas_active else "xla"
+    try:
+        return builder(), bn_backend
+    except Exception as e:
+        if not pallas_active:
+            raise  # Pallas was never in play: don't fabricate provenance
+        # first hardware contact of the Pallas kernels must not cost the
+        # artifact: demote to the XLA-fusion BN path and retry
+        log(f"BN pallas path failed ({type(e).__name__}: {e}); "
+            "demoting to XLA fusion and retrying")
+        bn_ops.set_pallas_mode("off")
+        return builder(), "xla (pallas demoted)"
+
+
+def _loss_fn(m, batch):
+    import jax.numpy as jnp
+    import optax
+
+    x, y = batch
+    logits = m(x).astype(jnp.float32)  # CE in f32
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+
+def build_program(per_chip_batch: int, side: int, *, with_flops: bool = True):
+    """Construct the exact training program bench times: bf16 SyncBN
+    ResNet-50 under DataParallel on the data-parallel mesh, with the
+    global batch device_put to the step's input sharding.
+
+    Deterministic by construction (seeded init, zero batch) so two
+    processes building it produce byte-identical HLO — which is what
+    makes an AOT prewarm compile a persistent-cache hit for a later
+    bench run. Requires ``runtime.initialize()`` to have run.
+
+    Returns ``(dp, batch, flops_per_step)``; ``flops_per_step`` is None
+    when ``with_flops=False`` or cost analysis is unavailable.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from flax import nnx
+
+    from tpu_syncbn import models, nn, parallel, runtime
+
+    n_chips = runtime.global_device_count()
+    global_batch = per_chip_batch * n_chips
+    mesh = runtime.data_parallel_mesh()
+
+    # bfloat16 compute (MXU fast path); params f32, BN accumulates f32
+    model = nn.convert_sync_batchnorm(
+        models.resnet50(num_classes=1000, dtype=jnp.bfloat16, rngs=nnx.Rngs(0))
+    )
+    dp = parallel.DataParallel(
+        model, optax.sgd(0.1, momentum=0.9), _loss_fn, mesh=mesh
+    )
+    x = jnp.zeros((global_batch, side, side, 3), jnp.float32)
+    y = jnp.zeros((global_batch,), jnp.int32)
+    batch = jax.device_put((x, y), dp.batch_sharding)
+
+    # FLOPs per step from HLO cost analysis on the *lowered*
+    # (pre-compile) module — a trace, not a second backend compile.
+    # Done before any donated execution so the args are still live.
+    flops = None
+    if with_flops:
+        try:
+            cost = dp.lowered_train_step(batch).cost_analysis()
+            if cost and cost.get("flops"):
+                flops = float(cost["flops"])
+        except Exception as e:  # cost analysis is an annotation, never fatal
+            log(f"cost analysis unavailable: {type(e).__name__}: {e}")
+
+    return dp, batch, flops
+
+
+def prewarm() -> dict:
+    """AOT-compile bench's exact train-step program into the persistent
+    compilation cache — no warmup, no timing, no donated execution.
+
+    ``dp.lowered_train_step`` lowers through the same ``jax.jit``
+    instance that ``dp.train_step`` calls, so the compiled executable is
+    cached under the very key a subsequent ``bench.py`` run looks up.
+    Mirrors bench's BN-backend selection (evidence-gated auto with
+    demotion to XLA fusion on hardware failure) so the prewarmed program
+    matches what bench will actually trace.
+
+    Assumes probe + ``runtime.initialize()`` were already done by the
+    caller (the validation battery does both).
+    """
+    from tpu_syncbn.ops import batch_norm as bn_ops
+
+    cfg = bench_config(True)
+
+    def build_and_compile():
+        dp, batch, _ = build_program(
+            cfg["per_chip_batch"], cfg["side"], with_flops=False
+        )
+        dp.lowered_train_step(batch).compile()
+
+    # unlike main() (whose process exits), prewarm is a library call
+    # inside a long-lived battery process: a demotion here must not leak
+    # 'off' into later in-process stages, which would trace different
+    # programs than the driver's fresh process resolves
+    orig_mode = bn_ops.get_pallas_mode()
+    t0 = time.perf_counter()
+    try:
+        _, bn_backend = _build_with_demotion(build_and_compile)
+    finally:
+        bn_ops.set_pallas_mode(orig_mode)
+    return {
+        "compile_s": round(time.perf_counter() - t0, 2),
+        "bn_backend": bn_backend,
+        "per_chip_batch": cfg["per_chip_batch"],
+        "image_side": cfg["side"],
+    }
+
+
 def main():
     from tpu_syncbn.runtime import probe
 
@@ -67,11 +215,8 @@ def main():
     log(f"probe: platform={info.platform} devices={info.device_count}")
 
     import jax
-    import jax.numpy as jnp
-    import optax
-    from flax import nnx
 
-    from tpu_syncbn import models, nn, parallel, runtime
+    from tpu_syncbn import runtime
 
     runtime.initialize()
     n_chips = runtime.global_device_count()
@@ -79,73 +224,31 @@ def main():
 
     # CPU fallback must emit its JSON line fast; the accelerator path runs
     # the real headline shape.
-    if on_accel:
-        per_chip_batch = int(os.environ.get("BENCH_PER_CHIP_BATCH", "64"))
-        steps = int(os.environ.get("BENCH_STEPS", "10"))
-        side = int(os.environ.get("BENCH_IMAGE_SIDE", "224"))
-    else:
-        per_chip_batch = int(os.environ.get("BENCH_PER_CHIP_BATCH", "8"))
-        steps = int(os.environ.get("BENCH_STEPS", "2"))
-        side = int(os.environ.get("BENCH_IMAGE_SIDE", "64"))
+    cfg = bench_config(on_accel)
+    per_chip_batch, steps, side = cfg["per_chip_batch"], cfg["steps"], cfg["side"]
     global_batch = per_chip_batch * n_chips
-    image = (side, side, 3)
-
-    def loss_fn(m, batch):
-        x, y = batch
-        logits = m(x).astype(jnp.float32)  # CE in f32
-        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
-
-    mesh = runtime.data_parallel_mesh()
 
     def build_and_warm():
-        # bfloat16 compute (MXU fast path); params f32, BN accumulates f32
-        model = nn.convert_sync_batchnorm(
-            models.resnet50(
-                num_classes=1000, dtype=jnp.bfloat16, rngs=nnx.Rngs(0)
-            )
-        )
-        dp = parallel.DataParallel(
-            model, optax.sgd(0.1, momentum=0.9), loss_fn, mesh=mesh
-        )
-        x = jnp.zeros((global_batch, *image), jnp.float32)
-        y = jnp.zeros((global_batch,), jnp.int32)
-        batch = jax.device_put((x, y), dp.batch_sharding)
-
-        # FLOPs per step from HLO cost analysis on the *lowered*
-        # (pre-compile) module — a trace, not a second backend compile.
-        # Done before any donated execution so the args are still live.
-        flops = None
-        try:
-            cost = dp.lowered_train_step(batch).cost_analysis()
-            if cost and cost.get("flops"):
-                flops = float(cost["flops"])
-        except Exception as e:  # cost analysis is an annotation, never fatal
-            log(f"cost analysis unavailable: {type(e).__name__}: {e}")
-
+        dp, batch, flops = build_program(per_chip_batch, side)
         log("compiling + warmup...")
         t_c = time.perf_counter()
         for _ in range(3 if on_accel else 1):
             out = dp.train_step(batch)
         out.loss.block_until_ready()
-        log(f"compile+warmup took {time.perf_counter()-t_c:.1f}s")
-        return dp, batch, flops
+        warm_s = time.perf_counter() - t_c
+        log(f"compile+warmup took {warm_s:.1f}s")
+        return dp, batch, flops, warm_s
 
-    from tpu_syncbn.ops import batch_norm as bn_ops
+    (dp, batch, flops_per_step, warm_s), bn_backend = _build_with_demotion(
+        build_and_warm
+    )
 
-    pallas_active = bn_ops._use_pallas()  # what the trace will pick
-    bn_backend = "pallas" if pallas_active else "xla"
-    try:
-        dp, batch, flops_per_step = build_and_warm()
-    except Exception as e:
-        if not pallas_active:
-            raise  # Pallas was never in play: don't fabricate provenance
-        # first hardware contact of the Pallas kernels must not cost the
-        # benchmark artifact: demote to the XLA-fusion BN path and retry
-        log(f"BN pallas path failed ({type(e).__name__}: {e}); "
-            "demoting to XLA fusion and retrying")
-        bn_ops.set_pallas_mode("off")
-        bn_backend = "xla (pallas demoted)"
-        dp, batch, flops_per_step = build_and_warm()
+    # A disk-hit compile (bench_compile prewarmed this exact program)
+    # leaves most of the window unspent — buy timing fidelity with it.
+    # Only when the user didn't pin BENCH_STEPS explicitly.
+    if on_accel and warm_s < 60 and "BENCH_STEPS" not in os.environ:
+        steps *= 3
+        log(f"compile was a cache hit ({warm_s:.1f}s); extending to {steps} steps")
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -176,6 +279,8 @@ def main():
         "chips": n_chips,
         "per_chip_batch": per_chip_batch,
         "image_side": side,
+        "steps": steps,
+        "compile_warmup_s": round(warm_s, 1),
         "mfu": mfu,
         "flops_per_step": flops_per_step,
     }))

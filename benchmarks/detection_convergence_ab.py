@@ -15,8 +15,14 @@ statistics are noise. Three arms, identical init and data order:
                   normalizes by 2-sample statistics.
 
 Prints one JSON line: mean |loss - oracle| for both arms plus the
-headline divergence ratio. The RetinaNet loss (sigmoid focal + smooth-L1,
-models/retinanet.py) and the anchor machinery are the framework's own.
+headline divergence ratio, AND a ``val_map`` block — decode + per-class
+NMS + COCO-style AP@[.5:.95] on a held-out synthetic set for each arm
+(the task metric in the domain's own currency: the reference names
+detection as where per-replica BN hurts, ``README.md:3``). Eval runs the
+model in eval mode, i.e. through the *running statistics* — exactly the
+state per-replica BN corrupts. The RetinaNet loss (sigmoid focal +
+smooth-L1, models/retinanet.py), decode/NMS and the mAP harness
+(utils/coco_map.py) are the framework's own.
 
     python benchmarks/detection_convergence_ab.py --simulate 8 \
         --steps 150 --per-chip-batch 2 [--curves out.json]
@@ -43,6 +49,9 @@ def parse_args():
                    help="0 keeps the dynamics stable so curve distance "
                         "measures the statistics error, not f32 chaos")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--eval-images", type=int, default=64,
+                   help="held-out synthetic images for the per-arm mAP")
+    p.add_argument("--eval-top-k", type=int, default=100)
     p.add_argument("--curves", default=None,
                    help="write full per-step loss curves to this JSON")
     return p.parse_args()
@@ -60,7 +69,8 @@ def main():
     from jax.sharding import Mesh
 
     from tpu_syncbn import data as tdata
-    from tpu_syncbn import models, nn, parallel
+    from tpu_syncbn import models, nn, parallel, utils
+    from tpu_syncbn.models import detection as det
     from tpu_syncbn.models.resnet import BasicBlock, ResNet
 
     R = args.simulate
@@ -98,6 +108,41 @@ def main():
                 idx = perm[s * global_batch : (s + 1) * global_batch]
                 yield tuple(f[idx] for f in stacked)
 
+    # held-out synthetic set: same generator family, disjoint seed — the
+    # task-metric readout must not score the training images
+    heldout = tdata.SyntheticDetectionDataset(
+        length=args.eval_images, image_size=size,
+        num_classes=args.num_classes, max_boxes=args.max_boxes,
+        seed=args.seed + 1000,
+    )
+
+    def eval_map(dp) -> dict:
+        """Decode + per-class NMS + COCO-style AP on the held-out set, in
+        eval mode — scoring through the running stats each arm learned
+        (the exact state per-replica BN corrupts)."""
+        m = dp.sync_to_model()
+        m.eval()
+        detections, ground_truths = [], []
+        for i in range(len(heldout)):
+            image, gboxes, glabels, gvalid = heldout[i]
+            boxes, scores, classes, keep_mask = m.decode(
+                image[None], top_k=args.eval_top_k
+            )
+            above = np.asarray(keep_mask[0])
+            b = np.asarray(boxes[0])[above]
+            s = np.asarray(scores[0])[above]
+            c = np.asarray(classes[0])[above]
+            kept = det.batched_nms(b, s, c)
+            detections.append((b[kept], s[kept], c[kept]))
+            gvalid = np.asarray(gvalid)
+            ground_truths.append(
+                (np.asarray(gboxes)[gvalid], np.asarray(glabels)[gvalid])
+            )
+        ap = utils.evaluate_detections(
+            detections, ground_truths, num_classes=args.num_classes
+        )
+        return {k: round(float(ap[k]), 4) for k in ("mAP", "AP50", "AP75")}
+
     def run(sync: bool, n_devices: int):
         mesh = Mesh(np.asarray(jax.devices()[:n_devices]), ("data",))
         model = make_model()
@@ -120,14 +165,14 @@ def main():
             losses.append(float(out.loss))
             box_losses.append(float(out.metrics["box_loss"]))
         return (np.asarray(losses), np.asarray(box_losses),
-                running_stats_vector(dp.rest))
+                running_stats_vector(dp.rest), eval_map(dp))
 
     log("arm 1/3: oracle (1 device, global batch)")
-    oracle, oracle_box, oracle_stats = run(sync=False, n_devices=1)
+    oracle, oracle_box, oracle_stats, oracle_map = run(sync=False, n_devices=1)
     log("arm 2/3: syncbn (R devices)")
-    synced, sync_box, sync_stats = run(sync=True, n_devices=R)
+    synced, sync_box, sync_stats, sync_map = run(sync=True, n_devices=R)
     log("arm 3/3: per-replica BN (R devices)")
-    local, local_box, local_stats = run(sync=False, n_devices=R)
+    local, local_box, local_stats, local_map = run(sync=False, n_devices=R)
 
     sync_mae = float(np.abs(synced - oracle).mean())
     local_mae = float(np.abs(local - oracle).mean())
@@ -168,6 +213,15 @@ def main():
             "oracle": round(float(oracle[-1]), 4),
             "syncbn": round(float(synced[-1]), 4),
             "perreplica": round(float(local[-1]), 4),
+        },
+        # the task metric, held-out, eval-mode (running stats): the
+        # BASELINE framing ("match NCCL-SyncBN top-1/mAP") in the
+        # detection domain's own currency
+        "val_map": {
+            "eval_images": args.eval_images,
+            "oracle": oracle_map,
+            "syncbn": sync_map,
+            "perreplica": local_map,
         },
     }
     if args.curves:

@@ -35,8 +35,12 @@ from _common import log
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ART = os.path.join(ROOT, "benchmarks", "artifacts")
 
+# default order = direct-run execution order: bench_compile strictly
+# before bench so a direct battery run during a scarce window also gets
+# the prewarmed (cache-hit) compile, not just the watcher's ordering
 STAGES = ["pallas_parity", "flash_parity", "pallas_sweep",
-          "syncbn_overhead", "buffer_broadcast", "bench", "entry_compile"]
+          "syncbn_overhead", "buffer_broadcast", "bench_compile", "bench",
+          "entry_compile", "vma_probe"]
 
 
 def save(name, payload):
@@ -98,12 +102,15 @@ def stage_pallas_parity():
 
 
 def _pallas_parity_cases(jax, jnp, np, bn_ops, pb, results):
-    rng = np.random.default_rng(0)
     done = {(c["m"], c["c"]) for c in results["cases"]}
     for (m, c) in [(256, 128), (1024, 64), (4096, 256), (37, 8), (8192, 512)]:
         if (m, c) in done:
             log(f"[pallas_parity] (M={m}, C={c}) already passed; skipping")
             continue
+        # per-case rng: a seeded-resume run that skips earlier cases must
+        # feed the remaining cases the SAME inputs a from-scratch run
+        # would (input-reproducible evidence)
+        rng = np.random.default_rng([m, c])
         x = rng.standard_normal((m, c)).astype(np.float32)
         xj = jnp.asarray(x)
         t0 = time.perf_counter()
@@ -203,7 +210,6 @@ def stage_flash_parity():
         pass
     done = {(c["l"], c["d"], c["causal"], c["dtype"])
             for c in results["cases"]}
-    rng = np.random.default_rng(0)
     cases = [
         (256, 64, True, "float32"),
         (256, 64, False, "float32"),
@@ -215,6 +221,11 @@ def stage_flash_parity():
             if (l, d, causal, dtype) in done:
                 log(f"[flash_parity] L={l} d={d} already passed; skipping")
                 continue
+            # per-case rng (same rule as pallas_parity): resume must not
+            # shift later cases' inputs vs a from-scratch run
+            rng = np.random.default_rng(
+                [l, d, int(causal), 0 if dtype == "float32" else 1]
+            )
             t0 = time.perf_counter()
             jt = jnp.dtype(dtype)
             q, k, v = (
@@ -280,6 +291,157 @@ def stage_entry_compile():
     dt = round(time.perf_counter() - t0, 2)
     save("entry_compile",
          {"backend": "tpu", "compile_s": dt, "complete": True})
+
+
+def stage_bench_compile():
+    """AOT-compile bench's *exact* train-step program (bf16 SyncBN
+    ResNet-50, bench_config(True) shapes) into the persistent cache.
+
+    ``entry_compile`` warms a different XLA program (f32 eval forward at
+    batch 8), so it never amortized bench's first compile — this stage
+    does, via ``bench.prewarm()`` which lowers through the same jit
+    instance ``bench.py`` executes (same HLO -> same cache key)."""
+    import jax
+
+    from tpu_syncbn import runtime
+
+    # initialize BEFORE any backend use (bench.py's own order): on a
+    # multi-host slice jax.distributed.initialize must precede backend
+    # creation, which jax.default_backend() triggers
+    runtime.initialize()
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    import bench
+
+    info = bench.prewarm()
+    save("bench_compile", {"backend": "tpu", "complete": True, **info})
+
+
+def stage_vma_probe():
+    """Record whether the REAL TPU lowering accepts ``check_vma=True``
+    around shard_map bodies that trace Pallas kernels (BN and flash
+    attention).
+
+    Round 3 turned the checker off whenever Pallas traced, based on an
+    interpret-mode failure (hlo_interpreter dynamic_slice); round 4
+    scoped that concession to interpret mode, predicting the TPU
+    lowering accepts the checker. This stage commits the evidence either
+    way — if the TPU rejects it too, the artifact justifies widening the
+    concession again (VERDICT r3 weak #3).
+
+    Evidence discipline: a checked-run failure alone proves nothing — a
+    Mosaic tiling bug at these shapes would also throw. Each probe
+    therefore re-runs the IDENTICAL program with the checker forced off
+    as a control arm: rejection is recorded only when checked fails AND
+    the control passes. Shapes sit inside the parity-validated envelope
+    (BN rows 1024 x C=64 ~ tpu_pallas_parity case (1024, 64); flash
+    L=256, d=64 ~ tpu_flash_parity case 1)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax import nnx
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_syncbn import nn as tnn, parallel, runtime
+    from tpu_syncbn.ops import batch_norm as bn_ops
+    from tpu_syncbn.parallel import trainer as trainer_mod
+
+    runtime.initialize()  # before any backend use (multi-host safety)
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    mesh = runtime.data_parallel_mesh()
+    results = {"backend": "tpu", "complete": False}
+
+    class TinyBN(nnx.Module):
+        def __init__(self, rngs):
+            self.bn = tnn.SyncBatchNorm(64, rngs=rngs)
+
+        def __call__(self, x):
+            return self.bn(x)
+
+    def bn_step(force_vma_off: bool):
+        orig = trainer_mod._pallas_forces_vma_off
+        if force_vma_off:  # control arm: same program, checker dropped
+            trainer_mod._pallas_forces_vma_off = lambda *m: True
+        try:
+            dp = parallel.DataParallel(
+                TinyBN(nnx.Rngs(0)), optax.sgd(0.1),
+                lambda m, b: jnp.mean(m(b[0]) ** 2), mesh=mesh,
+            )
+        finally:
+            trainer_mod._pallas_forces_vma_off = orig
+        # after the round-4 scoping the checker must be ON in the
+        # checked arm — the probe is meaningless if the trainer silently
+        # dropped it. Recorded BEFORE the step runs so a failing run
+        # still carries the evidence (a gate regression that dropped the
+        # checker would otherwise make a kernel failure read as a
+        # checker rejection with nothing in the artifact to rule it out)
+        if not force_vma_off:
+            results["bn_check_vma_requested"] = bool(dp._check_vma)
+        # 16*8*8 = 1024 rows/replica x 64 ch: the validated envelope
+        n = 16 * dp.world
+        batch = jax.device_put(
+            (jnp.ones((n, 8, 8, 64), jnp.float32),
+             jnp.zeros((n,), jnp.int32)),
+            dp.batch_sharding,
+        )
+        out = dp.train_step(batch)
+        out.loss.block_until_ready()
+
+    bn_ops.set_pallas_mode("on")
+    try:
+        bn_step(force_vma_off=False)
+        results["bn_pallas_check_vma_ok"] = True
+    except Exception as e:
+        results["bn_pallas_check_vma_ok"] = False
+        results["bn_error"] = f"{type(e).__name__}: {str(e)[:800]}"
+        try:
+            bn_step(force_vma_off=True)
+            results["bn_control_unchecked_ok"] = True  # genuine rejection
+        except Exception as e2:
+            # control fails too: a kernel/shape failure, NOT the checker
+            results["bn_control_unchecked_ok"] = False
+            results["bn_control_error"] = f"{type(e2).__name__}: {str(e2)[:800]}"
+    finally:
+        bn_ops.set_pallas_mode("auto")
+
+    from tpu_syncbn.parallel import sequence
+
+    rng = np.random.default_rng(0)
+    # 8 heads: divisible by any plausible axis size (Ulysses shards heads)
+    q = jnp.asarray(rng.standard_normal((1, 256, 8, 64)), jnp.float32)
+
+    def flash_step(check_vma: bool):
+        spec = P(None, "data", None, None)
+        fn = jax.shard_map(
+            functools.partial(
+                sequence.ulysses_attention, axis_name="data",
+                causal=True, local_impl="flash",
+            ),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=check_vma,
+        )
+        put = lambda x: jax.device_put(x, NamedSharding(mesh, spec))
+        fn(put(q), put(q), put(q)).block_until_ready()
+
+    try:
+        flash_step(check_vma=True)
+        results["flash_check_vma_ok"] = True
+    except Exception as e:
+        results["flash_check_vma_ok"] = False
+        results["flash_error"] = f"{type(e).__name__}: {str(e)[:800]}"
+        try:
+            flash_step(check_vma=False)
+            results["flash_control_unchecked_ok"] = True
+        except Exception as e2:
+            results["flash_control_unchecked_ok"] = False
+            results["flash_control_error"] = f"{type(e2).__name__}: {str(e2)[:800]}"
+
+    # recording the lowering's verdict IS this stage's job — complete
+    # even when the verdict is "rejected"
+    results["complete"] = True
+    save("vma_probe", results)
 
 
 def run_sub(name, cmd):
@@ -352,6 +514,10 @@ def main():
                 stage_flash_parity()
             elif stage == "entry_compile":
                 stage_entry_compile()
+            elif stage == "bench_compile":
+                stage_bench_compile()
+            elif stage == "vma_probe":
+                stage_vma_probe()
             elif stage == "pallas_sweep":
                 run_sub(stage, [sys.executable, "benchmarks/pallas_block_sweep.py",
                                 "--iters", "10", "--budget-s", "1400",

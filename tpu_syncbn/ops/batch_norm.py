@@ -39,6 +39,12 @@ import jax.numpy as jnp
 
 from tpu_syncbn.parallel.collectives import moments_from_stats, reduce_moments
 
+# lazily-resolved 'auto' decision, per process; cleared on every
+# set_pallas_mode call (defined before it — set_pallas_mode runs at
+# import time for the env-var override below)
+_AUTO_PALLAS_CACHE: list = []
+
+
 def set_pallas_mode(mode: str) -> None:
     """Select the BN kernel backend: 'auto' (on TPU, Pallas if — and only
     if — the committed hardware measurement
@@ -57,6 +63,10 @@ def set_pallas_mode(mode: str) -> None:
     if mode not in ("auto", "on", "off"):
         raise ValueError(f"pallas mode must be auto/on/off, got {mode!r}")
     _PALLAS_MODE = mode
+    # every mode change is a full re-decision: an overhead artifact that
+    # landed (or a kernel edited) mid-process would otherwise be ignored
+    # by a memoized 'auto' until the process restarts
+    _AUTO_PALLAS_CACHE.clear()
 
 
 def get_pallas_mode() -> str:
@@ -136,9 +146,6 @@ def _measured_pallas_speedup(path: str | None = None) -> float | None:
         return None
     speedup = parsed.get("pallas_speedup_vs_xla")
     return float(speedup) if isinstance(speedup, (int, float)) else None
-
-
-_AUTO_PALLAS_CACHE: list = []  # lazily-resolved 'auto' decision, per process
 
 
 def _use_pallas() -> bool:

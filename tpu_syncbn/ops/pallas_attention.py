@@ -260,6 +260,15 @@ def flash_attention(
     """
     if q.ndim != 4:
         raise ValueError(f"expected (B, L, H, D), got {q.shape}")
+    # the 2d lowering takes lengths/padding from q and reuses them for
+    # k/v (no cross-attention support), and the output reshape assumes
+    # v's head_dim == q's — mismatches must fail here with a clear
+    # message, not deep in a pallas lowering error
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(
+            "flash_attention requires q, k, v of identical (B, L, H, D) "
+            f"shape, got q={q.shape}, k={k.shape}, v={v.shape}"
+        )
     b, l, h, d = q.shape
     s = float(scale) if scale is not None else d ** -0.5
     to2d = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, l, x.shape[-1])

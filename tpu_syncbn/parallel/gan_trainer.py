@@ -75,13 +75,13 @@ class GANTrainer:
         self.g_opt = g_optimizer
         self.d_opt = d_optimizer
 
-        from tpu_syncbn.parallel.trainer import _model_traces_pallas_bn
+        from tpu_syncbn.parallel.trainer import _pallas_forces_vma_off
 
         # same contract as DataParallel: checker on unless pallas traces
-        # for either network (snapshotted at construction)
-        self._check_vma = not (
-            _model_traces_pallas_bn(generator)
-            or _model_traces_pallas_bn(discriminator)
+        # for either network under the interpret lowering (snapshotted at
+        # construction)
+        self._check_vma = not _pallas_forces_vma_off(
+            generator, discriminator
         )
 
         self.g_def, g_params, g_rest = nnx.split(generator, nnx.Param, ...)

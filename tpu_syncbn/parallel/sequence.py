@@ -226,7 +226,10 @@ def ring_attention_zigzag(
     The self block (before the scan) adds the two in-chunk causal
     diagonals. Total: ``2(n-1) + 3`` chunk-attends of the ``4n`` the
     contiguous layout computes. Exact (online softmax, order-free):
-    output ≡ the causal oracle on the zigzag-ordered sequence.
+    output ≡ the *original-order* causal oracle, presented in the zigzag
+    layout — masking follows original positions, not zigzag offsets;
+    undo the layout with ``zigzag_unshard`` (as ``sharded_self_attention``
+    does).
     """
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
@@ -360,10 +363,10 @@ def ulysses_attention(
     the score-matrix oracle: the (L, L) scores — Ulysses' memory ceiling
     for long context — are then never materialized. Default None keeps
     the oracle (the evidence-gating stance: kernels are opt-in until
-    timed on hardware). Like every Pallas path (DESIGN.md §3), the
-    kernel body mixes unvarying scratch with varying blocks, so the
+    timed on hardware). Under the CPU mesh's *interpret* lowering the
     enclosing ``shard_map`` needs ``check_vma=False`` when flash is
-    selected.
+    selected (hlo_interpreter dynamic_slice rejects the checker around
+    pallas bodies); the TPU lowering keeps the checker on.
     """
     if local_impl not in (None, "flash"):
         raise ValueError(
@@ -419,9 +422,10 @@ def sharded_self_attention(
     ``"ring_zigzag"`` (causal only) reorders the sequence into the
     zigzag layout on the way in and back on the way out, so callers keep
     ordinary position order end to end. ``local_impl="flash"`` (Ulysses
-    only) runs the local attention through the Pallas kernel; the
-    wrapper then builds the shard_map with ``check_vma=False`` (pallas
-    bodies mix unvarying scratch with varying blocks, DESIGN.md §3)."""
+    only) runs the local attention through the Pallas kernel; off-TPU the
+    wrapper builds the shard_map with ``check_vma=False`` (the interpret
+    lowering rejects the checker around pallas bodies, DESIGN.md §3) —
+    on TPU the checker stays on."""
     if impl == "ring_zigzag":
         if not causal:
             raise ValueError(
@@ -454,13 +458,21 @@ def sharded_self_attention(
         fn = functools.partial(base, **kw)
     if local_impl is not None and impl == "ring_zigzag":
         raise ValueError("local_impl applies to impl='ulysses' only")
+    # checker off ONLY for the interpret lowering of the flash kernel
+    # (hlo_interpreter dynamic_slice rejects check_vma=True around pallas
+    # bodies on the CPU mesh); on TPU the checker stays on
+    check_vma = True
+    if local_impl == "flash":
+        from tpu_syncbn.ops._pallas_common import interpret
+
+        check_vma = not interpret()
     seq_sharded = P(None, axis_name, None, None)
     shard_fn = jax.shard_map(
         fn,
         mesh=mesh,
         in_specs=(seq_sharded, seq_sharded, seq_sharded),
         out_specs=seq_sharded,
-        check_vma=local_impl != "flash",
+        check_vma=check_vma,
     )
     put = lambda x: jax.device_put(x, NamedSharding(mesh, seq_sharded))
     out = shard_fn(put(q), put(k), put(v))

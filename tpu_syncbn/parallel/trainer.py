@@ -97,6 +97,24 @@ def _model_traces_pallas_bn(model: nnx.Module) -> bool:
     return False
 
 
+def _pallas_forces_vma_off(*models: nnx.Module) -> bool:
+    """Should the VMA checker be dropped because Pallas BN kernels will
+    trace for one of ``models``?
+
+    Scoped to the INTERPRET lowering only: the hlo_interpreter's
+    dynamic_slice rejects ``check_vma=True`` around pallas bodies on the
+    CPU test mesh (the round-3 observation that motivated the blanket
+    concession). The real TPU lowering keeps the checker ON — the very
+    checker that caught round 1's 8x-gradient bug — pending the
+    ``vma_probe`` battery stage recording a TPU-lowering rejection, which
+    would be the evidence to widen this again."""
+    from tpu_syncbn.ops import _pallas_common
+
+    if not _pallas_common.interpret():
+        return False
+    return any(_model_traces_pallas_bn(m) for m in models)
+
+
 def _stats_replicated_by_construction(model: nnx.Module) -> bool:
     """True when every non-Param Variable in the model is owned by a
     full-world SyncBatchNorm: such stats are computed from psum'd global
@@ -245,13 +263,14 @@ class DataParallel:
             self._per_step_broadcast = bool(broadcast_buffers)
         self.broadcast_buffers = broadcast_buffers
         # VMA checker on, EXCEPT when the Pallas BN kernels will trace
-        # for THIS model: pallas kernel bodies mix unvarying scratch refs
-        # with varying input blocks, which the checker rejects (pinned by
-        # the pallas test suite). With the checker off, replication is
-        # guaranteed structurally, exactly as in round 1. Snapshotted at
+        # for THIS model *under the interpret lowering* (CPU test mesh),
+        # whose dynamic_slice rejects the checker regardless of kernel
+        # correctness. On TPU the checker stays on even with Pallas
+        # bodies. With the checker off, replication is guaranteed
+        # structurally, exactly as in round 1. Snapshotted at
         # construction — set_pallas_mode() must be called before building
         # the trainer (its docstring says so).
-        self._check_vma = not _model_traces_pallas_bn(model)
+        self._check_vma = not _pallas_forces_vma_off(model)
 
         self.zero = bool(zero)
         self.graphdef, params, rest = nnx.split(model, nnx.Param, ...)
