@@ -19,7 +19,15 @@ with identical init, data order, and noise streams:
 
 Prints one JSON line: mean |loss - oracle| over training for the D and G
 curves of both arms plus the headline divergence ratio
-(perreplica_mae / syncbn_mae over the combined curves).
+(perreplica_mae / syncbn_mae over the combined curves), plus two
+chaos-robust readouts that do NOT depend on trajectory proximity:
+
+* ``fid_proxy`` — Fréchet distance between feature Gaussians of the real
+  data and each arm's eval-mode samples (shared z), under ONE fixed
+  extractor (the oracle arm's trained discriminator): end-state sample
+  quality, immune to when the trajectories decohered;
+* ``d_balance`` — each arm's mean sigmoid(D) on real/fake over the last
+  half of training: adversarial-equilibrium drift, bounded [0, 1].
 
     python benchmarks/gan_convergence_ab.py --simulate 8 --steps 200 \
         --per-chip-batch 2 [--curves out.json]
@@ -125,6 +133,7 @@ def main():
         trainer = parallel.GANTrainer(G, D, opt(), opt(), loss=gan_loss,
                                       mesh=mesh)
         d_losses, g_losses = [], []
+        d_real_t, d_fake_t = [], []
         stream = batches()
         for _ in range(args.steps):
             real, z_d, z_g = next(stream)
@@ -134,18 +143,72 @@ def main():
             out = trainer.train_step(put(real), put(z_d), put(z_g))
             d_losses.append(float(out.d_loss))
             g_losses.append(float(out.g_loss))
+            d_real_t.append(float(out.metrics["d_real"]))
+            d_fake_t.append(float(out.metrics["d_fake"]))
         stats = np.concatenate([
             running_stats_vector(trainer.g_rest),
             running_stats_vector(trainer.d_rest),
         ])
-        return np.asarray(d_losses), np.asarray(g_losses), stats
+        return (np.asarray(d_losses), np.asarray(g_losses), stats,
+                np.asarray(d_real_t), np.asarray(d_fake_t), trainer)
 
     log("arm 1/3: oracle (1 device, global batch)")
-    od, og, oracle_stats = run(sync=False, n_devices=1)
+    od, og, oracle_stats, o_dr, o_df, oracle_tr = run(sync=False, n_devices=1)
     log("arm 2/3: syncbn (R devices, SyncBN in G and D)")
-    sd, sg, sync_stats = run(sync=True, n_devices=R)
+    sd, sg, sync_stats, s_dr, s_df, sync_tr = run(sync=True, n_devices=R)
     log("arm 3/3: per-replica BN (R devices)")
-    ld, lg, local_stats = run(sync=False, n_devices=R)
+    ld, lg, local_stats, l_dr, l_df, local_tr = run(sync=False, n_devices=R)
+
+    # -- chaos-robust readout 1: FID-style sample quality -----------------
+    # ONE fixed extractor (the oracle arm's trained D, eval mode) scores
+    # real data vs each arm's eval-mode samples from a SHARED z batch;
+    # Fréchet distance between feature Gaussians. Measures the end state,
+    # not the path — immune to when trajectories decohered.
+    from tpu_syncbn import utils
+
+    _, feat_d = oracle_tr.sync_to_models()
+    feat_d.eval()
+    z_eval = jnp.asarray(
+        np.random.RandomState(args.seed + 9).randn(
+            args.dataset_size, args.latent
+        ).astype(np.float32)
+    )
+    real_stats = utils.gaussian_stats(
+        np.asarray(feat_d.features(jnp.asarray(xs)))
+    )
+
+    def fid_of(trainer) -> float:
+        fakes = np.asarray(trainer.generate(z_eval), np.float32)
+        fake_stats = utils.gaussian_stats(
+            np.asarray(feat_d.features(jnp.asarray(fakes)))
+        )
+        return round(utils.frechet_distance(*real_stats, *fake_stats), 4)
+
+    fid_proxy = {
+        "oracle": fid_of(oracle_tr),
+        "syncbn": fid_of(sync_tr),
+        "perreplica": fid_of(local_tr),
+    }
+    fid_proxy["excess_vs_oracle"] = {
+        "syncbn": round(fid_proxy["syncbn"] - fid_proxy["oracle"], 4),
+        "perreplica": round(fid_proxy["perreplica"] - fid_proxy["oracle"], 4),
+    }
+
+    # -- chaos-robust readout 2: adversarial-equilibrium drift ------------
+    # mean sigmoid(D) on real/fake over the last half of training:
+    # bounded [0, 1], slow-moving, no oracle-trajectory pairing needed
+    half = args.steps // 2
+
+    def balance(dr, df) -> dict:
+        return {"d_real": round(float(dr[half:].mean()), 4),
+                "d_fake": round(float(df[half:].mean()), 4)}
+
+    d_balance = {
+        "window": f"steps {half}..{args.steps}",
+        "oracle": balance(o_dr, o_df),
+        "syncbn": balance(s_dr, s_df),
+        "perreplica": balance(l_dr, l_df),
+    }
 
     sync_d = float(np.abs(sd - od).mean())
     sync_g = float(np.abs(sg - og).mean())
@@ -173,6 +236,8 @@ def main():
             (local_d + local_g) / max(sync_d + sync_g, 1e-12), 2
         ),
         **blocks,
+        "fid_proxy": fid_proxy,
+        "d_balance": d_balance,
         "final_loss": {
             "oracle": {"d": round(float(od[-1]), 4), "g": round(float(og[-1]), 4)},
             "syncbn": {"d": round(float(sd[-1]), 4), "g": round(float(sg[-1]), 4)},

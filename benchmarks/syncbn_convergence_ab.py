@@ -24,6 +24,8 @@ curves for plotting.
 
 import argparse
 import json
+import os
+import sys
 
 from _common import setup
 
@@ -44,6 +46,19 @@ def parse_args():
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--curves", default=None,
                    help="write full per-step loss curves to this JSON file")
+    p.add_argument("--oracle-curve", default=None,
+                   help="share ONE oracle across runs: if this file "
+                        "exists, load the oracle loss curve from it "
+                        "instead of training the oracle arm (config "
+                        "fingerprint must match); if absent, train the "
+                        "oracle and write it here. Used by the "
+                        "const-global-batch dose-response sweep — on the "
+                        "CPU backend two processes with different "
+                        "--simulate values compile different thread/"
+                        "device partitionings, so independently-trained "
+                        "oracles drift by float noise that training "
+                        "chaos then amplifies; sharing the curve removes "
+                        "the oracle as a variable entirely")
     return p.parse_args()
 
 
@@ -124,7 +139,35 @@ def main():
             losses.append(float(out.loss))
         return np.asarray(losses)
 
-    oracle = run(sync=False, n_devices=1)  # global-batch single device
+    # everything the oracle arm's program depends on; per-chip batch and
+    # replica count deliberately absent (the oracle is 1 device x global
+    # batch — that is the point of sharing it across doses)
+    oracle_config = {
+        "steps": args.steps, "global_batch": global_batch,
+        "seed": args.seed, "lr": args.lr, "momentum": args.momentum,
+        "image_size": args.image_size, "num_classes": args.num_classes,
+        "dataset_size": args.dataset_size,
+    }
+    if args.oracle_curve and os.path.exists(args.oracle_curve):
+        with open(args.oracle_curve) as f:
+            payload = json.load(f)
+        if payload.get("config") != oracle_config:
+            raise SystemExit(
+                f"--oracle-curve config mismatch: file has "
+                f"{payload.get('config')}, this run needs {oracle_config}"
+            )
+        oracle = np.asarray(payload["oracle"], np.float64)
+        print(f"oracle curve loaded from {args.oracle_curve}",
+              file=sys.stderr, flush=True)
+    else:
+        oracle = run(sync=False, n_devices=1)  # global-batch single device
+        if args.oracle_curve:
+            tmp = args.oracle_curve + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(
+                    {"config": oracle_config, "oracle": oracle.tolist()}, f
+                )
+            os.replace(tmp, args.oracle_curve)
     synced = run(sync=True, n_devices=R)  # SyncBN, per-chip batch B
     local = run(sync=False, n_devices=R)  # per-replica BN, per-chip batch B
 

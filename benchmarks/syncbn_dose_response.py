@@ -3,17 +3,31 @@
 The reference's claim is not just "per-device BN hurts" but that it
 hurts *at small per-device batches* (``README.md:3``). This sweep runs
 the classification convergence A/B (``syncbn_convergence_ab.py``) at
-several per-chip batch sizes on the same 8-replica mesh and reports the
-per-replica arm's absolute trajectory damage (loss-curve MAE) alongside
-the divergence ratio, as one JSON line — the dose-response curve behind
-the single-point A/Bs. NOTE each dose has its OWN oracle (the
-single-device arm trains at global batch = replicas × b, which varies
-with the dose), so each point records its ``global_batch`` and the
-oracle's final loss; compare ratios across points, and absolute MAEs
-only with that caveat in mind. Points are written to ``--out``
-incrementally: a mid-sweep failure keeps every completed dose.
+several doses and reports the per-replica arm's absolute trajectory
+damage (loss-curve MAE) alongside the divergence ratio, as one JSON
+line. Two modes isolate different variables:
+
+* ``--mode per_chip`` (default): fixed replica count (8), per-chip batch
+  swept over ``--batches``. NOTE each dose has its OWN oracle (the
+  single-device arm trains at global batch = replicas x b, which varies
+  with the dose), so each point records its ``global_batch`` and the
+  oracle's final loss; compare ratios across points, and absolute MAEs
+  only with that caveat in mind.
+* ``--mode const_global``: fixed global batch (``--global-batch``),
+  replica count swept over ``--replicas`` => per-chip batch G/R. Every
+  dose shares ONE oracle configuration (1 device, batch G, same seed and
+  data order — the oracle curve is identical across doses, which the
+  driver verifies on the full unrounded per-step curve and treats as
+  fatal if violated), so the per-replica damage column varies ONLY the
+  per-device-statistics mechanism the reference names — not the global
+  batch.
+
+Points are written to ``--out`` incrementally: a mid-sweep failure keeps
+every completed dose.
 
     python benchmarks/syncbn_dose_response.py --batches 1 2 4 8
+    python benchmarks/syncbn_dose_response.py --mode const_global \
+        --global-batch 16 --replicas 2 4 8
 """
 
 import argparse
@@ -29,8 +43,16 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 
 def parse_args():
     p = argparse.ArgumentParser()
-    p.add_argument("--simulate", type=int, default=8)
-    p.add_argument("--batches", type=int, nargs="+", default=[1, 2, 4, 8])
+    p.add_argument("--mode", choices=["per_chip", "const_global"],
+                   default="per_chip")
+    p.add_argument("--simulate", type=int, default=8,
+                   help="replica count (per_chip mode)")
+    p.add_argument("--batches", type=int, nargs="+", default=[1, 2, 4, 8],
+                   help="per-chip batches to sweep (per_chip mode)")
+    p.add_argument("--global-batch", type=int, default=16,
+                   help="fixed global batch (const_global mode)")
+    p.add_argument("--replicas", type=int, nargs="+", default=[2, 4, 8],
+                   help="replica counts to sweep (const_global mode)")
     p.add_argument("--steps", type=int, default=300)
     p.add_argument("--out", default=None, help="also write the JSON here")
     return p.parse_args()
@@ -50,13 +72,29 @@ def _last_json_line(stdout: str):
 
 def main():
     args = parse_args()
+    if args.mode == "per_chip":
+        metric = "syncbn_dose_response_per_chip_batch"
+        # (replicas, per_chip_batch) per dose
+        doses = [(args.simulate, b) for b in args.batches]
+    else:
+        metric = "syncbn_dose_response_const_global_batch"
+        for r in args.replicas:
+            if args.global_batch % r:
+                raise SystemExit(
+                    f"--global-batch {args.global_batch} not divisible by "
+                    f"replica count {r}"
+                )
+        doses = [(r, args.global_batch // r) for r in args.replicas]
     result = {
-        "metric": "syncbn_dose_response_per_chip_batch",
-        "replicas": args.simulate,
+        "metric": metric,
         "steps": args.steps,
         "points": [],
         "failed": [],
     }
+    if args.mode == "per_chip":
+        result["replicas"] = args.simulate
+    else:
+        result["global_batch"] = args.global_batch
 
     def save():
         if args.out:
@@ -65,14 +103,27 @@ def main():
                 json.dump(result, f, indent=2)
             os.replace(tmp, args.out)
 
-    for b in args.batches:
-        log(f"per-chip batch {b}...")
+    oracle_curves = {}  # dose -> full per-step oracle loss curve
+    # const_global: ONE oracle, trained by the first dose child and
+    # loaded (not retrained) by the rest — on CPU, different --simulate
+    # values compile different thread/device partitionings, so
+    # independently-trained oracles drift by float noise that training
+    # chaos amplifies (observed; the shared file removes the variable)
+    oracle_path = os.path.join(HERE, f".dose_oracle_{os.getpid()}.json")
+    for (r, b) in doses:
+        log(f"replicas {r}, per-chip batch {b}...")
+        curves_path = os.path.join(HERE, f".dose_curves_{r}_{b}.json")
+        cmd = [sys.executable,
+               os.path.join(HERE, "syncbn_convergence_ab.py"),
+               "--simulate", str(r),
+               "--per-chip-batch", str(b), "--steps", str(args.steps)]
+        if args.mode == "const_global":
+            # curves only exist to verify oracle identity — per_chip
+            # mode has no such invariant and skips the plumbing
+            cmd += ["--curves", curves_path, "--oracle-curve", oracle_path]
         try:
             proc = subprocess.run(
-                [sys.executable,
-                 os.path.join(HERE, "syncbn_convergence_ab.py"),
-                 "--simulate", str(args.simulate),
-                 "--per-chip-batch", str(b), "--steps", str(args.steps)],
+                cmd,
                 cwd=HERE, capture_output=True, text=True, timeout=3600,
             )
             if proc.returncode != 0:
@@ -82,13 +133,28 @@ def main():
             d = _last_json_line(proc.stdout)
         except (subprocess.TimeoutExpired, RuntimeError) as e:
             # completed doses are training hours — keep them
-            log(f"  batch {b} FAILED: {e}")
-            result["failed"].append(b)
+            log(f"  ({r}, {b}) FAILED: {e}")
+            result["failed"].append({"replicas": r, "per_chip_batch": b})
             save()
             continue
+        if args.mode == "const_global":
+            # verification input only: an unreadable curves file must
+            # not discard a successfully-parsed dose (it just shrinks
+            # what the oracle-identity check can compare)
+            try:
+                with open(curves_path) as f:
+                    oracle_curves[(r, b)] = json.load(f)["oracle"]
+            except (OSError, KeyError, ValueError) as e:
+                log(f"  ({r}, {b}) oracle-curve readback failed: {e}")
+            finally:
+                try:
+                    os.remove(curves_path)
+                except OSError:
+                    pass
         result["points"].append({
+            "replicas": r,
             "per_chip_batch": b,
-            "global_batch": args.simulate * b,  # = this dose's oracle batch
+            "global_batch": r * b,  # = this dose's oracle batch
             "oracle_final_loss": d["final_loss"]["oracle"],
             "syncbn_loss_mae": d["syncbn_loss_mae"],
             "perreplica_loss_mae": d["perreplica_loss_mae"],
@@ -97,8 +163,23 @@ def main():
         save()
         log(f"  perreplica MAE {d['perreplica_loss_mae']}, "
             f"ratio {d['divergence_ratio']}")
+    try:
+        os.remove(oracle_path)
+    except OSError:
+        pass
+    if args.mode == "const_global" and len(oracle_curves) > 1:
+        # every dose must have scored against the SAME oracle curve
+        # (trained once, shared via --oracle-curve) — verified on the
+        # FULL unrounded per-step curve, fatal on drift: an artifact
+        # whose isolation failed must not exit 0
+        curves = list(oracle_curves.values())
+        result["oracle_shared"] = all(c == curves[0] for c in curves[1:])
+        if not result["oracle_shared"]:
+            log("ERROR: oracle curves differ across doses — the "
+                "const-global isolation failed")
+        save()
     print(json.dumps(result))
-    if result["failed"]:
+    if result["failed"] or result.get("oracle_shared") is False:
         sys.exit(1)
 
 

@@ -389,6 +389,8 @@ def stage_vma_probe():
         out = dp.train_step(batch)
         out.loss.block_until_ready()
 
+    orig_mode = bn_ops.get_pallas_mode()  # restore exactly (env override
+    # must survive this stage — 'auto' is not the universal prior state)
     bn_ops.set_pallas_mode("on")
     try:
         bn_step(force_vma_off=False)
@@ -404,7 +406,7 @@ def stage_vma_probe():
             results["bn_control_unchecked_ok"] = False
             results["bn_control_error"] = f"{type(e2).__name__}: {str(e2)[:800]}"
     finally:
-        bn_ops.set_pallas_mode("auto")
+        bn_ops.set_pallas_mode(orig_mode)
 
     from tpu_syncbn.parallel import sequence
 

@@ -192,3 +192,26 @@ def test_generate_preserves_caller_mode():
     trainer.generate(jnp.zeros((2, LATENT)))
     # the shared module's mode flags were not flipped back to train
     assert g.bn0.use_running_average
+
+
+def test_discriminator_features():
+    """features() = spatially-pooled penultimate trunk activations —
+    the fixed feature space for the FID-proxy instrument; must agree
+    with the logit path's trunk (same BN/conv weights, same mode)."""
+    import numpy as np
+    from flax import nnx
+    from tpu_syncbn import models
+
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (4, 32, 32, 3)), jnp.float32)
+    for cls, kw in [(models.DCGANDiscriminator, {}),
+                    (models.SNGANDiscriminator, {"use_bn": True}),
+                    (models.SNGANDiscriminator, {"use_bn": False})]:
+        d = cls(width=8, rngs=nnx.Rngs(0), **kw)
+        d.eval()
+        f = d.features(x)
+        assert f.shape == (4, 32)  # (B, 4*width)
+        np.testing.assert_allclose(
+            np.asarray(f), np.asarray(d._trunk(x).mean(axis=(1, 2))),
+            rtol=1e-6,
+        )

@@ -83,3 +83,66 @@ def test_rejects_bad_rank():
     with pytest.raises(ValueError, match="B, L, H, D"):
         pa.flash_attention(jnp.zeros((4, 8, 2)), jnp.zeros((4, 8, 2)),
                            jnp.zeros((4, 8, 2)))
+
+
+def test_rejects_mismatched_shapes():
+    q = jnp.zeros((1, 16, 2, 8))
+    with pytest.raises(ValueError, match="identical"):
+        pa.flash_attention(q, jnp.zeros((1, 32, 2, 8)), q)
+    with pytest.raises(ValueError, match="identical"):
+        pa.flash_attention(q, q, jnp.zeros((1, 16, 2, 4)))
+
+
+class TestCausalTileWalk:
+    """The compressed causal grid must (a) visit ~half the rectangular
+    tile count (the DMA win), (b) keep each qi's ki sweep contiguous,
+    ascending, starting at 0 (the VMEM scratch-carry contract), and
+    (c) cover exactly the at-or-below-diagonal pairs."""
+
+    def test_equal_blocks_triangle(self):
+        n = 8
+        qids, kids = pa._causal_tiles(n, n, 128, 128)
+        assert len(qids) == n * (n + 1) // 2  # vs n*n rectangular
+        live = set(zip(qids.tolist(), kids.tolist()))
+        expect = {(qi, ki) for qi in range(n) for ki in range(qi + 1)}
+        assert live == expect
+
+    def test_walk_order_contract(self):
+        for (nq, nk, bq, bk) in [(8, 8, 128, 128), (4, 8, 256, 128),
+                                 (8, 4, 128, 256), (5, 5, 64, 64)]:
+            qids, kids = pa._causal_tiles(nq, nk, bq, bk)
+            # qi non-decreasing; within each qi, ki = 0, 1, 2, ...
+            assert list(qids) == sorted(qids)
+            for qi in range(nq):
+                ks = [k for q, k in zip(qids, kids) if q == qi]
+                assert ks == list(range(len(ks))) and ks[0] == 0
+                # last ki is where the diagonal leaves this query tile
+                assert ks[-1] == min(nk - 1, (qi * bq + bq - 1) // bk)
+
+    def test_mismatched_blocks_parity(self):
+        # block_q != block_k exercises the non-trivial diagonal-exit
+        # arithmetic in the compressed walk
+        q, k, v = make(200, seed=5)
+        got = pa.flash_attention(q, k, v, causal=True,
+                                 block_q=64, block_k=128)
+        want = sequence._single_device_attention(
+            q, k, v, causal=True, scale=None
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5
+        )
+
+    def test_rect_fallback_over_tile_cap(self, monkeypatch):
+        # past _MAX_CAUSAL_TILES the compressed walk's index arrays
+        # would strain scalar memory — the rectangular grid (matmul-skip
+        # only) must take over with identical numerics
+        monkeypatch.setattr(pa, "_MAX_CAUSAL_TILES", 3)
+        q, k, v = make(200, seed=6)
+        got = pa.flash_attention(q, k, v, causal=True,
+                                 block_q=64, block_k=64)
+        want = sequence._single_device_attention(
+            q, k, v, causal=True, scale=None
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5
+        )

@@ -161,3 +161,63 @@ def test_scalar_logger_jsonl(tmp_path):
 
 def _reject(token):
     raise AssertionError(f"non-strict JSON token {token!r} in log")
+
+
+class TestFrechetDistance:
+    """utils.fid — the chaos-robust GAN sample-quality instrument."""
+
+    def test_identical_stats_zero(self):
+        import numpy as np
+        from tpu_syncbn import utils
+
+        rng = np.random.default_rng(0)
+        f = rng.standard_normal((64, 8))
+        mu, cov = utils.gaussian_stats(f)
+        assert utils.frechet_distance(mu, cov, mu, cov) == 0.0
+
+    def test_univariate_closed_form(self):
+        # d^2 between N(m1, s1^2) and N(m2, s2^2) = (m1-m2)^2 + (s1-s2)^2
+        import numpy as np
+        from tpu_syncbn import utils
+
+        got = utils.frechet_distance(
+            np.array([1.0]), np.array([[4.0]]),
+            np.array([3.0]), np.array([[9.0]]),
+        )
+        assert abs(got - ((1 - 3) ** 2 + (2 - 3) ** 2)) < 1e-9
+
+    def test_mean_shift_dominates(self):
+        import numpy as np
+        from tpu_syncbn import utils
+
+        rng = np.random.default_rng(1)
+        a = rng.standard_normal((256, 16))
+        b = a + 5.0  # same covariance, shifted mean
+        d = utils.frechet_distance(
+            *utils.gaussian_stats(a), *utils.gaussian_stats(b)
+        )
+        assert abs(d - 16 * 25.0) < 1.0  # ||shift||^2 = F * 5^2
+
+    def test_rank_deficient_cov_finite(self):
+        # more features than samples: sample covariance is singular —
+        # the PSD-clipped eigh sqrt must stay finite and nonnegative
+        import numpy as np
+        from tpu_syncbn import utils
+
+        rng = np.random.default_rng(2)
+        a = rng.standard_normal((10, 32))
+        b = rng.standard_normal((10, 32)) + 1.0
+        d = utils.frechet_distance(
+            *utils.gaussian_stats(a), *utils.gaussian_stats(b)
+        )
+        assert np.isfinite(d) and d >= 0.0
+
+    def test_rejects_bad_shape(self):
+        import numpy as np
+        import pytest
+        from tpu_syncbn import utils
+
+        with pytest.raises(ValueError, match="N>=2"):
+            utils.gaussian_stats(np.zeros((1, 4)))
+        with pytest.raises(ValueError, match="N>=2"):
+            utils.gaussian_stats(np.zeros(4))

@@ -119,11 +119,19 @@ class DCGANDiscriminator(nnx.Module):
         self.fc = nnx.Linear(width * 4 * 4 * 4, 1, kernel_init=_g_init, rngs=rngs)
 
     def __call__(self, x):
+        return self.fc(self._trunk(x).reshape(x.shape[0], -1))[:, 0]
+
+    def _trunk(self, x):
         a = 0.2
         x = nnx.leaky_relu(self.conv1(x), a)
         x = nnx.leaky_relu(self.bn2(self.conv2(x)), a)
-        x = nnx.leaky_relu(self.bn3(self.conv3(x)), a)
-        return self.fc(x.reshape(x.shape[0], -1))[:, 0]
+        return nnx.leaky_relu(self.bn3(self.conv3(x)), a)
+
+    def features(self, x):
+        """Spatially-pooled penultimate activations, (B, 4*width) — a
+        fixed feature space for distributional sample-quality metrics
+        (``utils.frechet_distance``)."""
+        return self._trunk(x).mean(axis=(1, 2))
 
 
 class SNGANDiscriminator(nnx.Module):
@@ -140,6 +148,9 @@ class SNGANDiscriminator(nnx.Module):
         self.fc = nnx.Linear(width * 4 * 4 * 4, 1, kernel_init=_g_init, rngs=rngs)
 
     def __call__(self, x):
+        return self.fc(self._trunk(x).reshape(x.shape[0], -1))[:, 0]
+
+    def _trunk(self, x):
         a = 0.1
         x = nnx.leaky_relu(self.conv1(x), a)
         x = self.conv2(x)
@@ -149,8 +160,12 @@ class SNGANDiscriminator(nnx.Module):
         x = self.conv3(x)
         if self.bn3 is not None:
             x = self.bn3(x)
-        x = nnx.leaky_relu(x, a)
-        return self.fc(x.reshape(x.shape[0], -1))[:, 0]
+        return nnx.leaky_relu(x, a)
+
+    def features(self, x):
+        """Spatially-pooled penultimate activations, (B, 4*width) — see
+        ``DCGANDiscriminator.features``."""
+        return self._trunk(x).mean(axis=(1, 2))
 
 
 # -- losses ---------------------------------------------------------------

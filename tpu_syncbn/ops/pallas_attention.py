@@ -12,12 +12,13 @@ holds one (block_q, D) query tile and streams (block_k, D) KV tiles
 through VMEM, carrying the online-softmax running (max, denominator,
 accumulator) in f32 scratch — the same algorithm
 ``parallel.sequence._block_attend`` runs at the ring level, pushed down
-to the tile level. Under ``causal=True``, KV tiles strictly above the
-diagonal skip their matmuls via ``pl.when`` (no wasted MXU work; note
-the BlockSpec pipeline still streams every tile through VMEM — bounding
-the ki sweep per query block to also skip the dead DMA is deferred
-until hardware timing exists to justify the scalar-prefetch grid it
-needs); the diagonal tile masks with a 2-D iota.
+to the tile level. Under ``causal=True`` the grid itself is compressed:
+only the at-or-below-diagonal (qi, ki) tile pairs are enumerated (a 1-D
+tile walk mapped through scalar-prefetched index arrays), so tiles
+strictly above the diagonal cost neither MXU work NOR VMEM streaming —
+the BlockSpec pipeline never touches their DMA (~2x bandwidth cut at
+long L vs the rectangular grid); the diagonal tile masks with a 2-D
+iota.
 
 Backward is a ``jax.custom_vjp`` in plain XLA: one ``lax.scan`` over KV
 blocks recomputes P column-block by column-block from the saved
@@ -55,14 +56,13 @@ from tpu_syncbn.ops._pallas_common import sds as _sds
 # -- forward kernel -------------------------------------------------------
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                 acc_ref, m_ref, l_ref, *,
-                 scale, causal, block_q, block_k, n_k, l_real):
-    """Grid (BH, n_q, n_k); ki is innermost (sequential on TPU), so the
-    VMEM scratch carries the online-softmax state across the ki sweep of
-    one (bh, qi) tile."""
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
+def _attend_tile(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                 acc_ref, m_ref, l_ref, qi, ki, last_ki, *,
+                 scale, causal, block_q, block_k, l_real):
+    """One (qi, ki) online-softmax step; ``qi``/``ki`` may be traced
+    scalars (compressed causal grid) or program ids (rectangular grid).
+    The ki sweep for a fixed (bh, qi) is contiguous in the grid walk, so
+    the VMEM scratch carries the running (max, denom, acc) across it."""
 
     @pl.when(ki == 0)
     def _init():
@@ -72,46 +72,127 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     q_start = qi * block_q
     k_start = ki * block_k
-    # causal: a KV tile strictly right of this query tile's last row
-    # touches nothing — skip its matmuls entirely
-    live = (k_start <= q_start + block_q - 1) if causal else True
-
-    @pl.when(live)
-    def _attend():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        s = lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (block_q, block_k)
-        cols = k_start + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    s = lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (block_q, block_k)
+    cols = k_start + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    mask = cols < l_real  # right-pad KV rows are dead
+    if causal:
+        rows = q_start + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
         )
-        mask = cols < l_real  # right-pad KV rows are dead
-        if causal:
-            rows = q_start + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            mask = mask & (rows >= cols)
-        s = jnp.where(mask, s, _NEG_BIG)
+        mask = mask & (rows >= cols)
+    s = jnp.where(mask, s, _NEG_BIG)
 
-        m_prev = m_ref[...]  # (block_q, 1)
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)
-        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * corr + lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_ref[...] = m_new
+    m_prev = m_ref[...]  # (block_q, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * corr + lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[...] = m_new
 
-    @pl.when(ki == n_k - 1)
+    @pl.when(ki == last_ki)
     def _finalize():
         l = jnp.maximum(l_ref[...], 1e-30)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
         lse_ref[0] = (m_ref[...] + jnp.log(l))[:, 0]
+
+
+def _attn_kernel_rect(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref, *,
+                      scale, causal, block_q, block_k, n_k, l_real):
+    """Full rectangular grid (BH, n_q, n_k), ki innermost. Non-causal
+    always; also the causal fallback when the compressed walk's index
+    arrays would be too large for scalar memory — there, above-diagonal
+    tiles still stream through VMEM but skip their matmuls."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    if not causal:
+        _attend_tile(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                     acc_ref, m_ref, l_ref, qi, ki, n_k - 1,
+                     scale=scale, causal=False,
+                     block_q=block_q, block_k=block_k, l_real=l_real)
+        return
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_BIG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # a KV tile strictly right of this query tile's last row touches
+    # nothing — skip its matmuls (its DMA still streams in this path)
+    live = ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(live)
+    def _attend():
+        _attend_tile(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                     acc_ref, m_ref, l_ref, qi, ki, n_k - 1,
+                     scale=scale, causal=True,
+                     block_q=block_q, block_k=block_k, l_real=l_real)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        # _attend_tile's own finalize only fires when the last tile is
+        # live, which for a causal row it always is (diagonal end) — but
+        # keep the rect path self-sufficient if block ratios change
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[...] + jnp.log(l))[:, 0]
+
+
+def _attn_kernel_causal(qids_ref, kids_ref, q_ref, k_ref, v_ref,
+                        o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                        scale, block_q, block_k, n_k, l_real):
+    """Causal: compressed 1-D tile walk (BH, T) over ONLY the live
+    (qi, ki) pairs, decoded from the scalar-prefetched index arrays —
+    above-diagonal tiles are never visited, so their KV DMA never
+    happens. last live ki for a query tile is where the diagonal exits
+    its rows (clamped to the KV extent)."""
+    t = pl.program_id(1)
+    qi = qids_ref[t]
+    ki = kids_ref[t]
+    last_ki = jnp.minimum(
+        n_k - 1, (qi * block_q + block_q - 1) // block_k
+    )
+    _attend_tile(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                 acc_ref, m_ref, l_ref, qi, ki, last_ki,
+                 scale=scale, causal=True,
+                 block_q=block_q, block_k=block_k, l_real=l_real)
+
+
+# compressed-walk ceiling: the (qids, kids) int32 pairs live in scalar
+# memory (SMEM), which is scarce — past this many tiles fall back to the
+# rectangular grid (matmul-skip only). 16384 tiles = 128 KiB of indices
+# ~ n_q 180 at equal 128-blocks ~ local L 23k; the SP layer shards
+# longer sequences across devices before they reach one kernel.
+_MAX_CAUSAL_TILES = 16384
+
+
+@functools.lru_cache(maxsize=64)
+def _causal_tiles(n_q: int, n_k: int, block_q: int, block_k: int):
+    """Enumerate live (qi, ki) pairs for the causal lower triangle, qi
+    ascending and ki ascending within qi (the scratch-carry contract).
+    ~T = n_q(n_q+1)/2 of the rectangular n_q*n_k when blocks match."""
+    import numpy as np
+
+    qids, kids = [], []
+    for qi in range(n_q):
+        k_hi = min(n_k - 1, (qi * block_q + block_q - 1) // block_k)
+        for ki in range(k_hi + 1):
+            qids.append(qi)
+            kids.append(ki)
+    return np.asarray(qids, np.int32), np.asarray(kids, np.int32)
 
 
 def _flash_fwd_2d(q, k, v, *, causal, scale, block_q, block_k):
@@ -125,11 +206,63 @@ def _flash_fwd_2d(q, k, v, *, causal, scale, block_q, block_k):
     kp = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0))) if pad_k else k
     vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0))) if pad_k else v
 
+    vmem = pltpu.VMEM
+    out_shape = [
+        _sds((bh, n_q * block_q, d), q.dtype, qp),
+        _sds((bh, n_q * block_q), jnp.float32, qp),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((block_q, d), jnp.float32),   # acc
+        pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+        pltpu.VMEM((block_q, 1), jnp.float32),   # running denom
+    ]
+    n_live = sum(
+        min(int(n_k) - 1, (qi * block_q + block_q - 1) // block_k) + 1
+        for qi in range(int(n_q))
+    ) if causal else 0
+    if causal and n_live <= _MAX_CAUSAL_TILES:
+        qids, kids = _causal_tiles(int(n_q), int(n_k), block_q, block_k)
+        kernel = functools.partial(
+            _attn_kernel_causal, scale=scale,
+            block_q=block_q, block_k=block_k, n_k=n_k, l_real=l_real,
+        )
+        # index maps see (b, t, qids_ref, kids_ref): the tile walk is
+        # decoded through the prefetched arrays, so the pipeline only
+        # ever streams live KV tiles
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, len(qids)),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d),
+                             lambda b, t, qids, kids: (b, qids[t], 0),
+                             memory_space=vmem),
+                pl.BlockSpec((1, block_k, d),
+                             lambda b, t, qids, kids: (b, kids[t], 0),
+                             memory_space=vmem),
+                pl.BlockSpec((1, block_k, d),
+                             lambda b, t, qids, kids: (b, kids[t], 0),
+                             memory_space=vmem),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d),
+                             lambda b, t, qids, kids: (b, qids[t], 0),
+                             memory_space=vmem),
+                pl.BlockSpec((1, block_q),
+                             lambda b, t, qids, kids: (b, qids[t]),
+                             memory_space=vmem),
+            ],
+            scratch_shapes=scratch_shapes,
+        )
+        o, lse = pl.pallas_call(
+            kernel, grid_spec=grid_spec, out_shape=out_shape,
+            interpret=_interpret(),
+        )(jnp.asarray(qids), jnp.asarray(kids), qp, kp, vp)
+        return o[:, :l_real], lse[:, :l_real]
+
     kernel = functools.partial(
-        _attn_kernel, scale=scale, causal=causal,
+        _attn_kernel_rect, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, n_k=n_k, l_real=l_real,
     )
-    vmem = pltpu.VMEM
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_k),
@@ -147,15 +280,8 @@ def _flash_fwd_2d(q, k, v, *, causal, scale, block_q, block_k):
             pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
                          memory_space=vmem),
         ],
-        out_shape=[
-            _sds((bh, n_q * block_q, d), q.dtype, qp),
-            _sds((bh, n_q * block_q), jnp.float32, qp),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),   # acc
-            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
-            pltpu.VMEM((block_q, 1), jnp.float32),   # running denom
-        ],
+        out_shape=out_shape,
+        scratch_shapes=scratch_shapes,
         interpret=_interpret(),
     )(qp, kp, vp)
     return o[:, :l_real], lse[:, :l_real]

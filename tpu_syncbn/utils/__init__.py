@@ -13,9 +13,12 @@ from tpu_syncbn.utils.metrics import (
     step_timer,
 )
 from tpu_syncbn.utils.coco_map import evaluate_detections
+from tpu_syncbn.utils.fid import frechet_distance, gaussian_stats
 
 __all__ = [
     "evaluate_detections",
+    "frechet_distance",
+    "gaussian_stats",
     "save_checkpoint",
     "load_checkpoint",
     "available_steps",
