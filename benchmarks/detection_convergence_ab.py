@@ -44,12 +44,19 @@ def parse_args():
     # learnable-regime defaults: 3 classes / <=2 boxes of 40-70% image
     # side — sizes RetinaNet's smallest default anchor (4x stride 8 =
     # 32 px at 64x64) can match at IoU>=0.5, so the task trains to
-    # nonzero mAP at CPU-mesh scale and the val_map block can separate
-    # the arms (smaller 10-30% boxes only ever match via low-quality
-    # promotion and AP stays ~0 regardless of BN mode)
+    # nonzero mAP given enough steps (~AP50 0.3 after 1500 CPU-mesh
+    # steps; at the quick 150-step default every arm's AP is still ~0 —
+    # the val_map block needs the long run to separate the arms).
+    # Smaller 10-30% boxes only ever match via low-quality promotion
+    # and AP stays ~0 regardless of BN mode or steps.
     p.add_argument("--num-classes", type=int, default=3)
     p.add_argument("--max-boxes", type=int, default=2)
     p.add_argument("--box-frac", type=float, nargs=2, default=[0.4, 0.7])
+    # task-difficulty knob (same role as realdata_accuracy_ab's noise):
+    # at the easy default every arm learns the task to similar mAP
+    # despite corrupted statistics — separation at the task metric needs
+    # the harder regime where statistics quality is load-bearing
+    p.add_argument("--noise", type=float, default=0.3)
     p.add_argument("--dataset-size", type=int, default=128)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--momentum", type=float, default=0.0,
@@ -89,7 +96,7 @@ def main():
     ds = tdata.SyntheticDetectionDataset(
         length=args.dataset_size, image_size=size,
         num_classes=args.num_classes, max_boxes=args.max_boxes,
-        seed=args.seed, box_frac=tuple(args.box_frac),
+        seed=args.seed, box_frac=tuple(args.box_frac), noise=args.noise,
     )
     # materialize once: every arm sees byte-identical batches
     samples = [ds[i] for i in range(len(ds))]
@@ -121,6 +128,7 @@ def main():
         length=args.eval_images, image_size=size,
         num_classes=args.num_classes, max_boxes=args.max_boxes,
         seed=args.seed + 1000, box_frac=tuple(args.box_frac),
+        noise=args.noise,
     )
 
     def eval_map(dp) -> dict:
@@ -200,6 +208,7 @@ def main():
         "per_chip_batch": B,
         "steps": args.steps,
         "image_size": args.image_size,
+        "noise": args.noise,
         "syncbn_loss_mae": round(sync_mae, 6),
         "perreplica_loss_mae": round(local_mae, 6),
         "divergence_ratio": round(local_mae / max(sync_mae, 1e-12), 2),

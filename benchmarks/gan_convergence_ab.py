@@ -168,9 +168,13 @@ def main():
 
     _, feat_d = oracle_tr.sync_to_models()
     feat_d.eval()
+    # generate() shards z over the R-device mesh: the eval batch must be
+    # divisible by R even when --dataset-size isn't (training only needs
+    # dataset_size >= one global batch)
+    n_eval = max(R, (args.dataset_size // R) * R)
     z_eval = jnp.asarray(
         np.random.RandomState(args.seed + 9).randn(
-            args.dataset_size, args.latent
+            n_eval, args.latent
         ).astype(np.float32)
     )
     real_stats = utils.gaussian_stats(

@@ -122,35 +122,36 @@ def main():
             # mode has no such invariant and skips the plumbing
             cmd += ["--curves", curves_path, "--oracle-curve", oracle_path]
         try:
-            proc = subprocess.run(
-                cmd,
-                cwd=HERE, capture_output=True, text=True, timeout=3600,
-            )
-            if proc.returncode != 0:
-                raise RuntimeError(
-                    f"rc={proc.returncode}: {proc.stderr[-1000:]}"
-                )
-            d = _last_json_line(proc.stdout)
-        except (subprocess.TimeoutExpired, RuntimeError) as e:
-            # completed doses are training hours — keep them
-            log(f"  ({r}, {b}) FAILED: {e}")
-            result["failed"].append({"replicas": r, "per_chip_batch": b})
-            save()
-            continue
-        if args.mode == "const_global":
-            # verification input only: an unreadable curves file must
-            # not discard a successfully-parsed dose (it just shrinks
-            # what the oracle-identity check can compare)
             try:
-                with open(curves_path) as f:
-                    oracle_curves[(r, b)] = json.load(f)["oracle"]
-            except (OSError, KeyError, ValueError) as e:
-                log(f"  ({r}, {b}) oracle-curve readback failed: {e}")
-            finally:
+                proc = subprocess.run(
+                    cmd,
+                    cwd=HERE, capture_output=True, text=True, timeout=3600,
+                )
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"rc={proc.returncode}: {proc.stderr[-1000:]}"
+                    )
+                d = _last_json_line(proc.stdout)
+            except (subprocess.TimeoutExpired, RuntimeError) as e:
+                # completed doses are training hours — keep them
+                log(f"  ({r}, {b}) FAILED: {e}")
+                result["failed"].append({"replicas": r, "per_chip_batch": b})
+                save()
+                continue
+            if args.mode == "const_global":
+                # verification input only: an unreadable curves file
+                # must not discard a successfully-parsed dose — the
+                # identity check below accounts for the missing curve
                 try:
-                    os.remove(curves_path)
-                except OSError:
-                    pass
+                    with open(curves_path) as f:
+                        oracle_curves[(r, b)] = json.load(f)["oracle"]
+                except (OSError, KeyError, ValueError) as e:
+                    log(f"  ({r}, {b}) oracle-curve readback failed: {e}")
+        finally:
+            try:
+                os.remove(curves_path)
+            except OSError:
+                pass
         result["points"].append({
             "replicas": r,
             "per_chip_batch": b,
@@ -167,16 +168,23 @@ def main():
         os.remove(oracle_path)
     except OSError:
         pass
-    if args.mode == "const_global" and len(oracle_curves) > 1:
+    if args.mode == "const_global" and len(result["points"]) > 1:
         # every dose must have scored against the SAME oracle curve
         # (trained once, shared via --oracle-curve) — verified on the
-        # FULL unrounded per-step curve, fatal on drift: an artifact
-        # whose isolation failed must not exit 0
+        # FULL unrounded per-step curve. Fatal on drift AND on
+        # unverifiability: an artifact whose documented isolation
+        # invariant was never checked must not look like a verified one
         curves = list(oracle_curves.values())
-        result["oracle_shared"] = all(c == curves[0] for c in curves[1:])
-        if not result["oracle_shared"]:
-            log("ERROR: oracle curves differ across doses — the "
-                "const-global isolation failed")
+        verified = (
+            len(oracle_curves) == len(result["points"])
+            and all(c == curves[0] for c in curves[1:])
+        )
+        result["oracle_shared"] = verified
+        if not verified:
+            log("ERROR: oracle identity across doses not verified "
+                f"(curves readable for {len(oracle_curves)}/"
+                f"{len(result['points'])} doses, "
+                f"identical={bool(curves) and all(c == curves[0] for c in curves[1:])})")
         save()
     print(json.dumps(result))
     if result["failed"] or result.get("oracle_shared") is False:

@@ -72,7 +72,20 @@ def stage_done(stage: str) -> bool:
         # "complete" distinguishes all-cases-passed from a mid-stage tunnel
         # death; artifacts predating the flag carry all 5 shape cases
         complete = payload.get("complete", len(payload.get("cases", [])) >= 5)
-        return bool(complete) and payload.get("backend") == "tpu"
+        if not (complete and payload.get("backend") == "tpu"):
+            return False
+        # evidence validates a binary, not a file name: a kernel edit
+        # voids the artifact and the stage re-runs at the next window
+        # (the stage itself re-seeds only version-matched cases)
+        try:
+            import tpu_validation
+
+            current = (tpu_validation._bn_code_version()
+                       if stage == "pallas_parity"
+                       else tpu_validation._attn_code_version())
+        except Exception:
+            return True  # can't fingerprint: don't wedge the queue
+        return payload.get("code_version") == current
     if stage in ("entry_compile", "bench_compile", "vma_probe"):
         # written in-process; complete means the evidence was recorded
         return bool(payload.get("complete")) and payload.get("backend") == "tpu"

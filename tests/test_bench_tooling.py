@@ -264,3 +264,30 @@ def test_zigzag_flops_benchmark_contract():
     assert out["reduction_x"] > 1.0
     assert out["predicted_x"] == round(4 * 2 / (2 * 1 + 3), 4)
     assert out["zigzag_flops"] < out["contiguous_flops"]
+
+
+class TestKernelEditInvalidatesParity:
+    """Hardware evidence validates a binary: after a kernel-source edit
+    the watcher must re-run the parity stages at the next window, even
+    though the on-disk artifact says complete."""
+
+    def _current(self, stage):
+        v = _load_validation()
+        return (v._bn_code_version() if stage == "pallas_parity"
+                else v._attn_code_version())
+
+    def test_stale_fingerprint_not_done(self, tmp_path):
+        w = _load_watcher(tmp_path)
+        for stage in ("pallas_parity", "flash_parity"):
+            _write(tmp_path, stage,
+                   {"backend": "tpu", "cases": [{"ok": True}] * 5,
+                    "complete": True, "code_version": "0000deadbeef0000"})
+            assert not w.stage_done(stage)
+
+    def test_current_fingerprint_done(self, tmp_path):
+        w = _load_watcher(tmp_path)
+        for stage in ("pallas_parity", "flash_parity"):
+            _write(tmp_path, stage,
+                   {"backend": "tpu", "cases": [{"ok": True}] * 5,
+                    "complete": True, "code_version": self._current(stage)})
+            assert w.stage_done(stage)

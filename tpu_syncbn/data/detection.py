@@ -93,8 +93,13 @@ class SyntheticDetectionDataset(Dataset):
         ).astype(np.float32)
         labels = rng.randint(0, self.num_classes, n).astype(np.int32)
         for (bx1, by1, bx2, by2), lab in zip(boxes, labels):
-            ix1, iy1 = int(round(bx1)), int(round(by1))
-            ix2, iy2 = max(int(round(bx2)), ix1 + 1), max(int(round(by2)), iy1 + 1)
+            # clamp into the canvas: rounding can push a box start to the
+            # image edge (x1 can approach w for small box_frac minima),
+            # and the painted block's shape must match its slice exactly
+            ix1 = min(int(round(bx1)), w - 1)
+            iy1 = min(int(round(by1)), h - 1)
+            ix2 = min(max(int(round(bx2)), ix1 + 1), w)
+            iy2 = min(max(int(round(by2)), iy1 + 1), h)
             image[iy1:iy2, ix1:ix2] = (
                 self.palette[lab]
                 + self.noise * rng.randn(iy2 - iy1, ix2 - ix1, 3)

@@ -216,12 +216,11 @@ def _flash_fwd_2d(q, k, v, *, causal, scale, block_q, block_k):
         pltpu.VMEM((block_q, 1), jnp.float32),   # running max
         pltpu.VMEM((block_q, 1), jnp.float32),   # running denom
     ]
-    n_live = sum(
-        min(int(n_k) - 1, (qi * block_q + block_q - 1) // block_k) + 1
-        for qi in range(int(n_q))
-    ) if causal else 0
-    if causal and n_live <= _MAX_CAUSAL_TILES:
+    if causal:
+        # one source of truth for the live-tile set: the gate below must
+        # agree exactly with the SMEM index-array size it protects
         qids, kids = _causal_tiles(int(n_q), int(n_k), block_q, block_k)
+    if causal and len(qids) <= _MAX_CAUSAL_TILES:
         kernel = functools.partial(
             _attn_kernel_causal, scale=scale,
             block_q=block_q, block_k=block_k, n_k=n_k, l_real=l_real,
