@@ -248,22 +248,26 @@ def stage_flash_parity():
             if dtype == "float32":  # grads once per f32 case
                 # vs the ORACLE's grads: a compiled-path bug in the lse
                 # output corrupts only the backward (p = exp(s - lse)),
-                # so finiteness alone would certify nothing
+                # so finiteness alone would certify nothing. Both VJP
+                # implementations are validated — the XLA scan (default)
+                # and the fused two-kernel Pallas backward (opt-in)
                 wgt = jnp.asarray(
                     rng.standard_normal(got.shape), jnp.float32
                 )
-                g = jax.jit(jax.grad(
-                    lambda q: jnp.sum(wgt * pa.flash_attention(
-                        q, k, v, causal=causal))
-                ))(q)
                 g_ref = jax.grad(
                     lambda q: jnp.sum(
                         wgt * sequence._single_device_attention(
                             q, k, v, causal=causal, scale=None))
                 )(q)
-                np.testing.assert_allclose(
-                    np.asarray(g), np.asarray(g_ref), atol=5e-4
-                )
+                for bwd in ("xla", "pallas"):
+                    g = jax.jit(jax.grad(
+                        lambda q: jnp.sum(wgt * pa.flash_attention(
+                            q, k, v, causal=causal, backward=bwd))
+                    ))(q)
+                    np.testing.assert_allclose(
+                        np.asarray(g), np.asarray(g_ref), atol=5e-4,
+                        err_msg=f"backward={bwd}",
+                    )
             results["cases"].append({
                 "l": l, "d": d, "causal": causal, "dtype": dtype,
                 "ok": True,

@@ -20,10 +20,13 @@ the BlockSpec pipeline never touches their DMA (~2x bandwidth cut at
 long L vs the rectangular grid); the diagonal tile masks with a 2-D
 iota.
 
-Backward is a ``jax.custom_vjp`` in plain XLA: one ``lax.scan`` over KV
-blocks recomputes P column-block by column-block from the saved
-logsumexp (O(L·block_k) live memory, never (L, L)) and accumulates
-dQ/dK/dV with the standard flash backward identities.
+Backward is a ``jax.custom_vjp`` with two implementations, both
+recomputing P from the saved logsumexp (O(L·block) live memory, never
+(L, L)): the default ``"xla"`` path is one ``lax.scan`` over KV blocks;
+the opt-in ``"pallas"`` path (``backward="pallas"``) is two fused
+kernels in the FlashAttention-2 structure — a dK/dV kernel sweeping
+query tiles per KV tile and a dQ kernel sweeping KV tiles per query
+tile, f32 VMEM accumulators, causal dead tiles skipping their matmuls.
 
 Like the BN kernels, everything runs under ``interpret=True`` off-TPU
 (the CPU suite exercises the real kernel code path), and the kernel is
@@ -343,23 +346,214 @@ def _flash_bwd_2d(res, do, *, causal, scale, block_k):
     )
 
 
+# -- backward (Pallas, two fused kernels — FlashAttention-2 structure) ----
+
+
+def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+              qi, ki, *, scale, causal, block_q, block_k, l_real):
+    """Shared per-tile recompute for both backward kernels: returns
+    (p, ds, qf, dof) for one (qi, ki) tile, f32, with padded/causal-dead
+    entries zeroed. Padded query rows carry a ZERO-padded lse (the fwd
+    returns lse only for real rows), so exp(s - lse) is meaningless
+    there — dead entries are excluded by mask *selection* on p, which
+    keeps every dead contribution exactly zero regardless of what the
+    unselected exp evaluates to."""
+    qf = q_ref[0].astype(jnp.float32) * scale
+    kf = k_ref[0].astype(jnp.float32)
+    vf = v_ref[0].astype(jnp.float32)
+    dof = do_ref[0].astype(jnp.float32)
+    s = lax.dot_general(
+        qf, kf, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (block_q, block_k)
+    rows = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    cols = ki * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    mask = (rows < l_real) & (cols < l_real)
+    if causal:
+        mask = mask & (rows >= cols)
+    p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+    dp = lax.dot_general(
+        dof, vf, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_ref[0][:, None])
+    return p, ds, qf, dof
+
+
+def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dk_ref, dv_ref, dk_acc, dv_acc, *,
+                   scale, causal, block_q, block_k, n_q, l_real):
+    """dK/dV: grid (BH, n_k, n_q), qi innermost — the scratch carries
+    one KV tile's (dk, dv) across its sweep over query tiles."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # causal: a query tile fully left of this KV tile contributes nothing
+    live = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(live)
+    def _accum():
+        p, ds, qf, dof = _bwd_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+            scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, l_real=l_real,
+        )
+        dv_acc[...] += lax.dot_general(
+            p, dof, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_acc[...] += lax.dot_general(
+            ds, qf, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                  dq_ref, dq_acc, *,
+                  scale, causal, block_q, block_k, n_k, l_real):
+    """dQ: grid (BH, n_q, n_k), ki innermost — the scratch carries one
+    query tile's dq across its sweep over KV tiles."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    live = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _accum():
+        _, ds, _, _ = _bwd_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+            scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, l_real=l_real,
+        )
+        dq_acc[...] += lax.dot_general(
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        dq_ref[0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_2d_pallas(res, do, *, causal, scale, block_q, block_k):
+    """Fused backward: two pallas_calls (dK/dV then dQ), P recomputed
+    tile-by-tile from the saved logsumexp — (L, L) never materialized
+    and, unlike the XLA scan path, the per-tile matmuls are explicit
+    MXU calls with f32 VMEM accumulators. Causal dead tiles skip their
+    matmuls (rectangular grid; the fwd's compressed-walk DMA skip is a
+    future step here). Same evidence-gating stance as the forward:
+    opt-in (``backward="pallas"``) until timed on hardware."""
+    q, k, v, o, lse = res
+    bh, l_real, d = q.shape
+    n_q = pl.cdiv(l_real, block_q)
+    n_k = pl.cdiv(l_real, block_k)
+    pad_q = n_q * block_q - l_real
+    pad_k = n_k * block_k - l_real
+    padq = lambda x: jnp.pad(x, ((0, 0), (0, pad_q), (0, 0))) if pad_q else x
+    padk = lambda x: jnp.pad(x, ((0, 0), (0, pad_k), (0, 0))) if pad_k else x
+    qp, dop = padq(q), padq(do)
+    kp, vp = padk(k), padk(v)
+    # softmax-jacobian diagonal correction, computed on unpadded rows
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )
+    lsep = jnp.pad(lse, ((0, 0), (0, pad_q))) if pad_q else lse
+    deltap = jnp.pad(delta, ((0, 0), (0, pad_q))) if pad_q else delta
+
+    vmem = pltpu.VMEM
+    q_spec_kv = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0),
+                             memory_space=vmem)
+    kv_spec_kv = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
+                              memory_space=vmem)
+    row_spec_kv = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i),
+                               memory_space=vmem)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_kv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, n_q=n_q, l_real=l_real,
+        ),
+        grid=(bh, n_k, n_q),
+        in_specs=[q_spec_kv, kv_spec_kv, kv_spec_kv, q_spec_kv,
+                  row_spec_kv, row_spec_kv],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
+                         memory_space=vmem),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
+                         memory_space=vmem),
+        ],
+        out_shape=[
+            _sds((bh, n_k * block_k, d), q.dtype, qp),
+            _sds((bh, n_k * block_k, d), q.dtype, qp),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    q_spec_q = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                            memory_space=vmem)
+    kv_spec_q = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                             memory_space=vmem)
+    row_spec_q = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+                              memory_space=vmem)
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_q_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, n_k=n_k, l_real=l_real,
+        ),
+        grid=(bh, n_q, n_k),
+        in_specs=[q_spec_q, kv_spec_q, kv_spec_q, q_spec_q,
+                  row_spec_q, row_spec_q],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                               memory_space=vmem),
+        out_shape=_sds((bh, n_q * block_q, d), q.dtype, qp),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    return dq[:, :l_real], dk[:, :l_real], dv[:, :l_real]
+
+
 # -- public API -----------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_2d(q, k, v, causal, scale, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_2d(q, k, v, causal, scale, block_q, block_k, backward):
     o, _ = _flash_fwd_2d(q, k, v, causal=causal, scale=scale,
                          block_q=block_q, block_k=block_k)
     return o
 
 
-def _flash_2d_fwd(q, k, v, causal, scale, block_q, block_k):
+def _flash_2d_fwd(q, k, v, causal, scale, block_q, block_k, backward):
     o, lse = _flash_fwd_2d(q, k, v, causal=causal, scale=scale,
                            block_q=block_q, block_k=block_k)
     return o, (q, k, v, o, lse)
 
 
-def _flash_2d_bwd(causal, scale, block_q, block_k, res, do):
+def _flash_2d_bwd(causal, scale, block_q, block_k, backward, res, do):
+    if backward == "pallas":
+        return _flash_bwd_2d_pallas(res, do, causal=causal, scale=scale,
+                                    block_q=block_q, block_k=block_k)
     return _flash_bwd_2d(res, do, causal=causal, scale=scale,
                          block_k=block_k)
 
@@ -376,15 +570,22 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = _BLOCK_Q,
     block_k: int = _BLOCK_K,
+    backward: str = "xla",
 ) -> jax.Array:
     """Exact fused softmax attention, ``(B, L, H, D) → (B, L, H, D)``.
 
     Drop-in for ``parallel.sequence._single_device_attention`` (same
-    semantics, tolerances at f32 rounding); differentiable via the
-    blockwise custom VJP above. ``scale`` defaults to ``D**-0.5``.
+    semantics, tolerances at f32 rounding); differentiable via a
+    blockwise custom VJP. ``scale`` defaults to ``D**-0.5``.
+    ``backward`` selects the VJP implementation: ``"xla"`` (default —
+    blockwise lax.scan) or ``"pallas"`` (two fused kernels, dK/dV then
+    dQ; opt-in until timed on hardware, the evidence-gating stance).
     """
     if q.ndim != 4:
         raise ValueError(f"expected (B, L, H, D), got {q.shape}")
+    if backward not in ("xla", "pallas"):
+        raise ValueError(f"backward must be 'xla' or 'pallas', got "
+                         f"{backward!r}")
     # the 2d lowering takes lengths/padding from q and reuses them for
     # k/v (no cross-attention support), and the output reshape assumes
     # v's head_dim == q's — mismatches must fail here with a clear
@@ -397,5 +598,6 @@ def flash_attention(
     b, l, h, d = q.shape
     s = float(scale) if scale is not None else d ** -0.5
     to2d = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, l, x.shape[-1])
-    o = _flash_2d(to2d(q), to2d(k), to2d(v), causal, s, block_q, block_k)
+    o = _flash_2d(to2d(q), to2d(k), to2d(v), causal, s, block_q, block_k,
+                  backward)
     return o.reshape(b, h, l, d).transpose(0, 2, 1, 3)
