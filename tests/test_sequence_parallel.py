@@ -297,3 +297,33 @@ class TestUlyssesFlash:
                 mesh_of(2), q, k, v, causal=True, impl="ring",
                 local_impl="flash",
             )
+
+
+def test_ulysses_flash_pallas_backward_grads():
+    """local_backward='pallas' under shard_map: fwd AND grads must match
+    the oracle ulysses path (the fused backward kernels run inside the
+    sharded region)."""
+    mesh = mesh_of(4)
+    rng = np.random.default_rng(12)
+    q = jnp.asarray(rng.standard_normal((1, 64, 8, 16)), jnp.float32)
+
+    def run(**kw):
+        def loss(q):
+            out = sequence.sharded_self_attention(
+                mesh, q, q, q, impl="ulysses", causal=True, **kw
+            )
+            return jnp.sum(out ** 2)
+        return jax.grad(loss)(q)
+
+    g_p = run(local_impl="flash", local_backward="pallas")
+    g_o = run()
+    np.testing.assert_allclose(np.asarray(g_p), np.asarray(g_o), atol=2e-4)
+
+
+def test_local_backward_requires_flash():
+    mesh = mesh_of(2)
+    q = jnp.zeros((1, 16, 2, 8))
+    with pytest.raises(ValueError, match="local_backward"):
+        sequence.sharded_self_attention(
+            mesh, q, q, q, impl="ulysses", local_backward="pallas"
+        )

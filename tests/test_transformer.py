@@ -70,6 +70,32 @@ def test_flash_attn_impl_matches_dense():
     )
 
 
+def test_flash_pallas_bwd_impl_matches_dense_grads():
+    """attn_impl='flash_pallas_bwd' routes the VJP through the fused
+    two-kernel Pallas backward — logits AND grads must match dense."""
+    params = make_params()
+    tokens = make_tokens()
+
+    def loss(p, impl):
+        out = tfm.transformer_lm(p, tokens, n_heads=HEADS,
+                                 attn_impl=impl, axis_name=None)
+        return jnp.sum(out ** 2) / out.size
+
+    dense = tfm.transformer_lm(params, tokens, n_heads=HEADS)
+    flash = tfm.transformer_lm(params, tokens, n_heads=HEADS,
+                               attn_impl="flash_pallas_bwd", axis_name=None)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
+                               atol=3e-4)
+    g_p = jax.grad(lambda p: loss(p, "flash_pallas_bwd"))(params)
+    g_d = jax.grad(lambda p: loss(p, None))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-4
+        ),
+        g_p, g_d,
+    )
+
+
 def test_dense_lm_trains():
     params = make_params(seed=2)
     tokens = make_tokens(seed=3)

@@ -76,10 +76,12 @@ def _rms_norm(x, scale):
 
 
 def _attend(q, k, v, impl: Optional[str], axis_name: Optional[str]):
-    if impl == "flash":
+    if impl in ("flash", "flash_pallas_bwd"):
         # fused Pallas kernel over the FULL sequence — the dense
         # counterpart of the SP impls; opt-in pending hardware timing
-        # (the ops.batch_norm evidence-gating stance)
+        # (the ops.batch_norm evidence-gating stance). The _pallas_bwd
+        # variant also routes the VJP through the fused two-kernel
+        # Pallas backward (whole attention fwd+bwd on the MXU path).
         if axis_name is not None:
             raise ValueError(
                 "attn_impl='flash' is the dense single-device kernel; it "
@@ -89,7 +91,8 @@ def _attend(q, k, v, impl: Optional[str], axis_name: Optional[str]):
             )
         from tpu_syncbn.ops.pallas_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True)
+        backward = "pallas" if impl == "flash_pallas_bwd" else "xla"
+        return flash_attention(q, k, v, causal=True, backward=backward)
     if impl is None or axis_name is None:
         return _single_device_attention(q, k, v, causal=True, scale=None)
     if impl == "ring":

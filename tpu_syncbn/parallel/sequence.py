@@ -343,6 +343,7 @@ def ulysses_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     local_impl: Optional[str] = None,
+    local_backward: str = "xla",
 ) -> jax.Array:
     """Sequence parallelism by head redistribution (DeepSpeed-Ulysses).
 
@@ -363,14 +364,21 @@ def ulysses_attention(
     the score-matrix oracle: the (L, L) scores — Ulysses' memory ceiling
     for long context — are then never materialized. Default None keeps
     the oracle (the evidence-gating stance: kernels are opt-in until
-    timed on hardware). Under the CPU mesh's *interpret* lowering the
-    enclosing ``shard_map`` needs ``check_vma=False`` when flash is
-    selected (hlo_interpreter dynamic_slice rejects the checker around
-    pallas bodies); the TPU lowering keeps the checker on.
+    timed on hardware). ``local_backward`` forwards to the flash
+    kernel's VJP selector ("xla" scan default; "pallas" = the fused
+    two-kernel backward — so long-context training can run the whole
+    attention fwd+bwd through Pallas). Under the CPU mesh's *interpret*
+    lowering the enclosing ``shard_map`` needs ``check_vma=False`` when
+    flash is selected (hlo_interpreter dynamic_slice rejects the checker
+    around pallas bodies); the TPU lowering keeps the checker on.
     """
     if local_impl not in (None, "flash"):
         raise ValueError(
             f"local_impl must be None or 'flash', got {local_impl!r}"
+        )
+    if local_impl is None and local_backward != "xla":
+        raise ValueError(
+            "local_backward applies to local_impl='flash' only"
         )
     n = lax.axis_size(axis_name)
     h = q.shape[2]
@@ -378,7 +386,8 @@ def ulysses_attention(
         from tpu_syncbn.ops.pallas_attention import flash_attention
 
         local_attn = functools.partial(
-            flash_attention, causal=causal, scale=scale
+            flash_attention, causal=causal, scale=scale,
+            backward=local_backward,
         )
     else:
         local_attn = functools.partial(
@@ -415,6 +424,7 @@ def sharded_self_attention(
     scale: Optional[float] = None,
     impl: str = "ring",
     local_impl: Optional[str] = None,
+    local_backward: str = "xla",
 ) -> jax.Array:
     """Array-level convenience wrapper: shard global ``(B, L, H, D)``
     arrays along ``L`` over ``mesh[axis_name]`` and run ring, zigzag-ring
@@ -450,9 +460,15 @@ def sharded_self_attention(
         kw = dict(axis_name=axis_name, causal=causal, scale=scale)
         if impl == "ulysses":
             kw["local_impl"] = local_impl
+            kw["local_backward"] = local_backward
         elif local_impl is not None:
             raise ValueError(
                 f"local_impl applies to impl='ulysses' only, got "
+                f"impl={impl!r}"
+            )
+        elif local_backward != "xla":
+            raise ValueError(
+                f"local_backward applies to impl='ulysses' only, got "
                 f"impl={impl!r}"
             )
         fn = functools.partial(base, **kw)
