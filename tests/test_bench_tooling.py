@@ -93,18 +93,29 @@ class TestStageDone:
     def test_parity_requires_completion_flag(self, tmp_path):
         # a window that dies after case 1 of 5 must stay retryable
         w = _load_watcher(tmp_path)
+        current = _load_validation()._bn_code_version()
         _write(tmp_path, "pallas_parity",
-               {"backend": "tpu", "cases": [{"ok": True}], "complete": False})
+               {"backend": "tpu", "cases": [{"ok": True}],
+                "complete": False, "code_version": current})
         assert not w.stage_done("pallas_parity")
         _write(tmp_path, "pallas_parity",
-               {"backend": "tpu", "cases": [{"ok": True}], "complete": True})
+               {"backend": "tpu", "cases": [{"ok": True}],
+                "complete": True, "code_version": current})
         assert w.stage_done("pallas_parity")
 
-    def test_parity_legacy_artifact_counts_five_cases(self, tmp_path):
+    def test_parity_legacy_artifact_needs_fingerprint(self, tmp_path):
         # artifacts written before the "complete" flag carry all 5 cases
+        # — but one with NO code_version cannot prove which kernel binary
+        # it validated, so the fingerprint gate sends it back for a
+        # re-run at the next window
         w = _load_watcher(tmp_path)
         _write(tmp_path, "pallas_parity",
                {"backend": "tpu", "cases": [{"ok": True}] * 5})
+        assert not w.stage_done("pallas_parity")
+        current = _load_validation()._bn_code_version()
+        _write(tmp_path, "pallas_parity",
+               {"backend": "tpu", "cases": [{"ok": True}] * 5,
+                "code_version": current})
         assert w.stage_done("pallas_parity")
 
     def test_entry_compile_artifact_is_done(self, tmp_path):
