@@ -198,6 +198,23 @@ def _causal_tiles(n_q: int, n_k: int, block_q: int, block_k: int):
     return np.asarray(qids, np.int32), np.asarray(kids, np.int32)
 
 
+@functools.lru_cache(maxsize=64)
+def _causal_tiles_kv(n_q: int, n_k: int, block_q: int, block_k: int):
+    """The transposed walk for the dK/dV backward kernel: live (ki, qi)
+    pairs grouped by ki ascending, qi ascending within ki starting at
+    the first query tile that reaches this KV tile's columns
+    (qi_lo = (ki*block_k) // block_q) — the scratch carries one KV
+    tile's (dk, dv) across its contiguous qi sweep."""
+    import numpy as np
+
+    kis, qis = [], []
+    for ki in range(n_k):
+        for qi in range((ki * block_k) // block_q, n_q):
+            kis.append(ki)
+            qis.append(qi)
+    return np.asarray(kis, np.int32), np.asarray(qis, np.int32)
+
+
 def _flash_fwd_2d(q, k, v, *, causal, scale, block_q, block_k):
     """(BH, L, D) in → ((BH, L, D) out, (BH, L) logsumexp)."""
     bh, l_real, d = q.shape
@@ -453,14 +470,96 @@ def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
 
 
+def _walk_group_bounds(group_ref, t, n_tiles):
+    """Group start/end flags for position ``t`` of a compressed tile
+    walk, derived from the walk's OWN grouping array (the scalar-
+    prefetched kis/qids) rather than re-deriving the diagonal formula —
+    one source of truth with the host-side enumeration. A group starts
+    where the grouping value changes (or at t=0, which also covers the
+    per-batch restart of program_id) and ends where the next value
+    differs (or at the final tile)."""
+    g = group_ref[t]
+    prev = group_ref[jnp.maximum(t - 1, 0)]
+    nxt = group_ref[jnp.minimum(t + 1, n_tiles - 1)]
+    is_start = (t == 0) | (g != prev)
+    is_end = (t == n_tiles - 1) | (g != nxt)
+    return is_start, is_end
+
+
+def _bwd_kv_kernel_c(kis_ref, qis_ref, q_ref, k_ref, v_ref, do_ref,
+                     lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                     *, scale, block_q, block_k, n_tiles, l_real):
+    """Compressed causal dK/dV: 1-D walk over live (ki, qi) pairs from
+    the scalar-prefetched transposed enumeration — dead tiles are never
+    visited, so their Q/dO/lse/delta DMA never happens."""
+    t = pl.program_id(1)
+    ki = kis_ref[t]
+    qi = qis_ref[t]
+    is_start, is_end = _walk_group_bounds(kis_ref, t, n_tiles)
+
+    @pl.when(is_start)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    p, ds, qf, dof = _bwd_p_ds(
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+        scale=scale, causal=True, block_q=block_q,
+        block_k=block_k, l_real=l_real,
+    )
+    dv_acc[...] += lax.dot_general(
+        p, dof, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dk_acc[...] += lax.dot_general(
+        ds, qf, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(is_end)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_q_kernel_c(qids_ref, kids_ref, q_ref, k_ref, v_ref, do_ref,
+                    lse_ref, delta_ref, dq_ref, dq_acc,
+                    *, scale, block_q, block_k, n_tiles, l_real):
+    """Compressed causal dQ: same walk as the compressed forward."""
+    t = pl.program_id(1)
+    qi = qids_ref[t]
+    ki = kids_ref[t]
+    is_start, is_end = _walk_group_bounds(qids_ref, t, n_tiles)
+
+    @pl.when(is_start)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    _, ds, _, _ = _bwd_p_ds(
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+        scale=scale, causal=True, block_q=block_q,
+        block_k=block_k, l_real=l_real,
+    )
+    dq_acc[...] += lax.dot_general(
+        ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(is_end)
+    def _finalize():
+        dq_ref[0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
+
+
 def _flash_bwd_2d_pallas(res, do, *, causal, scale, block_q, block_k):
     """Fused backward: two pallas_calls (dK/dV then dQ), P recomputed
     tile-by-tile from the saved logsumexp — (L, L) never materialized
     and, unlike the XLA scan path, the per-tile matmuls are explicit
-    MXU calls with f32 VMEM accumulators. Causal dead tiles skip their
-    matmuls (rectangular grid; the fwd's compressed-walk DMA skip is a
-    future step here). Same evidence-gating stance as the forward:
-    opt-in (``backward="pallas"``) until timed on hardware."""
+    MXU calls with f32 VMEM accumulators. Under ``causal=True`` both
+    kernels use compressed live-tile walks (the forward's DMA-skip
+    mechanism; the dK/dV walk is the transposed enumeration), with the
+    rectangular matmul-skip grid as the over-cap fallback. Same
+    evidence-gating stance as the forward: opt-in
+    (``backward="pallas"``) until timed on hardware."""
     q, k, v, o, lse = res
     bh, l_real, d = q.shape
     n_q = pl.cdiv(l_real, block_q)
@@ -479,57 +578,131 @@ def _flash_bwd_2d_pallas(res, do, *, causal, scale, block_q, block_k):
     deltap = jnp.pad(delta, ((0, 0), (0, pad_q))) if pad_q else delta
 
     vmem = pltpu.VMEM
-    q_spec_kv = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0),
-                             memory_space=vmem)
-    kv_spec_kv = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
-                              memory_space=vmem)
-    row_spec_kv = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i),
-                               memory_space=vmem)
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_kv_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, n_q=n_q, l_real=l_real,
-        ),
-        grid=(bh, n_k, n_q),
-        in_specs=[q_spec_kv, kv_spec_kv, kv_spec_kv, q_spec_kv,
-                  row_spec_kv, row_spec_kv],
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
-                         memory_space=vmem),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
-                         memory_space=vmem),
-        ],
-        out_shape=[
-            _sds((bh, n_k * block_k, d), q.dtype, qp),
-            _sds((bh, n_k * block_k, d), q.dtype, qp),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
-        ],
-        interpret=_interpret(),
-    )(qp, kp, vp, dop, lsep, deltap)
+    operands = (qp, kp, vp, dop, lsep, deltap)
+    kv_out_shape = [
+        _sds((bh, n_k * block_k, d), q.dtype, qp),
+        _sds((bh, n_k * block_k, d), q.dtype, qp),
+    ]
+    kv_scratch = [
+        pltpu.VMEM((block_k, d), jnp.float32),
+        pltpu.VMEM((block_k, d), jnp.float32),
+    ]
+    compressed = False
+    if causal:
+        kis, qis = _causal_tiles_kv(int(n_q), int(n_k), block_q, block_k)
+        qids, kids = _causal_tiles(int(n_q), int(n_k), block_q, block_k)
+        compressed = max(len(kis), len(qids)) <= _MAX_CAUSAL_TILES
 
-    q_spec_q = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
-                            memory_space=vmem)
-    kv_spec_q = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
-                             memory_space=vmem)
-    row_spec_q = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
-                              memory_space=vmem)
-    dq = pl.pallas_call(
-        functools.partial(
-            _bwd_q_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, n_k=n_k, l_real=l_real,
-        ),
-        grid=(bh, n_q, n_k),
-        in_specs=[q_spec_q, kv_spec_q, kv_spec_q, q_spec_q,
-                  row_spec_q, row_spec_q],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
-                               memory_space=vmem),
-        out_shape=_sds((bh, n_q * block_q, d), q.dtype, qp),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        interpret=_interpret(),
-    )(qp, kp, vp, dop, lsep, deltap)
+    def _walk_specs(q_slot):
+        """Operand/row specs for a compressed backward walk whose
+        prefetch ref ``q_slot`` (0 or 1) carries the Q-row tile index
+        and whose other ref carries the KV-row index. ONE builder for
+        both kernels — the two walks differ only in which array means
+        what, and a drifted copy would compile but misindex."""
+        def q3(b, t, *refs):
+            return (b, refs[q_slot][t], 0)
+
+        def kv3(b, t, *refs):
+            return (b, refs[1 - q_slot][t], 0)
+
+        def q2(b, t, *refs):
+            return (b, refs[q_slot][t])
+
+        in_specs = [
+            pl.BlockSpec((1, block_q, d), q3, memory_space=vmem),   # q
+            pl.BlockSpec((1, block_k, d), kv3, memory_space=vmem),  # k
+            pl.BlockSpec((1, block_k, d), kv3, memory_space=vmem),  # v
+            pl.BlockSpec((1, block_q, d), q3, memory_space=vmem),   # do
+            pl.BlockSpec((1, block_q), q2, memory_space=vmem),      # lse
+            pl.BlockSpec((1, block_q), q2, memory_space=vmem),      # delta
+        ]
+        return in_specs, q3, kv3
+
+    if compressed:
+        in_specs, _, kv3 = _walk_specs(q_slot=1)  # (kis, qis) prefetch
+        dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_kv_kernel_c, scale=scale, block_q=block_q,
+                block_k=block_k, n_tiles=len(kis), l_real=l_real,
+            ),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(bh, len(kis)),
+                in_specs=in_specs,
+                out_specs=[
+                    pl.BlockSpec((1, block_k, d), kv3, memory_space=vmem),
+                    pl.BlockSpec((1, block_k, d), kv3, memory_space=vmem),
+                ],
+                scratch_shapes=kv_scratch,
+            ),
+            out_shape=kv_out_shape,
+            interpret=_interpret(),
+        )(jnp.asarray(kis), jnp.asarray(qis), *operands)
+    else:
+        q_spec_kv = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0),
+                                 memory_space=vmem)
+        kv_spec_kv = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
+                                  memory_space=vmem)
+        row_spec_kv = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i),
+                                   memory_space=vmem)
+        dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_kv_kernel, scale=scale, causal=causal,
+                block_q=block_q, block_k=block_k, n_q=n_q, l_real=l_real,
+            ),
+            grid=(bh, n_k, n_q),
+            in_specs=[q_spec_kv, kv_spec_kv, kv_spec_kv, q_spec_kv,
+                      row_spec_kv, row_spec_kv],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
+                             memory_space=vmem),
+                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
+                             memory_space=vmem),
+            ],
+            out_shape=kv_out_shape,
+            scratch_shapes=kv_scratch,
+            interpret=_interpret(),
+        )(*operands)
+
+    if compressed:
+        in_specs, q3, _ = _walk_specs(q_slot=0)  # (qids, kids) prefetch
+        dq = pl.pallas_call(
+            functools.partial(
+                _bwd_q_kernel_c, scale=scale, block_q=block_q,
+                block_k=block_k, n_tiles=len(qids), l_real=l_real,
+            ),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(bh, len(qids)),
+                in_specs=in_specs,
+                out_specs=pl.BlockSpec((1, block_q, d), q3,
+                                       memory_space=vmem),
+                scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            ),
+            out_shape=_sds((bh, n_q * block_q, d), q.dtype, qp),
+            interpret=_interpret(),
+        )(jnp.asarray(qids), jnp.asarray(kids), *operands)
+    else:
+        q_spec_q = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                                memory_space=vmem)
+        kv_spec_q = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                                 memory_space=vmem)
+        row_spec_q = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+                                  memory_space=vmem)
+        dq = pl.pallas_call(
+            functools.partial(
+                _bwd_q_kernel, scale=scale, causal=causal,
+                block_q=block_q, block_k=block_k, n_k=n_k, l_real=l_real,
+            ),
+            grid=(bh, n_q, n_k),
+            in_specs=[q_spec_q, kv_spec_q, kv_spec_q, q_spec_q,
+                      row_spec_q, row_spec_q],
+            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                                   memory_space=vmem),
+            out_shape=_sds((bh, n_q * block_q, d), q.dtype, qp),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            interpret=_interpret(),
+        )(*operands)
 
     return dq[:, :l_real], dk[:, :l_real], dv[:, :l_real]
 
