@@ -38,7 +38,7 @@ ART = os.path.join(ROOT, "benchmarks", "artifacts")
 # default order = direct-run execution order: bench_compile strictly
 # before bench so a direct battery run during a scarce window also gets
 # the prewarmed (cache-hit) compile, not just the watcher's ordering
-STAGES = ["pallas_parity", "flash_parity", "pallas_sweep",
+STAGES = ["pallas_parity", "flash_parity", "flash_overhead", "pallas_sweep",
           "syncbn_overhead", "buffer_broadcast", "bench_compile", "bench",
           "entry_compile", "vma_probe"]
 
@@ -278,6 +278,106 @@ def stage_flash_parity():
         results["complete"] = True
     finally:
         save("flash_parity", results)
+
+
+def stage_flash_overhead():
+    """Time the flash kernel against the (L, L) softmax oracle on the
+    chip — fwd+grad wall time per step for three implementations:
+    oracle, flash with the XLA-scan backward, flash with the fused
+    Pallas backward. This is the evidence the opt-in flash paths
+    (``attn_impl="flash"``, ``local_impl="flash"``, ``backward=
+    "pallas"``) are waiting on; per-case incremental save + kernel
+    fingerprint like the parity stages."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    from tpu_syncbn.ops import pallas_attention as pa
+    from tpu_syncbn.parallel import sequence
+
+    version = _attn_code_version()
+    results = {"backend": "tpu", "code_version": version,
+               "cases": [], "complete": False}
+    try:
+        with open(os.path.join(ART, "tpu_flash_overhead.json")) as f:
+            prev = json.load(f)
+        if (prev.get("backend") == "tpu"
+                and prev.get("code_version") == version):
+            results["cases"] = list(prev.get("cases", []))
+    except (OSError, json.JSONDecodeError):
+        pass
+    done = {(c["l"], c["causal"]) for c in results["cases"]}
+
+    def timed(fn, *args, iters=20):
+        out = jax.block_until_ready(fn(*args))  # compile + warm
+        jax.block_until_ready(fn(*args))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+
+    # (L, include_oracle): the oracle materializes (B, L, H, L) scores,
+    # so it drops out of the long-L case rather than OOMing the chip
+    cases = [(2048, True, True), (2048, False, True), (8192, True, False)]
+    try:
+        for (l, causal, with_oracle) in cases:
+            if (l, causal) in done:
+                log(f"[flash_overhead] L={l} causal={causal} done; skipping")
+                continue
+            rng = np.random.default_rng([l, int(causal)])
+            q, k, v = (
+                jnp.asarray(rng.standard_normal((1, l, 8, 64)),
+                            jnp.float32).astype(jnp.bfloat16)
+                for _ in range(3)
+            )
+            wgt = jnp.asarray(
+                rng.standard_normal((1, l, 8, 64)), jnp.float32
+            )
+
+            def make(fn):
+                def step(q, k, v):
+                    def loss(q, k, v):
+                        return jnp.sum(
+                            wgt * fn(q, k, v).astype(jnp.float32)
+                        )
+                    l_, g = jax.value_and_grad(loss, argnums=(0, 1, 2))(
+                        q, k, v
+                    )
+                    return l_, g
+                return jax.jit(step)
+
+            case = {"l": l, "causal": causal, "dtype": "bfloat16",
+                    "heads": 8, "head_dim": 64}
+            case["flash_xla_bwd_s"] = timed(make(
+                lambda q, k, v: pa.flash_attention(
+                    q, k, v, causal=causal, backward="xla")), q, k, v)
+            case["flash_pallas_bwd_s"] = timed(make(
+                lambda q, k, v: pa.flash_attention(
+                    q, k, v, causal=causal, backward="pallas")), q, k, v)
+            if with_oracle:
+                case["oracle_s"] = timed(make(
+                    lambda q, k, v: sequence._single_device_attention(
+                        q, k, v, causal=causal, scale=None)), q, k, v)
+                best = min(case["flash_xla_bwd_s"],
+                           case["flash_pallas_bwd_s"])
+                case["flash_speedup_vs_oracle"] = round(
+                    case["oracle_s"] / best, 3
+                )
+            case["pallas_bwd_speedup_vs_xla_bwd"] = round(
+                case["flash_xla_bwd_s"] / case["flash_pallas_bwd_s"], 3
+            )
+            for key in ("flash_xla_bwd_s", "flash_pallas_bwd_s",
+                        "oracle_s"):
+                if key in case:
+                    case[key] = round(case[key], 5)
+            results["cases"].append(case)
+            save("flash_overhead", results)
+            log(f"[flash_overhead] L={l} causal={causal}: {case}")
+        results["complete"] = True
+    finally:
+        save("flash_overhead", results)
 
 
 def stage_entry_compile():
@@ -524,6 +624,8 @@ def main():
                 stage_bench_compile()
             elif stage == "vma_probe":
                 stage_vma_probe()
+            elif stage == "flash_overhead":
+                stage_flash_overhead()
             elif stage == "pallas_sweep":
                 run_sub(stage, [sys.executable, "benchmarks/pallas_block_sweep.py",
                                 "--iters", "10", "--budget-s", "1400",

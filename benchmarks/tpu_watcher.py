@@ -58,7 +58,7 @@ ART = os.path.join(ROOT, "benchmarks", "artifacts")
 # then the headline number rides the warmed cache
 STAGES = ["entry_compile", "bench_compile", "bench", "vma_probe",
           "syncbn_overhead", "buffer_broadcast", "pallas_parity",
-          "flash_parity", "pallas_sweep"]
+          "flash_parity", "flash_overhead", "pallas_sweep"]
 
 
 def stage_done(stage: str) -> bool:
@@ -68,7 +68,8 @@ def stage_done(stage: str) -> bool:
             payload = json.load(f)
     except (OSError, json.JSONDecodeError):
         return False
-    if stage in ("pallas_parity", "flash_parity"):  # battery in-process
+    if stage in ("pallas_parity", "flash_parity", "flash_overhead"):
+        # battery in-process stages
         # "complete" distinguishes all-cases-passed from a mid-stage tunnel
         # death; artifacts predating the flag carry all 5 shape cases
         complete = payload.get("complete", len(payload.get("cases", [])) >= 5)
