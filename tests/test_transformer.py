@@ -171,3 +171,34 @@ def test_flash_attn_impl_rejects_sharded_axis():
     x = jnp.zeros((1, 8, 2, 4), jnp.float32)
     with pytest.raises(ValueError, match="local shard"):
         tfm._attend(x, x, x, "flash", "seq")
+
+
+def test_transformer_lm_rejects_local_impl_off_ulysses():
+    params = make_params()
+    tokens = make_tokens()
+    with pytest.raises(ValueError, match="local_impl"):
+        tfm.transformer_lm(params, tokens, n_heads=HEADS,
+                           local_impl="flash")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("extra", [
+    [],
+    ["--impl", "ulysses", "--local-impl", "flash",
+     "--local-backward", "pallas"],
+])
+def test_longcontext_example_trains(extra):
+    """The long-context training example (reference layer L5 for the SP
+    axis) must run end to end and reduce the loss — it exits nonzero
+    otherwise."""
+    import subprocess, sys, os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "examples", "longcontext_train.py"),
+         "--simulate", "4", "--steps", "12", "--seq-per-device", "16",
+         "--n-heads", "4"] + extra,
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "XLA_FLAGS": ""},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "loss" in proc.stderr + proc.stdout

@@ -75,7 +75,8 @@ def _rms_norm(x, scale):
     return (y * scale).astype(x.dtype)
 
 
-def _attend(q, k, v, impl: Optional[str], axis_name: Optional[str]):
+def _attend(q, k, v, impl: Optional[str], axis_name: Optional[str],
+            local_impl: Optional[str] = None, local_backward: str = "xla"):
     if impl in ("flash", "flash_pallas_bwd"):
         # fused Pallas kernel over the FULL sequence — the dense
         # counterpart of the SP impls; opt-in pending hardware timing
@@ -98,7 +99,10 @@ def _attend(q, k, v, impl: Optional[str], axis_name: Optional[str]):
     if impl == "ring":
         return ring_attention(q, k, v, axis_name, causal=True)
     if impl == "ulysses":
-        return ulysses_attention(q, k, v, axis_name, causal=True)
+        return ulysses_attention(
+            q, k, v, axis_name, causal=True,
+            local_impl=local_impl, local_backward=local_backward,
+        )
     if impl == "ring_zigzag":
         raise ValueError(
             "ring_zigzag is not supported at the LM layer: it requires the "
@@ -120,6 +124,8 @@ def transformer_lm(
     attn_impl: Optional[str] = None,
     axis_name: Optional[str] = None,
     pos_offset: Optional[jax.Array] = None,
+    local_impl: Optional[str] = None,
+    local_backward: str = "xla",
 ) -> jax.Array:
     """Causal LM forward: ``tokens`` (B, L) int32 → logits (B, L, vocab).
 
@@ -130,8 +136,22 @@ def transformer_lm(
     global positions — attention is the only cross-shard op in a
     transformer, so everything else needs no change. ``n_heads`` is
     static (it shapes the reshape), so it rides as a kwarg, not a param
-    leaf.
+    leaf. ``local_impl``/``local_backward`` forward to
+    ``ulysses_attention`` (Ulysses only): ``local_impl="flash"`` runs
+    the local full-sequence attention through the fused Pallas kernel,
+    ``local_backward="pallas"`` also its fused backward.
     """
+    if local_impl is not None or local_backward != "xla":
+        # the sharded-Ulysses path is the only consumer; anything else
+        # (ring, dense, or ulysses WITHOUT an axis — which _attend
+        # degrades to the single-device oracle) would silently drop the
+        # requested kernel — same contract as sharded_self_attention
+        if attn_impl != "ulysses" or axis_name is None:
+            raise ValueError(
+                "local_impl/local_backward apply to attn_impl='ulysses' "
+                f"with an axis_name only, got attn_impl={attn_impl!r}, "
+                f"axis_name={axis_name!r}"
+            )
     b, l = tokens.shape
     max_len = params["pos"].shape[0]
     if pos_offset is None:
@@ -160,7 +180,7 @@ def transformer_lm(
         shp = (b, l, n_heads, dh)
         o = _attend(
             q.reshape(shp), k_.reshape(shp), v.reshape(shp),
-            attn_impl, axis_name,
+            attn_impl, axis_name, local_impl, local_backward,
         )
         x = x + o.reshape(b, l, d_model) @ p["wo"]
         h = _rms_norm(x, p["ln2_scale"])
