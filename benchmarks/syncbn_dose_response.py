@@ -54,6 +54,10 @@ def parse_args():
     p.add_argument("--replicas", type=int, nargs="+", default=[2, 4, 8],
                    help="replica counts to sweep (const_global mode)")
     p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--child-timeout-s", type=float, default=7200,
+                   help="per-dose wall clock; the heaviest dose (largest "
+                        "global batch) trains 3 arms x steps and has "
+                        "blown a 3600s budget under CPU contention")
     p.add_argument("--out", default=None, help="also write the JSON here")
     return p.parse_args()
 
@@ -125,7 +129,8 @@ def main():
             try:
                 proc = subprocess.run(
                     cmd,
-                    cwd=HERE, capture_output=True, text=True, timeout=3600,
+                    cwd=HERE, capture_output=True, text=True,
+                    timeout=args.child_timeout_s,
                 )
                 if proc.returncode != 0:
                     raise RuntimeError(
