@@ -598,12 +598,51 @@ def run_sub(name, cmd):
         )
 
 
+def _stage_runner(stage: str):
+    """The callable for one stage. An explicit table keyed by STAGES so
+    a stage added to the inventory without a runner FAILS LOUDLY instead
+    of silently no-opping and reading as 'passed' (burning a window)."""
+    in_process = {
+        "pallas_parity": stage_pallas_parity,
+        "flash_parity": stage_flash_parity,
+        "flash_overhead": stage_flash_overhead,
+        "entry_compile": stage_entry_compile,
+        "bench_compile": stage_bench_compile,
+        "vma_probe": stage_vma_probe,
+    }
+    subprocess_cmds = {
+        "pallas_sweep": [sys.executable, "benchmarks/pallas_block_sweep.py",
+                         "--iters", "10", "--budget-s", "1400",
+                         "--partial-out",
+                         os.path.join(ART, "tpu_pallas_sweep_partial.json")],
+        "syncbn_overhead": [sys.executable, "benchmarks/syncbn_overhead.py",
+                            "--arch", "resnet50", "--per-chip-batch", "32",
+                            "--image-size", "128"],
+        # --simulate 0 (falsy): target the real backend — the script's
+        # default of 8 would silently measure a CPU mesh
+        "buffer_broadcast": [sys.executable,
+                             "benchmarks/buffer_broadcast_overhead.py",
+                             "--simulate", "0"],
+        "bench": [sys.executable, "bench.py"],
+    }
+    if stage in in_process:
+        return in_process[stage]
+    if stage in subprocess_cmds:
+        return lambda: run_sub(stage, subprocess_cmds[stage])
+    raise KeyError(f"stage {stage!r} has no runner — the STAGES "
+                   "inventory and the runner table are out of sync")
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--stages", nargs="+", default=STAGES, choices=STAGES)
     args = p.parse_args()
 
     sys.path.insert(0, ROOT)
+    # resolve every requested runner BEFORE touching the backend: an
+    # inventory/runner mismatch must fail without spending window time
+    runners = {stage: _stage_runner(stage) for stage in args.stages}
+
     from tpu_syncbn.runtime import probe
 
     info = probe.ensure_backend(1)
@@ -614,35 +653,7 @@ def main():
     failures = []
     for stage in args.stages:
         try:
-            if stage == "pallas_parity":
-                stage_pallas_parity()
-            elif stage == "flash_parity":
-                stage_flash_parity()
-            elif stage == "entry_compile":
-                stage_entry_compile()
-            elif stage == "bench_compile":
-                stage_bench_compile()
-            elif stage == "vma_probe":
-                stage_vma_probe()
-            elif stage == "flash_overhead":
-                stage_flash_overhead()
-            elif stage == "pallas_sweep":
-                run_sub(stage, [sys.executable, "benchmarks/pallas_block_sweep.py",
-                                "--iters", "10", "--budget-s", "1400",
-                                "--partial-out",
-                                os.path.join(ART, "tpu_pallas_sweep_partial.json")])
-            elif stage == "syncbn_overhead":
-                run_sub(stage, [sys.executable, "benchmarks/syncbn_overhead.py",
-                                "--arch", "resnet50", "--per-chip-batch", "32",
-                                "--image-size", "128"])
-            elif stage == "buffer_broadcast":
-                # --simulate 0 (falsy): target the real backend — the
-                # script's default of 8 would silently measure a CPU mesh
-                run_sub(stage, [sys.executable,
-                                "benchmarks/buffer_broadcast_overhead.py",
-                                "--simulate", "0"])
-            elif stage == "bench":
-                run_sub(stage, [sys.executable, "bench.py"])
+            runners[stage]()
         except Exception as e:  # keep stages independent
             log(f"[{stage}] FAILED: {type(e).__name__}: {e}")
             failures.append(stage)

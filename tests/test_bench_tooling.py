@@ -302,3 +302,13 @@ class TestKernelEditInvalidatesParity:
                    {"backend": "tpu", "cases": [{"ok": True}] * 5,
                     "complete": True, "code_version": self._current(stage)})
             assert w.stage_done(stage)
+
+
+def test_every_battery_stage_has_a_runner():
+    """A stage in the inventory without a runner must fail at resolve
+    time (before any window is spent), not silently no-op as 'passed'."""
+    v = _load_validation()
+    for stage in v.STAGES:
+        assert callable(v._stage_runner(stage)), stage
+    with pytest.raises(KeyError, match="no runner"):
+        v._stage_runner("nonexistent_stage")
