@@ -54,6 +54,15 @@ def parse_args():
     p.add_argument("--lr", type=float, default=2e-4)
     p.add_argument("--beta1", type=float, default=0.5)  # DCGAN Adam recipe
     p.add_argument("--dataset-size", type=int, default=256)
+    p.add_argument("--fid-eval-mult", type=int, default=4,
+                   help="generated-sample count for the FID proxy, as a "
+                        "multiple of --dataset-size (z is free to sample; "
+                        "more fakes cuts estimator variance — the real "
+                        "side is bounded by the dataset)")
+    p.add_argument("--fid-shrinkage", default="oas",
+                   help="covariance shrinkage for the FID proxy: 'oas', "
+                        "a float in [0,1], or 'none' for the raw "
+                        "pre-round-5 estimator")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--curves", default=None,
                    help="write full per-step D/G loss curves to this JSON")
@@ -170,25 +179,36 @@ def main():
     feat_d.eval()
     # generate() shards z over the R-device mesh: the eval batch must be
     # divisible by R even when --dataset-size isn't (training only needs
-    # dataset_size >= one global batch)
-    n_eval = max(R, (args.dataset_size // R) * R)
+    # dataset_size >= one global batch). Fakes are oversampled
+    # (--fid-eval-mult) and both covariances shrunk (--fid-shrinkage):
+    # F = 4*width_d feature dims fitted from ~dataset-size reals makes
+    # the raw estimator noise-dominated at small gaps (round-4's SNGAN
+    # b=1 cell read both sharded arms *below* the oracle)
+    _shrink_spec = str(args.fid_shrinkage).lower()
+    shrinkage = (None if _shrink_spec == "none"
+                 else "oas" if _shrink_spec == "oas"
+                 else float(args.fid_shrinkage))
+    n_eval = max(R, (args.fid_eval_mult * args.dataset_size // R) * R)
     z_eval = jnp.asarray(
         np.random.RandomState(args.seed + 9).randn(
             n_eval, args.latent
         ).astype(np.float32)
     )
     real_stats = utils.gaussian_stats(
-        np.asarray(feat_d.features(jnp.asarray(xs)))
+        np.asarray(feat_d.features(jnp.asarray(xs))), shrinkage=shrinkage
     )
 
     def fid_of(trainer) -> float:
         fakes = np.asarray(trainer.generate(z_eval), np.float32)
         fake_stats = utils.gaussian_stats(
-            np.asarray(feat_d.features(jnp.asarray(fakes)))
+            np.asarray(feat_d.features(jnp.asarray(fakes))),
+            shrinkage=shrinkage,
         )
         return round(utils.frechet_distance(*real_stats, *fake_stats), 4)
 
     fid_proxy = {
+        "estimator": {"n_eval": int(n_eval),
+                      "shrinkage": str(args.fid_shrinkage)},
         "oracle": fid_of(oracle_tr),
         "syncbn": fid_of(sync_tr),
         "perreplica": fid_of(local_tr),

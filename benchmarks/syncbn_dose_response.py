@@ -31,14 +31,23 @@ every completed dose.
 """
 
 import argparse
+import atexit
 import json
 import os
+import signal
 import subprocess
 import sys
 
 from _common import log
 
 HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _rm_quiet(path):
+    try:
+        os.remove(path)
+    except OSError:
+        pass
 
 
 def parse_args():
@@ -114,6 +123,12 @@ def main():
     # independently-trained oracles drift by float noise that training
     # chaos amplifies (observed; the shared file removes the variable)
     oracle_path = os.path.join(HERE, f".dose_oracle_{os.getpid()}.json")
+    # a graceful parent-level kill (^C, SIGTERM from a budget overrun)
+    # must not leak the PID-named oracle temp into the tree; SIGTERM is
+    # routed through sys.exit so the atexit hook actually runs (atexit
+    # never fires on a raw signal death, and nothing can cover SIGKILL)
+    atexit.register(lambda: _rm_quiet(oracle_path))
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
     for (r, b) in doses:
         log(f"replicas {r}, per-chip batch {b}...")
         curves_path = os.path.join(HERE, f".dose_curves_{r}_{b}.json")
@@ -169,10 +184,7 @@ def main():
         save()
         log(f"  perreplica MAE {d['perreplica_loss_mae']}, "
             f"ratio {d['divergence_ratio']}")
-    try:
-        os.remove(oracle_path)
-    except OSError:
-        pass
+    _rm_quiet(oracle_path)
     if args.mode == "const_global" and len(result["points"]) > 1:
         # every dose must have scored against the SAME oracle curve
         # (trained once, shared via --oracle-curve) — verified on the

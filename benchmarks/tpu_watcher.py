@@ -84,8 +84,13 @@ def stage_done(stage: str) -> bool:
             current = (tpu_validation._bn_code_version()
                        if stage == "pallas_parity"
                        else tpu_validation._attn_code_version())
-        except Exception:
-            return True  # can't fingerprint: don't wedge the queue
+        except Exception as e:
+            # fail toward re-running: a broken fingerprint helper must
+            # not silently disable the kernel-edit invalidation gate
+            # (the stage itself re-checks and will no-op if truly done)
+            log(f"stage_done({stage!r}): fingerprint check failed ({e!r}); "
+                "treating stage as NOT done")
+            return False
         return payload.get("code_version") == current
     if stage in ("entry_compile", "bench_compile", "vma_probe"):
         # written in-process; complete means the evidence was recorded

@@ -249,12 +249,15 @@ def test_psum_in_groups_mixed_radix_six_of_twelve():
     world, g = 12, 6
     vals = np.arange(float(world)).reshape(world, 1)
 
+    groups = tuple(
+        tuple(range(b, b + g)) for b in range(0, world, g)
+    )
     flat = vals.copy()
     stride = 1
     for f in collectives._prime_factors(g):
         acc = flat.copy()
         for k in range(1, f):
-            perm = collectives._stage_perm(world, g, stride, f, k)
+            perm = collectives._stage_perm(groups, stride, f, k)
             assert sorted(d for _, d in perm) == list(range(world))
             assert sorted(s for s, _ in perm) == list(range(world))
             permuted = np.empty_like(flat)
@@ -279,6 +282,91 @@ def test_prime_factors():
     assert _prime_factors(12) == [2, 2, 3]
     assert _prime_factors(7) == [7]
     assert _prime_factors(360) == [2, 2, 2, 3, 3, 5]
+
+
+def _group_oracle(vals: np.ndarray, groups) -> np.ndarray:
+    """Every rank receives the exact sum over its own group's rows."""
+    out = np.empty_like(vals)
+    for g in groups:
+        out[list(g)] = vals[list(g)].sum(0)
+    return out
+
+
+def test_psum_in_groups_arbitrary_equal_partition():
+    """Non-contiguous equal-size groups — torch's arbitrary process_group
+    rank sets ([torch] nn/modules/batchnorm.py:706) — still ride the
+    ppermute butterfly: gather-free HLO, exact per-group sums."""
+    mesh = runtime.data_parallel_mesh()
+    groups = ((0, 3, 5, 6), (1, 2, 4, 7))
+    vals = jnp.arange(float(8 * 3)).reshape(8, 3)
+    f = jax.jit(
+        shard_map(
+            lambda x: collectives.psum_in_groups(x, "data", groups),
+            mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+        )
+    )
+    out = np.asarray(f(vals))
+    np.testing.assert_allclose(
+        out, _group_oracle(np.asarray(vals), groups), rtol=1e-6
+    )
+    hlo = f.lower(vals).compile().as_text()
+    assert "all-gather" not in hlo, "equal-size groups must not gather"
+
+
+def test_psum_in_groups_unequal_partition_masked_gather():
+    """Unequal group sizes cannot share a butterfly schedule; the masked
+    all-gather fallback still produces exact per-group sums (this is the
+    reference's own traffic order: all_gather of every rank's stats,
+    [torch] nn/modules/_functions.py:74-86)."""
+    mesh = runtime.data_parallel_mesh()
+    groups = ((0, 3), (1, 2, 4, 6, 7), (5,))
+    vals = jnp.arange(float(8 * 2)).reshape(8, 2) * 0.5
+    f = jax.jit(
+        shard_map(
+            lambda x: collectives.psum_in_groups(x, "data", groups),
+            mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+        )
+    )
+    np.testing.assert_allclose(
+        np.asarray(f(vals)), _group_oracle(np.asarray(vals), groups),
+        rtol=1e-6,
+    )
+
+
+def test_psum_in_groups_single_group_partition_is_psum():
+    """The whole-world partition short-circuits to one plain psum."""
+    mesh = runtime.data_parallel_mesh()
+    vals = jnp.arange(8.0).reshape(8, 1)
+    f = jax.jit(
+        shard_map(
+            lambda x: collectives.psum_in_groups(
+                x, "data", ((0, 1, 2, 3, 4, 5, 6, 7),)
+            ),
+            mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+        )
+    )
+    np.testing.assert_allclose(np.asarray(f(vals)), 28.0)
+
+
+def test_psum_in_groups_rejects_bad_partitions():
+    """Missing, duplicated, or empty-rank groups must fail loudly at
+    trace time, not mis-sum silently."""
+    import pytest
+
+    mesh = runtime.data_parallel_mesh()
+    vals = jnp.ones((8, 1))
+    for bad in (
+        ((0, 1), (2, 3)),              # missing ranks 4..7
+        ((0, 1, 2, 3), (3, 4, 5, 6, 7)),  # rank 3 twice
+        ((0, 1, 2, 3, 4, 5, 6, 7), ()),   # empty group
+        "nonsense",
+    ):
+        f = shard_map(
+            lambda x: collectives.psum_in_groups(x, "data", bad),
+            mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+        )
+        with pytest.raises(ValueError):
+            f(vals)
 
 
 def test_psum_in_groups_tree_payload_fused():

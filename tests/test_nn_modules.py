@@ -270,6 +270,44 @@ def test_convert_with_group_size():
     assert m.bn.group_size == 2
 
 
+def test_syncbn_arbitrary_group_partition_golden():
+    """An arbitrary (non-contiguous) 2-group split of 8 replicas must be
+    EXACTLY two independent SyncBNs — torch's process_group accepts any
+    rank set ([torch] nn/modules/batchnorm.py:706), not only contiguous
+    blocks. Golden: each group's output matches big-batch BN over that
+    group's rows, gathered in rank order."""
+    mesh = runtime.data_parallel_mesh()
+    groups = ((0, 3, 5), (1, 2, 4, 6, 7))
+    x = rand_x(37)  # (16, H, W, C): 8 replicas x 2 rows
+    sbn = tnn.SyncBatchNorm(
+        C, group_size=groups, track_running_stats=False
+    )
+    graphdef, state = nnx.split(sbn)
+
+    f = jax.jit(
+        shard_map(
+            lambda st, xs: nnx.merge(graphdef, st, copy=True)(xs),
+            mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"),
+        )
+    )
+    y = np.asarray(f(state, jnp.asarray(x)))
+
+    bn_local = tnn.BatchNorm2d(C, track_running_stats=False)
+    rows_of = lambda ranks: np.concatenate(
+        [x[2 * r:2 * r + 2] for r in ranks]
+    )
+    for ranks in groups:
+        expected = np.asarray(bn_local(jnp.asarray(rows_of(ranks))))
+        got = np.concatenate([y[2 * r:2 * r + 2] for r in ranks])
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_convert_normalizes_partition_to_tuples():
+    m = _Tower()
+    tnn.convert_sync_batchnorm(m, group_size=[[0, 3, 5], [1, 2, 4, 6, 7]])
+    assert m.bn.group_size == ((0, 3, 5), (1, 2, 4, 6, 7))
+
+
 def test_group_size_must_divide_world():
     mesh = runtime.data_parallel_mesh()
     sbn = tnn.SyncBatchNorm(C, group_size=3, track_running_stats=False)

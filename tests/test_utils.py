@@ -221,3 +221,60 @@ class TestFrechetDistance:
             utils.gaussian_stats(np.zeros((1, 4)))
         with pytest.raises(ValueError, match="N>=2"):
             utils.gaussian_stats(np.zeros(4))
+
+    def test_shrinkage_none_is_raw_cov(self):
+        # default must stay bit-compatible with pre-shrinkage artifacts
+        import numpy as np
+        from tpu_syncbn import utils
+
+        rng = np.random.default_rng(3)
+        f = rng.standard_normal((32, 8))
+        _, raw = utils.gaussian_stats(f)
+        _, again = utils.gaussian_stats(f, shrinkage=None)
+        np.testing.assert_array_equal(raw, again)
+        np.testing.assert_array_equal(raw, np.cov(f, rowvar=False))
+
+    def test_shrinkage_moves_toward_scaled_identity(self):
+        import numpy as np
+        from tpu_syncbn import utils
+
+        rng = np.random.default_rng(4)
+        f = rng.standard_normal((16, 8)) @ np.diag(np.arange(1.0, 9.0))
+        _, raw = utils.gaussian_stats(f)
+        _, half = utils.gaussian_stats(f, shrinkage=0.5)
+        _, full = utils.gaussian_stats(f, shrinkage=1.0)
+        target = np.trace(raw) / 8 * np.eye(8)
+        np.testing.assert_allclose(full, target, rtol=1e-12)
+        np.testing.assert_allclose(half, 0.5 * raw + 0.5 * target,
+                                   rtol=1e-12)
+        # trace is preserved by construction at every gamma
+        assert abs(np.trace(half) - np.trace(raw)) < 1e-9
+
+    def test_oas_gamma_adapts_to_sample_count(self):
+        # OAS shrinks hard when N << F-ish and relaxes as N grows; the
+        # estimator must also cut true estimation error in the
+        # rank-deficient regime the GAN A/B lives in
+        import numpy as np
+        from tpu_syncbn import utils
+
+        rng = np.random.default_rng(5)
+        true_cov = np.eye(24)
+        small = rng.standard_normal((12, 24))
+        big = rng.standard_normal((4096, 24))
+        _, raw_small = utils.gaussian_stats(small)
+        _, oas_small = utils.gaussian_stats(small, shrinkage="oas")
+        _, raw_big = utils.gaussian_stats(big)
+        _, oas_big = utils.gaussian_stats(big, shrinkage="oas")
+        err = lambda c: float(((c - true_cov) ** 2).sum())
+        assert err(oas_small) < err(raw_small)
+        # with plentiful samples OAS stays close to the raw estimate
+        assert err(oas_big) < 2 * err(raw_big) + 1e-6
+        np.testing.assert_allclose(oas_big, raw_big, atol=0.1)
+
+    def test_shrinkage_rejects_out_of_range(self):
+        import numpy as np
+        import pytest
+        from tpu_syncbn import utils
+
+        with pytest.raises(ValueError, match="shrinkage"):
+            utils.gaussian_stats(np.zeros((4, 2)), shrinkage=1.5)

@@ -48,7 +48,15 @@ class SyntheticDetectionDataset(Dataset):
     additive pixel noise (task difficulty knob); ``box_frac`` bounds box
     side length as a fraction of the image side (the default 10-30%
     sits below RetinaNet's smallest default anchor at 64x64 — pass
-    e.g. ``(0.4, 0.7)`` for boxes the anchor grid can match at IoU>=0.5)."""
+    e.g. ``(0.4, 0.7)`` for boxes the anchor grid can match at IoU>=0.5).
+
+    Occlusion caveat: overlapping boxes are painted in order, so a later
+    box overwrites an earlier box's class-colored pixels while the
+    occluded ground truth is kept. That is bounded label noise at the
+    default ``max_boxes=2`` but grows with ``max_boxes`` — it caps the
+    AP any detector (or the A/B's val_map instrument) can reach on this
+    data. Painting is deliberately left bit-identical across versions
+    because recorded A/B artifacts key on the exact pixel stream."""
 
     def __init__(
         self,

@@ -85,10 +85,10 @@ def _attend(q, k, v, impl: Optional[str], axis_name: Optional[str],
         # Pallas backward (whole attention fwd+bwd on the MXU path).
         if axis_name is not None:
             raise ValueError(
-                "attn_impl='flash' is the dense single-device kernel; it "
+                f"attn_impl={impl!r} is the dense single-device kernel; it "
                 "would silently attend only the local shard under a "
                 "sequence-sharded axis. Use attn_impl='ring'/'ulysses' "
-                "with axis_name, or flash with axis_name=None."
+                f"with axis_name, or {impl!r} with axis_name=None."
             )
         from tpu_syncbn.ops.pallas_attention import flash_attention
 

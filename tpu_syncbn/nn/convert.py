@@ -77,7 +77,7 @@ def _swap_in_container(value, axis_name: str, group_size=None):
 
 def convert_sync_batchnorm(
     module: nnx.Module, axis_name: str = DATA_AXIS,
-    group_size: int | None = None,
+    group_size: int | tuple | None = None,
 ):
     """Recursively replace BatchNorm modules with SyncBatchNorm.
 
@@ -85,9 +85,16 @@ def convert_sync_batchnorm(
     parameters and buffers are shared by reference; config and mode flags
     preserved. Returns the (possibly new) root; inner modules are rewritten
     in place. ``axis_name`` + ``group_size`` play the role of torch's
-    ``process_group`` argument: the mesh axis the statistics sync over and
-    (optionally) the size of contiguous replica subgroups to sync within.
+    ``process_group`` argument: the mesh axis the statistics sync over
+    and (optionally) which replicas sync together — an int for
+    contiguous subgroups of that size, or an explicit rank partition
+    like ``((0, 3, 5), (1, 2, 4, 6, 7))`` for torch's arbitrary rank
+    sets.
     """
+    if group_size is not None and not isinstance(group_size, int):
+        # same hashable normalization BatchNorm.__init__ applies — the
+        # in-place rewrite path (value.group_size = ...) bypasses init
+        group_size = tuple(tuple(int(r) for r in g) for g in group_size)
     if isinstance(module, BatchNorm):
         return _swap_in_container(module, axis_name, group_size)
     seen = set()

@@ -65,7 +65,7 @@ class BatchNorm(nnx.Module):
         track_running_stats: bool = True,
         channel_axis: int = -1,
         axis_name: str | None = None,
-        group_size: int | None = None,
+        group_size: int | tuple | None = None,
         dtype: jnp.dtype = jnp.float32,
         rngs: nnx.Rngs | None = None,  # unused; accepted for nnx idiom
     ):
@@ -87,6 +87,12 @@ class BatchNorm(nnx.Module):
         self.track_running_stats = track_running_stats
         self.channel_axis = channel_axis
         self.axis_name = axis_name
+        if group_size is not None and not isinstance(group_size, int):
+            # explicit rank partition (torch's arbitrary process_group
+            # sets): normalize to nested tuples so the value is hashable
+            # and stable under jit caching; membership is validated
+            # against the axis size at trace time (psum_in_groups)
+            group_size = tuple(tuple(int(r) for r in g) for g in group_size)
         self.group_size = group_size
         self.use_running_average = False
         if affine:
@@ -194,9 +200,11 @@ class SyncBatchNorm(BatchNorm):
 
     When training inside a mesh context that carries ``self.axis_name``
     (the trainer's shard_map over the ``data`` axis), per-channel moments
-    are reduced across all replicas — or within contiguous subgroups of
-    ``group_size`` replicas, the torch ``process_group`` scoping
-    (``[torch] nn/modules/batchnorm.py:706``) — with one fused psum
+    are reduced across all replicas — or within replica subgroups, the
+    torch ``process_group`` scoping (``[torch] nn/modules/batchnorm.py:706``):
+    ``group_size`` takes an int (contiguous, topology-shaped subgroups)
+    or an explicit partition of ranks like ``((0, 3, 5), (1, 2, 4, 6, 7))``
+    for torch's arbitrary rank sets — with one fused psum
     (see ops.batch_norm.sync_moments). Outside any mesh context — eval
     mode, single-replica debugging, world size 1 — it degrades to plain BN
     exactly like the reference's fallback
@@ -208,7 +216,8 @@ class SyncBatchNorm(BatchNorm):
 
     @classmethod
     def convert_sync_batchnorm(
-        cls, module, axis_name: str = DATA_AXIS, group_size: int | None = None
+        cls, module, axis_name: str = DATA_AXIS,
+        group_size: int | tuple | None = None,
     ):
         """Drop-in spelling parity with
         ``torch.nn.SyncBatchNorm.convert_sync_batchnorm(module,

@@ -172,75 +172,145 @@ def _prime_factors(n: int) -> list:
 
 
 def _stage_perm(
-    world: int, group_size: int, stride: int, f: int, k: int
+    groups: tuple, stride: int, f: int, k: int
 ) -> list:
     """(source, dest) ppermute pairs for shift ``k`` of a radix-``f``
-    mixed-radix butterfly stage at ``stride``, within contiguous groups
-    of ``group_size``: dest ``i`` receives from the group member whose
-    digit at this stride is ``k`` ahead (mod f)."""
+    mixed-radix butterfly stage at ``stride``, within equal-size replica
+    ``groups`` (arbitrary membership): each member receives from the
+    group member whose position digit at this stride is ``k`` ahead
+    (mod f). Contiguous groups are the special case
+    ``groups[i] = range(i*g, (i+1)*g)``."""
     perm = []
-    for i in range(world):
-        base = (i // group_size) * group_size
-        pos = i - base
-        d = (pos // stride) % f
-        src = pos + (((d + k) % f) - d) * stride
-        perm.append((base + src, i))
+    for g in groups:
+        for pos, rank in enumerate(g):
+            d = (pos // stride) % f
+            src_pos = pos + (((d + k) % f) - d) * stride
+            perm.append((g[src_pos], rank))
     return perm
 
 
+def _validate_partition(world: int, groups) -> tuple:
+    """Normalize an explicit rank partition: every rank in [0, world)
+    exactly once, no empty groups. Returns a tuple of rank tuples."""
+    try:
+        norm = tuple(tuple(int(r) for r in g) for g in groups)
+    except TypeError as e:
+        raise ValueError(
+            f"groups must be a sequence of rank sequences, got {groups!r}"
+        ) from e
+    flat = [r for g in norm for r in g]
+    if any(not g for g in norm) or sorted(flat) != list(range(world)):
+        raise ValueError(
+            f"groups {groups!r} must partition ranks 0..{world - 1}: "
+            "every rank exactly once, no empty groups (torch builds its "
+            "process groups under the same constraint — "
+            "[torch] distributed/distributed_c10d.py new_group)"
+        )
+    return norm
+
+
 def psum_in_groups(
-    tree: Pytree, axis_name: str, group_size: int
+    tree: Pytree, axis_name: str, group_size
 ) -> Pytree:
-    """Sum within contiguous subgroups of ``group_size`` replicas along the
-    axis — the TPU form of torch's ``process_group`` scoping (e.g. SyncBN
-    synced within a node rather than the whole world).
+    """Sum within replica subgroups along the axis — the TPU form of
+    torch's ``process_group`` scoping (e.g. SyncBN synced within a node
+    rather than the whole world).
+
+    ``group_size`` is either
+
+    * an ``int`` g: contiguous groups ``[0..g), [g..2g), ...`` (g must
+      divide the axis size) — the common topology-shaped case, or
+    * an explicit partition — a sequence of rank sequences covering
+      every rank exactly once, e.g. ``((0, 3, 5, 6), (1, 2, 4, 7))`` —
+      matching the arbitrary rank sets torch's ``process_group``
+      accepts (``[torch] nn/modules/batchnorm.py:706``).
 
     ``lax.psum(axis_index_groups=...)`` is unimplemented under shard_map's
     VMA checker (jax 0.9: the type system cannot express a group-varying
-    reduce result), so this is a **mixed-radix butterfly** of
-    ``ppermute``s: ``group_size`` is factorized and each prime factor
+    reduce result), so equal-size groups take a **mixed-radix butterfly**
+    of ``ppermute``s: the group size is factorized and each prime factor
     ``f`` contributes one stage of ``f - 1`` shifted exchanges —
     O(payload · Σ(fᵢ − 1)) traffic for ANY group size (log₂ g messages
     when g is a power of two, where radix-2 stages reduce to the classic
     recursive-doubling XOR butterfly), never an O(world) gather. All
-    perms are compile-time constants, VMA-legal CollectivePermute HLOs
-    that XLA schedules over the direct ICI neighbor links the contiguous
-    groups sit on. The whole tree moves as ONE fused payload, keeping
-    the "one collective per BN layer" property.
+    perms are compile-time constants, VMA-legal CollectivePermute HLOs;
+    for contiguous groups XLA schedules them over the direct ICI
+    neighbor links the groups sit on (arbitrary-membership groups keep
+    the same message count but may route across the mesh). The whole
+    tree moves as ONE fused payload, keeping the "one collective per BN
+    layer" property.
+
+    Unequal-size groups cannot share one butterfly schedule (stage
+    counts differ per group), so they fall back to a masked all-gather:
+    one AllGather of the fused payload plus a per-replica constant
+    membership row — O(world · payload) traffic, the same order as the
+    reference's SyncBN stats exchange (``all_gather`` of every rank's
+    stats, ``[torch] nn/modules/_functions.py:74-86``), so the fallback
+    is never worse than the semantics it emulates.
 
     Latency note: a large *prime* factor f contributes f-1 dependent
     exchange rounds (ring-like latency), so e.g. g=13 pays 12 round
     trips where a gather would pay one. Real stat-sync groups are
     topology-shaped (2/4/8 replicas per host, occasionally 3/6), where
     Σ(fᵢ−1) ≤ 4 — the design targets those; for exotic large-prime
-    groups prefer ``group_size=None`` (full-world psum) or a custom
-    path.
+    groups prefer ``group_size=None`` (full-world psum) or an explicit
+    unequal partition (which takes the gather path).
     """
     world = lax.axis_size(axis_name)
-    if group_size < 1 or world % group_size:
-        raise ValueError(
-            f"group_size {group_size} must divide axis size {world}"
+    if isinstance(group_size, (bool,)):
+        raise ValueError(f"group_size must be an int or a partition, "
+                         f"got {group_size!r}")
+    if isinstance(group_size, int):
+        if group_size < 1 or world % group_size:
+            raise ValueError(
+                f"group_size {group_size} must divide axis size {world}"
+            )
+        if group_size == world:
+            return lax.psum(tree, axis_name)
+        groups = tuple(
+            tuple(range(i, i + group_size))
+            for i in range(0, world, group_size)
         )
-    if group_size == world:
-        return lax.psum(tree, axis_name)
+    else:
+        groups = _validate_partition(world, group_size)
+        if len(groups) == 1:
+            return lax.psum(tree, axis_name)
 
     # one fused payload for the whole tree
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     flat = jnp.concatenate([jnp.ravel(l).astype(jnp.float32) for l in leaves])
 
-    stride = 1
-    for f in _prime_factors(group_size):
-        # radix-f stage: each member sums the f values whose mixed-radix
-        # digit at this stride differs — after the stage, every member
-        # holds the sum over its digit group; after all stages, the full
-        # contiguous-group sum
-        acc = flat
-        for k in range(1, f):
-            perm = _stage_perm(world, group_size, stride, f, k)
-            acc = acc + lax.ppermute(flat, axis_name, perm)
-        flat = acc
-        stride *= f
-    summed = flat
+    sizes = {len(g) for g in groups}
+    if len(sizes) == 1:
+        stride = 1
+        for f in _prime_factors(sizes.pop()):
+            # radix-f stage: each member sums the f values whose
+            # mixed-radix position digit at this stride differs — after
+            # the stage, every member holds the sum over its digit
+            # group; after all stages, the full group sum
+            acc = flat
+            for k in range(1, f):
+                perm = _stage_perm(groups, stride, f, k)
+                acc = acc + lax.ppermute(flat, axis_name, perm)
+            flat = acc
+            stride *= f
+        summed = flat
+    else:
+        # masked gather: every replica sees every row, sums its group's
+        gathered = lax.all_gather(flat, axis_name)  # (world, payload)
+        member = [[0.0] * world for _ in range(world)]
+        for g in groups:
+            for i in g:
+                for j in g:
+                    member[i][j] = 1.0
+        row = jnp.take(
+            jnp.asarray(member, jnp.float32),
+            lax.axis_index(axis_name), axis=0,
+        )
+        # elementwise mask + sum, NOT a matmul: jnp.matmul at default
+        # precision runs bf16 multiply passes on TPU, which would break
+        # the f32 accumulation the payload was cast to float32 for
+        summed = (row[:, None] * gathered).sum(0)
 
     out = []
     offset = 0
@@ -309,7 +379,7 @@ def reduce_moments(
     local_count: jax.Array,
     axis_name: str = DATA_AXIS,
     *,
-    group_size: int | None = None,
+    group_size: int | tuple | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Count-weighted global moments from per-replica partial sums.
 

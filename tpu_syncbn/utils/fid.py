@@ -20,14 +20,46 @@ from __future__ import annotations
 import numpy as np
 
 
-def gaussian_stats(features: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-    """(N, F) features -> (mean (F,), covariance (F, F)). N >= 2."""
+def gaussian_stats(
+    features: np.ndarray, shrinkage: float | str | None = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """(N, F) features -> (mean (F,), covariance (F, F)). N >= 2.
+
+    ``shrinkage`` regularizes the sample covariance toward the scaled
+    identity ``(tr(S)/F) I`` — essential when N is comparable to F (the
+    A/B benchmarks fit F = 4*width features from ~dataset-size samples,
+    where the raw estimator's noise can dominate small Fréchet gaps):
+
+    * ``None`` (default): raw ``np.cov`` — bit-compatible with artifacts
+      recorded before shrinkage existed.
+    * a float in [0, 1]: fixed mixing weight gamma.
+    * ``"oas"``: the Oracle Approximating Shrinkage weight (Chen,
+      Wiesel & Hero, 2010 — closed form, public method), which adapts
+      gamma to N/F automatically.
+    """
     feats = np.asarray(features, np.float64)
     if feats.ndim != 2 or feats.shape[0] < 2:
         raise ValueError(
             f"need (N>=2, F) features, got shape {feats.shape}"
         )
-    return feats.mean(0), np.cov(feats, rowvar=False)
+    mu = feats.mean(0)
+    cov = np.cov(feats, rowvar=False)
+    cov = np.atleast_2d(cov)
+    if shrinkage is None:
+        return mu, cov
+    n, f = feats.shape
+    mu_tr = np.trace(cov) / f
+    if shrinkage == "oas":
+        tr_s2 = float((cov * cov).sum())  # tr(S @ S) for symmetric S
+        tr_s_sq = float(np.trace(cov)) ** 2
+        num = (1.0 - 2.0 / f) * tr_s2 + tr_s_sq
+        den = (n + 1.0 - 2.0 / f) * (tr_s2 - tr_s_sq / f)
+        gamma = 1.0 if den <= 0 else min(1.0, num / den)
+    else:
+        gamma = float(shrinkage)
+        if not 0.0 <= gamma <= 1.0:
+            raise ValueError(f"shrinkage must be in [0, 1], got {gamma}")
+    return mu, (1.0 - gamma) * cov + gamma * mu_tr * np.eye(f)
 
 
 def _sqrtm_psd(a: np.ndarray) -> np.ndarray:
