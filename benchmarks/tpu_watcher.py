@@ -173,6 +173,16 @@ def main():
                 break
             stage = ordered[0]
             window_live = run_stage(stage, stage_budget)
+            if window_live and not stage_done(stage):
+                # ran clean but still reads incomplete (e.g. the
+                # fingerprint helper is broken and stage_done fails
+                # toward re-running): demote so it cannot livelock the
+                # window re-running back-to-back while later stages
+                # starve — it retries after the others get their shot
+                log(f"stage {stage!r} exited 0 but is still not done; "
+                    "demoting to the back of the line")
+                if stage not in demoted:
+                    demoted.append(stage)
             if not window_live:
                 if stage not in demoted:
                     demoted.append(stage)

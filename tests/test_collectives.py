@@ -348,6 +348,42 @@ def test_psum_in_groups_single_group_partition_is_psum():
     np.testing.assert_allclose(np.asarray(f(vals)), 28.0)
 
 
+def test_normalize_group_spec_canonical_forms():
+    """ONE normalization shared by SyncBatchNorm/convert/psum_in_groups:
+    int-likes (incl. numpy scalars) stay ints, partitions become nested
+    tuples of exact ints, non-integral ranks are an error (silent
+    truncation would mis-sum), bool is rejected."""
+    import pytest
+
+    f = collectives.normalize_group_spec
+    assert f(None) is None
+    assert f(4) == 4 and isinstance(f(4), int)
+    assert f(np.int64(4)) == 4 and isinstance(f(np.int64(4)), int)
+    assert f([[0, 1], (2, np.int64(3))]) == ((0, 1), (2, 3))
+    with pytest.raises(ValueError, match="exact integers"):
+        f([[0, 1.9], [2, 3]])
+    with pytest.raises(ValueError, match="exact integers"):
+        f("nonsense")
+    with pytest.raises(ValueError, match="int or a rank"):
+        f(True)
+
+
+def test_psum_in_groups_numpy_int_group_size():
+    """np.integer group sizes route the int (contiguous butterfly) path,
+    not the partition path — world//2 arithmetic often yields them."""
+    mesh = runtime.data_parallel_mesh()
+    vals = jnp.arange(8.0).reshape(8, 1)
+    f = jax.jit(
+        shard_map(
+            lambda x: collectives.psum_in_groups(x, "data", np.int64(4)),
+            mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+        )
+    )
+    out = np.asarray(f(vals))
+    np.testing.assert_allclose(out[:4], 6.0)
+    np.testing.assert_allclose(out[4:], 22.0)
+
+
 def test_psum_in_groups_rejects_bad_partitions():
     """Missing, duplicated, or empty-rank groups must fail loudly at
     trace time, not mis-sum silently."""

@@ -22,6 +22,7 @@ from __future__ import annotations
 from flax import nnx
 
 from tpu_syncbn.nn.normalization import BatchNorm, SyncBatchNorm
+from tpu_syncbn.parallel.collectives import normalize_group_spec
 from tpu_syncbn.runtime.distributed import DATA_AXIS
 
 
@@ -91,10 +92,9 @@ def convert_sync_batchnorm(
     like ``((0, 3, 5), (1, 2, 4, 6, 7))`` for torch's arbitrary rank
     sets.
     """
-    if group_size is not None and not isinstance(group_size, int):
-        # same hashable normalization BatchNorm.__init__ applies — the
-        # in-place rewrite path (value.group_size = ...) bypasses init
-        group_size = tuple(tuple(int(r) for r in g) for g in group_size)
+    # same canonical form BatchNorm.__init__ applies — the in-place
+    # rewrite path (value.group_size = ...) bypasses init
+    group_size = normalize_group_spec(group_size)
     if isinstance(module, BatchNorm):
         return _swap_in_container(module, axis_name, group_size)
     seen = set()

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from flax import nnx
 
 from tpu_syncbn.ops import batch_norm as bn_ops
+from tpu_syncbn.parallel.collectives import normalize_group_spec
 from tpu_syncbn.runtime.distributed import DATA_AXIS
 
 
@@ -87,13 +88,11 @@ class BatchNorm(nnx.Module):
         self.track_running_stats = track_running_stats
         self.channel_axis = channel_axis
         self.axis_name = axis_name
-        if group_size is not None and not isinstance(group_size, int):
-            # explicit rank partition (torch's arbitrary process_group
-            # sets): normalize to nested tuples so the value is hashable
-            # and stable under jit caching; membership is validated
-            # against the axis size at trace time (psum_in_groups)
-            group_size = tuple(tuple(int(r) for r in g) for g in group_size)
-        self.group_size = group_size
+        # int stays int (contiguous groups); an explicit rank partition
+        # (torch's arbitrary process_group sets) becomes hashable nested
+        # tuples, stable under jit caching; membership is validated
+        # against the axis size at trace time (psum_in_groups)
+        self.group_size = normalize_group_spec(group_size)
         self.use_running_average = False
         if affine:
             # torch init: weight=1, bias=0 ([torch] nn/modules/batchnorm.py reset_parameters)

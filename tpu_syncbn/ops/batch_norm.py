@@ -204,7 +204,7 @@ def sync_moments(
     *,
     channel_axis: int = -1,
     axis_name: str | None = None,
-    group_size: int | None = None,
+    group_size: int | tuple | None = None,
     mask: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Per-channel (mean, biased var, count) over the batch — cross-replica
@@ -319,12 +319,14 @@ def batch_norm_train(
     eps: float = 1e-5,
     channel_axis: int = -1,
     axis_name: str | None = None,
-    group_size: int | None = None,
+    group_size: int | tuple | None = None,
     mask: jax.Array | None = None,
 ):
     """Full training-mode BN forward (optionally cross-replica synced).
-    ``group_size`` scopes the sync to contiguous replica subgroups (the
-    torch ``process_group`` capability).
+    ``group_size`` scopes the sync to replica subgroups — an int for
+    contiguous groups of that size, or an explicit rank partition for
+    torch's arbitrary ``process_group`` rank sets (both routed through
+    ``parallel.collectives.psum_in_groups``).
 
     Returns ``(y, (new_running_mean, new_running_var, new_num_batches_tracked))``;
     the stats triple is ``(None, None, None)`` when running stats aren't

@@ -17,6 +17,7 @@ from tpu_syncbn.parallel.collectives import (
     reduce_scatter,
     reduce_moments,
     psum_in_groups,
+    normalize_group_spec,
     ring_all_reduce,
 )
 from tpu_syncbn.parallel.sequence import (
@@ -61,6 +62,7 @@ __all__ = [
     "reduce_scatter",
     "reduce_moments",
     "psum_in_groups",
+    "normalize_group_spec",
     "ring_all_reduce",
     "ring_attention",
     "ring_attention_zigzag",

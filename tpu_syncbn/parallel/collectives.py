@@ -27,6 +27,7 @@ correct for uneven/empty shards.
 
 from __future__ import annotations
 
+import operator
 from typing import Any
 
 import jax
@@ -189,24 +190,46 @@ def _stage_perm(
     return perm
 
 
-def _validate_partition(world: int, groups) -> tuple:
-    """Normalize an explicit rank partition: every rank in [0, world)
-    exactly once, no empty groups. Returns a tuple of rank tuples."""
+def normalize_group_spec(group_size):
+    """Canonicalize a ``group_size`` value: an int-like scalar stays an
+    int (contiguous groups of that size); anything else must be a rank
+    partition and becomes hashable nested tuples of exact ints
+    (``operator.index`` — a non-integral rank like 1.9 is an error, not
+    a silent truncation). ONE normalization shared by ``SyncBatchNorm``,
+    ``convert_sync_batchnorm`` and ``psum_in_groups`` so the value
+    hashes/compares identically across jit cache keys. ``None`` passes
+    through (full-world sync)."""
+    if group_size is None:
+        return None
+    if isinstance(group_size, bool):
+        raise ValueError(f"group_size must be an int or a rank "
+                         f"partition, got {group_size!r}")
     try:
-        norm = tuple(tuple(int(r) for r in g) for g in groups)
-    except TypeError as e:
+        return operator.index(group_size)  # int, np.integer, ...
+    except TypeError:
+        pass
+    try:
+        return tuple(tuple(operator.index(r) for r in g)
+                     for g in group_size)
+    except (TypeError, ValueError) as e:
         raise ValueError(
-            f"groups must be a sequence of rank sequences, got {groups!r}"
+            "group_size must be an int or a sequence of rank "
+            f"sequences of exact integers, got {group_size!r}"
         ) from e
-    flat = [r for g in norm for r in g]
-    if any(not g for g in norm) or sorted(flat) != list(range(world)):
+
+
+def _validate_partition(world: int, groups: tuple) -> tuple:
+    """Check a normalized rank partition: every rank in [0, world)
+    exactly once, no empty groups. Returns it unchanged."""
+    flat = [r for g in groups for r in g]
+    if any(not g for g in groups) or sorted(flat) != list(range(world)):
         raise ValueError(
             f"groups {groups!r} must partition ranks 0..{world - 1}: "
             "every rank exactly once, no empty groups (torch builds its "
             "process groups under the same constraint — "
             "[torch] distributed/distributed_c10d.py new_group)"
         )
-    return norm
+    return groups
 
 
 def psum_in_groups(
@@ -257,9 +280,7 @@ def psum_in_groups(
     unequal partition (which takes the gather path).
     """
     world = lax.axis_size(axis_name)
-    if isinstance(group_size, (bool,)):
-        raise ValueError(f"group_size must be an int or a partition, "
-                         f"got {group_size!r}")
+    group_size = normalize_group_spec(group_size)
     if isinstance(group_size, int):
         if group_size < 1 or world % group_size:
             raise ValueError(
