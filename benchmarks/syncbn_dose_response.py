@@ -124,14 +124,17 @@ def main():
     # chaos amplifies (observed; the shared file removes the variable)
     oracle_path = os.path.join(HERE, f".dose_oracle_{os.getpid()}.json")
     # a graceful parent-level kill (^C, SIGTERM from a budget overrun)
-    # must not leak the PID-named oracle temp into the tree; SIGTERM is
+    # must not leak temp files into the tree — neither the PID-named
+    # oracle curve nor the in-flight per-dose curves file; SIGTERM is
     # routed through sys.exit so the atexit hook actually runs (atexit
     # never fires on a raw signal death, and nothing can cover SIGKILL)
-    atexit.register(lambda: _rm_quiet(oracle_path))
+    temp_paths = [oracle_path]
+    atexit.register(lambda: [_rm_quiet(p) for p in temp_paths])
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
     for (r, b) in doses:
         log(f"replicas {r}, per-chip batch {b}...")
         curves_path = os.path.join(HERE, f".dose_curves_{r}_{b}.json")
+        temp_paths.append(curves_path)
         cmd = [sys.executable,
                os.path.join(HERE, "syncbn_convergence_ab.py"),
                "--simulate", str(r),

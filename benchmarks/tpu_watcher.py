@@ -174,15 +174,17 @@ def main():
             stage = ordered[0]
             window_live = run_stage(stage, stage_budget)
             if window_live and not stage_done(stage):
-                # ran clean but still reads incomplete (e.g. the
+                # ran clean but still reads incomplete (e.g. the child
+                # fell back to CPU and wrote a non-tpu artifact, or the
                 # fingerprint helper is broken and stage_done fails
                 # toward re-running): demote so it cannot livelock the
-                # window re-running back-to-back while later stages
-                # starve — it retries after the others get their shot
+                # window re-running back-to-back, AND drop window_live
+                # so the next iteration re-probes and the all-demoted
+                # sleep can engage — rc=0 with no usable evidence is
+                # not proof the tunnel is still alive
                 log(f"stage {stage!r} exited 0 but is still not done; "
-                    "demoting to the back of the line")
-                if stage not in demoted:
-                    demoted.append(stage)
+                    "demoting and re-probing")
+                window_live = False
             if not window_live:
                 if stage not in demoted:
                     demoted.append(stage)

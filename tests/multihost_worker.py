@@ -75,6 +75,40 @@ got_local = np.concatenate(
 np.testing.assert_allclose(got_local, ref_local, rtol=1e-4, atol=1e-5)
 print(f"[{pid}] syncbn-golden ok", flush=True)
 
+# --- grouped SyncBN, arbitrary rank partition crossing processes ---------
+# both groups straddle the process boundary (devices 0,1 live in proc 0
+# and 2,3 in proc 1), so the generalized butterfly's ppermutes cross a
+# REAL process boundary — torch's arbitrary process_group rank sets
+# ([torch] nn/modules/batchnorm.py:706) over the multi-host transport
+groups = ((0, 3), (1, 2))
+
+
+def bn_group_step(xs):
+    y, _ = ops.batch_norm_train(xs, None, None, None, None, None,
+                                axis_name="data", group_size=groups)
+    return y
+
+
+y_grp = jax.jit(
+    shard_map(bn_group_step, mesh=mesh,
+              in_specs=(P("data"),), out_specs=P("data"))
+)(gx)
+rows = x_global.reshape(world_dev, -1, 3, 3, C)
+ref_rows = np.empty_like(rows)
+for g in groups:
+    sel = np.concatenate([rows[r] for r in g])
+    yg, _ = ops.batch_norm_train(
+        jnp.asarray(sel), None, None, None, None, None
+    )
+    for i, r in enumerate(g):
+        ref_rows[r] = np.asarray(yg).reshape(len(g), -1, 3, 3, C)[i]
+ref_local = ref_rows.reshape(runtime.process_count(), -1, 3, 3, C)[pid]
+got_local = np.concatenate(
+    [np.asarray(s.data) for s in y_grp.addressable_shards]
+)
+np.testing.assert_allclose(got_local, ref_local, rtol=1e-4, atol=1e-5)
+print(f"[{pid}] grouped-syncbn ok", flush=True)
+
 # --- ring attention across processes -------------------------------------
 # the ppermute KV ring crossing a real process boundary (the CPU stand-in
 # for ICI hops between hosts), contiguous and zigzag layouts

@@ -50,6 +50,7 @@ def test_two_process_world():
         assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
         assert f"[{pid}] psum ok" in out
         assert f"[{pid}] syncbn-golden ok" in out
+        assert f"[{pid}] grouped-syncbn ok" in out
         assert f"[{pid}] ring-attention ok" in out
         assert f"[{pid}] zigzag-attention ok" in out
         assert f"[{pid}] done" in out
