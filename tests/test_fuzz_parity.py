@@ -118,3 +118,52 @@ def test_sampler_fuzz_native_vs_python(trial):
     with mock.patch.object(native, "available", return_value=False):
         expected = list(sampler)
     np.testing.assert_array_equal(np.asarray(nat), expected)
+
+
+@pytest.mark.parametrize("trial", range(10))
+def test_psum_in_groups_fuzz_random_partitions(trial):
+    """Random partitions of 8 ranks (equal-size shuffled groups on even
+    trials -> butterfly; unequal random splits on odd trials -> masked
+    gather): every replica must receive its own group's exact sum, for
+    any membership — the full torch process_group space."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_syncbn import runtime
+    from tpu_syncbn.parallel import collectives
+
+    rng = np.random.RandomState(300 + trial)
+    world = 8
+    perm = rng.permutation(world)
+    if trial % 2 == 0:
+        # g alternates 2/4 deterministically: both non-trivial butterfly
+        # radix structures get shuffled-membership coverage every run
+        # (g=1 and g=world short-circuit and are covered elsewhere)
+        g = 2 if trial % 4 == 0 else 4
+        groups = tuple(
+            tuple(int(r) for r in perm[i:i + g])
+            for i in range(0, world, g)
+        )
+    else:
+        cuts = sorted(rng.choice(range(1, world), size=rng.randint(1, 4),
+                                 replace=False))
+        bounds = [0] + list(cuts) + [world]
+        groups = tuple(
+            tuple(int(r) for r in perm[a:b])
+            for a, b in zip(bounds[:-1], bounds[1:])
+        )
+    vals = rng.randn(world, 3).astype(np.float32) * 10
+
+    mesh = runtime.data_parallel_mesh()
+    f = jax.jit(
+        shard_map(
+            lambda x: collectives.psum_in_groups(x, "data", groups),
+            mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+        )
+    )
+    got = np.asarray(f(jnp.asarray(vals)))
+    expect = np.empty_like(vals)
+    for grp in groups:
+        expect[list(grp)] = vals[list(grp)].sum(0)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
