@@ -48,9 +48,6 @@ def main():
 
     # -- 1. DP + SyncBN (the reference's strategy) ------------------------
     mesh = Mesh(np.array(devices), ("data",))
-    model = nn.convert_sync_batchnorm(
-        models.resnet18(num_classes=10, small_input=True, rngs=nnx.Rngs(0))
-    )
 
     def loss_fn(m, batch):
         x, y = batch
@@ -58,14 +55,53 @@ def main():
 
     x = jnp.asarray(rng.standard_normal((2 * n, 8, 8, 3)).astype(np.float32))
     y = jnp.asarray(rng.integers(0, 10, (2 * n,)).astype(np.int32))
-    dp = parallel.DataParallel(model, optax.sgd(0.1, momentum=0.9), loss_fn, mesh=mesh)
-    out = dp.train_step((x, y))
+
+    def dp_step_loss(group_size=None):
+        # identical init/data per call: only the BN sync scope varies
+        m = nn.convert_sync_batchnorm(
+            models.resnet18(num_classes=10, small_input=True,
+                            rngs=nnx.Rngs(0)),
+            group_size=group_size,
+        )
+        d = parallel.DataParallel(
+            m, optax.sgd(0.1, momentum=0.9), loss_fn, mesh=mesh
+        )
+        return d.train_step((x, y)).loss
+
+    out_loss = dp_step_loss()
     # the ZeRO check below compares against this run, so a shared defect
     # would pass both; at minimum the loss must be finite
-    if not bool(jnp.isfinite(out.loss)):
-        runtime.master_print(f"  [FAIL] DP + SyncBN loss = {float(out.loss)}")
+    if not bool(jnp.isfinite(out_loss)):
+        runtime.master_print(f"  [FAIL] DP + SyncBN loss = {float(out_loss)}")
         raise SystemExit(1)
-    runtime.master_print(f"  [PASS] {'DP + SyncBN':34s} loss = {float(out.loss):.4f}")
+    runtime.master_print(f"  [PASS] {'DP + SyncBN':34s} loss = {float(out_loss):.4f}")
+
+    # -- 1b. group-scoped SyncBN (torch process_group) --------------------
+    if n >= 2:
+        # oracle: the single-group partition routes the partition code
+        # path but must reproduce full-world sync bit-for-bit
+        check("full-partition SyncBN ≡ full sync",
+              dp_step_loss(group_size=(tuple(range(n)),)), out_loss,
+              atol=0.0)
+        # arbitrary rank partition: interleaved halves sync separately
+        # (torch's process_group over arbitrary rank sets). Scoping must
+        # actually change the statistics — equal losses would mean the
+        # partition was silently ignored
+        loss_g = dp_step_loss(
+            group_size=(tuple(range(0, n, 2)), tuple(range(1, n, 2)))
+        )
+        distinct = bool(jnp.isfinite(loss_g)) and float(loss_g) != float(out_loss)
+        tag = "PASS" if distinct else "FAIL"
+        runtime.master_print(
+            f"  [{tag}] {'grouped SyncBN (rank partition)':34s} "
+            f"loss = {float(loss_g):.4f} (≠ full-sync {float(out_loss):.4f})"
+        )
+        if not distinct:
+            raise SystemExit(1)
+    else:
+        runtime.master_print(
+            "  [SKIP] grouped SyncBN (needs >= 2 devices)"
+        )
 
     # -- 2. ZeRO: sharded params + optimizer ------------------------------
     model_z = nn.convert_sync_batchnorm(
@@ -75,7 +111,7 @@ def main():
         model_z, optax.sgd(0.1, momentum=0.9), loss_fn, mesh=mesh, zero=True
     )
     outz = dpz.train_step((x, y))
-    check("ZeRO step ≡ replicated step", outz.loss, out.loss, atol=1e-5)
+    check("ZeRO step ≡ replicated step", outz.loss, out_loss, atol=1e-5)
 
     # -- 3. sequence parallelism: ring + Ulysses attention ----------------
     # every dimension scales with the device count (Ulysses needs heads
