@@ -84,6 +84,13 @@ def stage_done(stage: str) -> bool:
             current = (tpu_validation._bn_code_version()
                        if stage == "pallas_parity"
                        else tpu_validation._attn_code_version())
+            # flash_parity 'ok's also certify harness pass criteria
+            # (atols, precision pin) the kernel fingerprint can't see
+            criteria_ok = (
+                payload.get("criteria")
+                == tpu_validation.FLASH_PARITY_CRITERIA
+                if stage == "flash_parity" else True
+            )
         except Exception as e:
             # fail toward re-running: a broken fingerprint helper must
             # not silently disable the kernel-edit invalidation gate
@@ -91,7 +98,7 @@ def stage_done(stage: str) -> bool:
             log(f"stage_done({stage!r}): fingerprint check failed ({e!r}); "
                 "treating stage as NOT done")
             return False
-        return payload.get("code_version") == current
+        return payload.get("code_version") == current and criteria_ok
     if stage in ("entry_compile", "bench_compile", "vma_probe"):
         # written in-process; complete means the evidence was recorded
         return bool(payload.get("complete")) and payload.get("backend") == "tpu"

@@ -108,7 +108,11 @@ def _attend_tile(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finalize():
         l = jnp.maximum(l_ref[...], 1e-30)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[...] + jnp.log(l))[:, 0]
+        # lse rides a (BH, T, 1) array: a 2-D (BH, T) output would put
+        # the BH axis in the block's last-two-dims window, where the TPU
+        # lowering rejects a block size of 1 (must divide 8 / equal the
+        # array dim — observed live in tpu_vma_probe.json round 5)
+        lse_ref[0] = m_ref[...] + jnp.log(l)
 
 
 def _attn_kernel_rect(q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -151,7 +155,7 @@ def _attn_kernel_rect(q_ref, k_ref, v_ref, o_ref, lse_ref,
         # keep the rect path self-sufficient if block ratios change
         l = jnp.maximum(l_ref[...], 1e-30)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[...] + jnp.log(l))[:, 0]
+        lse_ref[0] = m_ref[...] + jnp.log(l)
 
 
 def _attn_kernel_causal(qids_ref, kids_ref, q_ref, k_ref, v_ref,
@@ -229,7 +233,9 @@ def _flash_fwd_2d(q, k, v, *, causal, scale, block_q, block_k):
     vmem = pltpu.VMEM
     out_shape = [
         _sds((bh, n_q * block_q, d), q.dtype, qp),
-        _sds((bh, n_q * block_q), jnp.float32, qp),
+        # trailing singleton keeps BH out of the block's last-two-dims
+        # window (TPU tiling rule); squeezed before returning
+        _sds((bh, n_q * block_q, 1), jnp.float32, qp),
     ]
     scratch_shapes = [
         pltpu.VMEM((block_q, d), jnp.float32),   # acc
@@ -266,8 +272,8 @@ def _flash_fwd_2d(q, k, v, *, causal, scale, block_q, block_k):
                 pl.BlockSpec((1, block_q, d),
                              lambda b, t, qids, kids: (b, qids[t], 0),
                              memory_space=vmem),
-                pl.BlockSpec((1, block_q),
-                             lambda b, t, qids, kids: (b, qids[t]),
+                pl.BlockSpec((1, block_q, 1),
+                             lambda b, t, qids, kids: (b, qids[t], 0),
                              memory_space=vmem),
             ],
             scratch_shapes=scratch_shapes,
@@ -276,7 +282,7 @@ def _flash_fwd_2d(q, k, v, *, causal, scale, block_q, block_k):
             kernel, grid_spec=grid_spec, out_shape=out_shape,
             interpret=_interpret(),
         )(jnp.asarray(qids), jnp.asarray(kids), qp, kp, vp)
-        return o[:, :l_real], lse[:, :l_real]
+        return o[:, :l_real], lse[:, :l_real, 0]
 
     kernel = functools.partial(
         _attn_kernel_rect, scale=scale, causal=causal,
@@ -296,14 +302,14 @@ def _flash_fwd_2d(q, k, v, *, causal, scale, block_q, block_k):
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=vmem),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0),
                          memory_space=vmem),
         ],
         out_shape=out_shape,
         scratch_shapes=scratch_shapes,
         interpret=_interpret(),
     )(qp, kp, vp)
-    return o[:, :l_real], lse[:, :l_real]
+    return o[:, :l_real], lse[:, :l_real, 0]
 
 
 # -- backward (XLA, blockwise scan — O(L·block_k) live memory) ------------
@@ -392,12 +398,14 @@ def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     mask = (rows < l_real) & (cols < l_real)
     if causal:
         mask = mask & (rows >= cols)
-    p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+    # lse/delta ride (BH, T, 1) arrays (see _flash_fwd_2d's out_shape
+    # note), so ref[0] is already the (block_q, 1) broadcast shape
+    p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)
     dp = lax.dot_general(
         dof, vf, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    ds = p * (dp - delta_ref[0][:, None])
+    ds = p * (dp - delta_ref[0])
     return p, ds, qf, dof
 
 
@@ -574,8 +582,10 @@ def _flash_bwd_2d_pallas(res, do, *, causal, scale, block_q, block_k):
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     )
-    lsep = jnp.pad(lse, ((0, 0), (0, pad_q))) if pad_q else lse
-    deltap = jnp.pad(delta, ((0, 0), (0, pad_q))) if pad_q else delta
+    # (BH, T, 1): keep BH out of the block's last-two-dims window (the
+    # TPU lowering rejects a 2-D (1, block_q) row block — see forward)
+    lsep = padq(lse[..., None])
+    deltap = padq(delta[..., None])
 
     vmem = pltpu.VMEM
     operands = (qp, kp, vp, dop, lsep, deltap)
@@ -605,16 +615,13 @@ def _flash_bwd_2d_pallas(res, do, *, causal, scale, block_q, block_k):
         def kv3(b, t, *refs):
             return (b, refs[1 - q_slot][t], 0)
 
-        def q2(b, t, *refs):
-            return (b, refs[q_slot][t])
-
         in_specs = [
             pl.BlockSpec((1, block_q, d), q3, memory_space=vmem),   # q
             pl.BlockSpec((1, block_k, d), kv3, memory_space=vmem),  # k
             pl.BlockSpec((1, block_k, d), kv3, memory_space=vmem),  # v
             pl.BlockSpec((1, block_q, d), q3, memory_space=vmem),   # do
-            pl.BlockSpec((1, block_q), q2, memory_space=vmem),      # lse
-            pl.BlockSpec((1, block_q), q2, memory_space=vmem),      # delta
+            pl.BlockSpec((1, block_q, 1), q3, memory_space=vmem),   # lse
+            pl.BlockSpec((1, block_q, 1), q3, memory_space=vmem),   # delta
         ]
         return in_specs, q3, kv3
 
@@ -643,7 +650,7 @@ def _flash_bwd_2d_pallas(res, do, *, causal, scale, block_q, block_k):
                                  memory_space=vmem)
         kv_spec_kv = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
                                   memory_space=vmem)
-        row_spec_kv = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i),
+        row_spec_kv = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0),
                                    memory_space=vmem)
         dk, dv = pl.pallas_call(
             functools.partial(
@@ -687,7 +694,7 @@ def _flash_bwd_2d_pallas(res, do, *, causal, scale, block_q, block_k):
                                 memory_space=vmem)
         kv_spec_q = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
                                  memory_space=vmem)
-        row_spec_q = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+        row_spec_q = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0),
                                   memory_space=vmem)
         dq = pl.pallas_call(
             functools.partial(
