@@ -55,16 +55,93 @@ def _vs_baseline(backend: str) -> float | None:
     return 1.0 if backend == "tpu" else None
 
 
-def _peak_flops(device) -> float | None:
+def _peak_flops(device, backend: str) -> tuple[float | None, str | None]:
+    """Resolve the chip's bf16 peak with explicit provenance: the
+    device_kind string, the PALLAS_AXON_TPU_GEN env override, or — on
+    the axon tunnel, whose device_kind is opaque — the chip generation
+    documented in .claude/skills/verify/SKILL.md (one real TPU v5e).
+    The JSON line records which source produced the number."""
     kind = getattr(device, "device_kind", "").lower()
     for token, peak in _PEAK_FLOPS:
         if token in kind:
-            return peak
+            return peak, f"device_kind:{kind}"
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
     for token, peak in _PEAK_FLOPS:
         if token in gen:
-            return peak
-    return None
+            return peak, f"env:{gen}"
+    if backend == "tpu":
+        return 197e12, (f"assumed-v5e (verify-skill doc; device_kind "
+                        f"{kind!r} matched no known generation)")
+    return None, None
+
+
+# Where bench caches the CPU-lowered HLO FLOP count of its exact
+# program (the axon PJRT's cost_analysis reports no flops — observed
+# round 5 — and FLOPs of the *lowered* module are backend-independent)
+_FLOPS_ARTIFACT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "benchmarks", "artifacts", "bench_flops.json",
+)
+
+
+def _flops_fallback(per_chip_batch: int, side: int, n_chips: int,
+                    bn_backend: str):
+    """Whole-step FLOPs from the cached CPU cost analysis, if its config
+    — including the BN kernel backend, which changes the traced program
+    — matches bench's. Returns (flops_per_step, source) or (None, None)."""
+    try:
+        with open(_FLOPS_ARTIFACT) as f:
+            d = json.load(f)
+        if (d.get("per_chip_batch") == per_chip_batch
+                and d.get("side") == side
+                and d.get("bn_backend") == bn_backend
+                and d.get("flops_per_chip")):
+            return float(d["flops_per_chip"]) * n_chips, d.get(
+                "source", "cpu-hlo-cost-analysis")
+    except (OSError, json.JSONDecodeError, TypeError, ValueError):
+        pass
+    return None, None
+
+
+def flops_only():
+    """Compute bench's per-chip train-step FLOPs on the CPU backend and
+    write the artifact ``_FLOPS_ARTIFACT``. Run as
+    ``python bench.py --flops-only`` — needs no TPU and no window; the
+    platform env pins the axon plugin, so the cpu override must go
+    through jax.config (see .claude/skills/verify/SKILL.md)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tpu_syncbn import runtime
+
+    runtime.initialize()
+    if runtime.global_device_count() != 1:
+        # not an assert: under python -O an elided check would record an
+        # N-device whole-program count as "per chip", inflating MFU N×
+        raise SystemExit(
+            f"flops-only wants 1 device, got {runtime.global_device_count()} "
+            "(unset xla_force_host_platform_device_count)"
+        )
+    cfg = bench_config(True)  # the accelerator config is what bench times
+
+    def build():
+        return build_program(cfg["per_chip_batch"], cfg["side"])
+
+    (dp, batch, flops), bn_backend = _build_with_demotion(build)
+    if not flops:
+        raise SystemExit("CPU cost analysis returned no flops")
+    payload = {
+        "arch": "resnet50_syncbn_dp",
+        "per_chip_batch": cfg["per_chip_batch"],
+        "side": cfg["side"],
+        "bn_backend": bn_backend,
+        "flops_per_chip": flops,
+        "source": "cpu-hlo-cost-analysis",
+    }
+    with open(_FLOPS_ARTIFACT, "w") as f:
+        json.dump(payload, f, indent=1)
+    print(json.dumps(payload))
 
 
 def bench_config(on_accel: bool) -> dict:
@@ -262,15 +339,24 @@ def main():
     img_per_sec_per_chip = img_per_sec / n_chips
     log(f"{img_per_sec:.1f} img/s total, {img_per_sec_per_chip:.1f} img/s/chip")
 
+    backend = jax.default_backend()
+    flops_source = (f"live-hlo-cost-analysis({backend})"
+                    if flops_per_step else None)
+    if flops_per_step is None and on_accel:
+        # bn_backend can read "xla (pallas demoted)"; the traced program
+        # is the XLA one either way, which is what the guard cares about
+        flops_per_step, flops_source = _flops_fallback(
+            per_chip_batch, side, n_chips,
+            "pallas" if bn_backend == "pallas" else "xla",
+        )
     mfu = None
-    peak = _peak_flops(jax.devices()[0]) if on_accel else None
+    peak, peak_source = (_peak_flops(jax.devices()[0], backend)
+                         if on_accel else (None, None))
     if flops_per_step and peak:
         # cost_analysis reports whole-program flops; per-chip share is
         # flops/n_chips for a data-parallel step
         mfu = round(flops_per_step / n_chips / (dt / steps) / peak, 4)
         log(f"MFU={mfu} (flops/step={flops_per_step:.3e}, peak={peak:.0e})")
-
-    backend = jax.default_backend()
     print(json.dumps({
         "metric": "resnet50_syncbn_dp_train_throughput",
         "value": round(img_per_sec_per_chip, 2),
@@ -285,6 +371,10 @@ def main():
         "compile_warmup_s": round(warm_s, 1),
         "mfu": mfu,
         "flops_per_step": flops_per_step,
+        "flops_source": flops_source,
+        "peak_flops": peak,
+        "peak_source": peak_source,
+        "device_kind": getattr(jax.devices()[0], "device_kind", None),
         # a fallback line is a liveness smoke signal, not a measurement
         # of anything the project tracks — cross-round diffs of it are
         # meaningless and tagged as such
@@ -293,4 +383,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--flops-only" in sys.argv[1:]:
+        flops_only()
+    else:
+        main()
