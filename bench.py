@@ -75,6 +75,12 @@ def _peak_flops(device, backend: str) -> tuple[float | None, str | None]:
     return None, None
 
 
+# The bench_batch_sweep stage's scaling points beyond the headline
+# batch. ONE definition shared with tpu_validation's stage — if they
+# drifted, a sweep case would land on a scarce TPU window with no
+# matching FLOPs entry and a silently-null MFU.
+SWEEP_BATCHES = (128, 256)
+
 # Where bench caches the CPU-lowered HLO FLOP count of its exact
 # program (the axon PJRT's cost_analysis reports no flops — observed
 # round 5 — and FLOPs of the *lowered* module are backend-independent)
@@ -86,18 +92,20 @@ _FLOPS_ARTIFACT = os.path.join(
 
 def _flops_fallback(per_chip_batch: int, side: int, n_chips: int,
                     bn_backend: str):
-    """Whole-step FLOPs from the cached CPU cost analysis, if its config
-    — including the BN kernel backend, which changes the traced program
-    — matches bench's. Returns (flops_per_step, source) or (None, None)."""
+    """Whole-step FLOPs from the cached CPU cost analysis, if an entry's
+    config — including the BN kernel backend, which changes the traced
+    program — matches bench's. Returns (flops_per_step, source) or
+    (None, None)."""
     try:
         with open(_FLOPS_ARTIFACT) as f:
             d = json.load(f)
-        if (d.get("per_chip_batch") == per_chip_batch
-                and d.get("side") == side
-                and d.get("bn_backend") == bn_backend
-                and d.get("flops_per_chip")):
-            return float(d["flops_per_chip"]) * n_chips, d.get(
-                "source", "cpu-hlo-cost-analysis")
+        for e in d.get("entries", []):
+            if (e.get("per_chip_batch") == per_chip_batch
+                    and e.get("side") == side
+                    and e.get("bn_backend") == bn_backend
+                    and e.get("flops_per_chip")):
+                return float(e["flops_per_chip"]) * n_chips, d.get(
+                    "source", "cpu-hlo-cost-analysis")
     except (OSError, json.JSONDecodeError, TypeError, ValueError):
         pass
     return None, None
@@ -124,20 +132,30 @@ def flops_only():
             "(unset xla_force_host_platform_device_count)"
         )
     cfg = bench_config(True)  # the accelerator config is what bench times
+    # the headline batch plus the bench_batch_sweep stage's scaling
+    # points, so each sweep case can carry its own MFU
+    batches = sorted({cfg["per_chip_batch"], *SWEEP_BATCHES})
 
-    def build():
-        return build_program(cfg["per_chip_batch"], cfg["side"])
+    entries = []
+    for b in batches:
+        def build(b=b):
+            return build_program(b, cfg["side"])
 
-    (dp, batch, flops), bn_backend = _build_with_demotion(build)
-    if not flops:
-        raise SystemExit("CPU cost analysis returned no flops")
+        (dp, batch, flops), bn_backend = _build_with_demotion(build)
+        if not flops:
+            raise SystemExit(
+                f"CPU cost analysis returned no flops at batch {b}")
+        entries.append({
+            "per_chip_batch": b,
+            "side": cfg["side"],
+            "bn_backend": bn_backend,
+            "flops_per_chip": flops,
+        })
+        log(f"batch {b}: {flops:.4g} flops/step/chip")
     payload = {
         "arch": "resnet50_syncbn_dp",
-        "per_chip_batch": cfg["per_chip_batch"],
-        "side": cfg["side"],
-        "bn_backend": bn_backend,
-        "flops_per_chip": flops,
         "source": "cpu-hlo-cost-analysis",
+        "entries": entries,
     }
     with open(_FLOPS_ARTIFACT, "w") as f:
         json.dump(payload, f, indent=1)

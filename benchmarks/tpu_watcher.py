@@ -58,7 +58,8 @@ ART = os.path.join(ROOT, "benchmarks", "artifacts")
 # then the headline number rides the warmed cache
 STAGES = ["entry_compile", "bench_compile", "bench", "vma_probe",
           "syncbn_overhead", "buffer_broadcast", "pallas_parity",
-          "flash_parity", "flash_overhead", "pallas_sweep"]
+          "flash_parity", "flash_overhead", "pallas_sweep",
+          "bench_batch_sweep"]
 
 
 def stage_done(stage: str) -> bool:
@@ -99,7 +100,8 @@ def stage_done(stage: str) -> bool:
                 "treating stage as NOT done")
             return False
         return payload.get("code_version") == current and criteria_ok
-    if stage in ("entry_compile", "bench_compile", "vma_probe"):
+    if stage in ("entry_compile", "bench_compile", "vma_probe",
+                 "bench_batch_sweep"):
         # written in-process; complete means the evidence was recorded
         return bool(payload.get("complete")) and payload.get("backend") == "tpu"
     if payload.get("rc") not in (0,):

@@ -297,11 +297,28 @@ class TestKernelEditInvalidatesParity:
 
     def test_current_fingerprint_done(self, tmp_path):
         w = _load_watcher(tmp_path)
+        v = _load_validation()
         for stage in ("pallas_parity", "flash_parity"):
-            _write(tmp_path, stage,
-                   {"backend": "tpu", "cases": [{"ok": True}] * 5,
-                    "complete": True, "code_version": self._current(stage)})
+            payload = {"backend": "tpu", "cases": [{"ok": True}] * 5,
+                       "complete": True,
+                       "code_version": self._current(stage)}
+            if stage == "flash_parity":
+                # flash 'ok's also certify the harness pass criteria
+                payload["criteria"] = v.FLASH_PARITY_CRITERIA
+            _write(tmp_path, stage, payload)
             assert w.stage_done(stage)
+
+    def test_flash_criteria_change_not_done(self, tmp_path):
+        """A harness-criteria edit (atol, precision pin) must re-run the
+        stage even when the kernel fingerprint is unchanged — the kernel
+        hash cannot see what an 'ok' certified."""
+        w = _load_watcher(tmp_path)
+        _write(tmp_path, "flash_parity",
+               {"backend": "tpu", "cases": [{"ok": True}] * 5,
+                "complete": True,
+                "code_version": self._current("flash_parity"),
+                "criteria": "v1:some-superseded-criteria"})
+        assert not w.stage_done("flash_parity")
 
 
 def test_every_battery_stage_has_a_runner():
