@@ -61,7 +61,8 @@ def _bn_code_version():
     seeded (skipped) cases must not survive a kernel edit. Shared with
     the evidence gate in ops.batch_norm (same rule: evidence validates a
     binary, not a file name)."""
-    sys.path.insert(0, ROOT)
+    if ROOT not in sys.path:  # called per watcher poll; don't grow path
+        sys.path.insert(0, ROOT)
     from tpu_syncbn.ops.batch_norm import kernel_code_version
 
     return kernel_code_version()
@@ -480,7 +481,14 @@ def stage_vma_probe():
     runtime.initialize()  # before any backend use (multi-host safety)
     assert jax.default_backend() == "tpu", jax.default_backend()
     mesh = runtime.data_parallel_mesh()
-    results = {"backend": "tpu", "complete": False}
+    # Fingerprints distinguish a checker VERDICT (valid across kernel
+    # edits — it characterizes the lowering) from a KERNEL failure
+    # (voided by the next kernel edit, exactly like a parity artifact).
+    # The round-5 first-contact artifact demonstrated why: its flash arm
+    # recorded the since-fixed lse/delta blockspec bug, not a verdict.
+    results = {"backend": "tpu", "complete": False,
+               "bn_code_version": _bn_code_version(),
+               "attn_code_version": _attn_code_version()}
 
     class TinyBN(nnx.Module):
         def __init__(self, rngs):

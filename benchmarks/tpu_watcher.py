@@ -62,6 +62,24 @@ STAGES = ["entry_compile", "bench_compile", "bench", "vma_probe",
           "bench_batch_sweep"]
 
 
+def _current_fingerprints(stage: str):
+    """(bn_version, attn_version, flash_criteria) for the live sources,
+    or None when the helpers themselves fail — in which case callers
+    must fail toward re-running: a broken fingerprint helper must not
+    silently disable the kernel-edit invalidation gate (the stage
+    itself re-checks and will no-op if truly done)."""
+    try:
+        import tpu_validation
+
+        return (tpu_validation._bn_code_version(),
+                tpu_validation._attn_code_version(),
+                tpu_validation.FLASH_PARITY_CRITERIA)
+    except Exception as e:
+        log(f"stage_done({stage!r}): fingerprint check failed ({e!r}); "
+            "treating stage as NOT done")
+        return None
+
+
 def stage_done(stage: str) -> bool:
     path = os.path.join(ART, f"tpu_{stage}.json")
     try:
@@ -79,31 +97,43 @@ def stage_done(stage: str) -> bool:
         # evidence validates a binary, not a file name: a kernel edit
         # voids the artifact and the stage re-runs at the next window
         # (the stage itself re-seeds only version-matched cases)
-        try:
-            import tpu_validation
-
-            current = (tpu_validation._bn_code_version()
-                       if stage == "pallas_parity"
-                       else tpu_validation._attn_code_version())
-            # flash_parity 'ok's also certify harness pass criteria
-            # (atols, precision pin) the kernel fingerprint can't see
-            criteria_ok = (
-                payload.get("criteria")
-                == tpu_validation.FLASH_PARITY_CRITERIA
-                if stage == "flash_parity" else True
-            )
-        except Exception as e:
-            # fail toward re-running: a broken fingerprint helper must
-            # not silently disable the kernel-edit invalidation gate
-            # (the stage itself re-checks and will no-op if truly done)
-            log(f"stage_done({stage!r}): fingerprint check failed ({e!r}); "
-                "treating stage as NOT done")
+        fps = _current_fingerprints(stage)
+        if fps is None:
             return False
+        bn_version, attn_version, criteria = fps
+        current = bn_version if stage == "pallas_parity" else attn_version
+        # flash_parity 'ok's also certify harness pass criteria
+        # (atols, precision pin) the kernel fingerprint can't see
+        criteria_ok = (payload.get("criteria") == criteria
+                       if stage == "flash_parity" else True)
         return payload.get("code_version") == current and criteria_ok
     if stage in ("entry_compile", "bench_compile", "vma_probe",
                  "bench_batch_sweep"):
         # written in-process; complete means the evidence was recorded
-        return bool(payload.get("complete")) and payload.get("backend") == "tpu"
+        if not (bool(payload.get("complete"))
+                and payload.get("backend") == "tpu"):
+            return False
+        if stage == "vma_probe":
+            # A checker VERDICT (accepted, or rejected-with-passing-
+            # control) stands across kernel edits — it characterizes the
+            # lowering. But an arm where the CONTROL also failed recorded
+            # a kernel bug, not a verdict; that evidence is voided by a
+            # kernel edit and the probe must re-run (round 5's first
+            # artifact captured the since-fixed flash blockspec bug).
+            fps = _current_fingerprints(stage)
+            if fps is None:
+                return False
+            bn_version, attn_version, _ = fps
+            arms = (("bn_pallas_check_vma_ok", "bn_control_unchecked_ok",
+                     "bn_code_version", bn_version),
+                    ("flash_check_vma_ok", "flash_control_unchecked_ok",
+                     "attn_code_version", attn_version))
+            for ok_key, ctrl_key, ver_key, current in arms:
+                kernel_failure = (payload.get(ok_key) is False
+                                  and payload.get(ctrl_key) is False)
+                if kernel_failure and payload.get(ver_key) != current:
+                    return False
+        return True
     if payload.get("rc") not in (0,):
         return False
     parsed = payload.get("parsed") or {}

@@ -321,6 +321,74 @@ class TestKernelEditInvalidatesParity:
         assert not w.stage_done("flash_parity")
 
 
+class TestKernelEditInvalidatesVmaProbe:
+    """The vma_probe records two kinds of evidence. A checker VERDICT
+    (accepted, or rejected with a passing unchecked control) stands
+    across kernel edits — it characterizes the shard_map lowering. But
+    an arm where the control ALSO failed recorded a kernel bug, not a
+    verdict (round 5's first on-chip artifact captured the since-fixed
+    flash lse/delta blockspec bug that way); that evidence is voided by
+    a kernel edit and the probe must re-run."""
+
+    def _base(self):
+        v = _load_validation()
+        return {"backend": "tpu", "complete": True,
+                "bn_pallas_check_vma_ok": True,
+                "bn_code_version": v._bn_code_version(),
+                "attn_code_version": v._attn_code_version()}
+
+    def test_kernel_failure_stale_fingerprint_not_done(self, tmp_path):
+        w = _load_watcher(tmp_path)
+        _write(tmp_path, "vma_probe",
+               {**self._base(), "flash_check_vma_ok": False,
+                "flash_control_unchecked_ok": False,
+                "attn_code_version": "0000deadbeef0000"})
+        assert not w.stage_done("vma_probe")
+
+    def test_kernel_failure_absent_fingerprint_not_done(self, tmp_path):
+        # the round-5 first-contact artifact shape: no fingerprint keys
+        w = _load_watcher(tmp_path)
+        payload = self._base()
+        del payload["bn_code_version"], payload["attn_code_version"]
+        _write(tmp_path, "vma_probe",
+               {**payload, "flash_check_vma_ok": False,
+                "flash_control_unchecked_ok": False})
+        assert not w.stage_done("vma_probe")
+
+    def test_kernel_failure_current_fingerprint_done(self, tmp_path):
+        # "kernel broken at this version" is settled evidence
+        w = _load_watcher(tmp_path)
+        _write(tmp_path, "vma_probe",
+               {**self._base(), "flash_check_vma_ok": False,
+                "flash_control_unchecked_ok": False})
+        assert w.stage_done("vma_probe")
+
+    def test_rejection_verdict_survives_kernel_edit(self, tmp_path):
+        # checked failed but control passed: genuine checker rejection,
+        # valid regardless of fingerprint
+        w = _load_watcher(tmp_path)
+        _write(tmp_path, "vma_probe",
+               {**self._base(), "flash_check_vma_ok": False,
+                "flash_control_unchecked_ok": True,
+                "attn_code_version": "0000deadbeef0000"})
+        assert w.stage_done("vma_probe")
+
+    def test_accept_verdict_survives_kernel_edit(self, tmp_path):
+        w = _load_watcher(tmp_path)
+        payload = self._base()
+        del payload["bn_code_version"], payload["attn_code_version"]
+        _write(tmp_path, "vma_probe",
+               {**payload, "flash_check_vma_ok": True})
+        assert w.stage_done("vma_probe")
+
+    def test_incomplete_not_done(self, tmp_path):
+        w = _load_watcher(tmp_path)
+        _write(tmp_path, "vma_probe",
+               {**self._base(), "complete": False,
+                "flash_check_vma_ok": True})
+        assert not w.stage_done("vma_probe")
+
+
 def test_every_battery_stage_has_a_runner():
     """A stage in the inventory without a runner must fail at resolve
     time (before any window is spent), not silently no-op as 'passed'."""
