@@ -32,6 +32,10 @@ import os
 import sys
 import time
 
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "benchmarks"))
+from _common import fetch_sync
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
@@ -331,7 +335,9 @@ def main():
         t_c = time.perf_counter()
         for _ in range(3 if on_accel else 1):
             out = dp.train_step(batch)
-        out.loss.block_until_ready()
+        # fetch-sync, not block_until_ready: see benchmarks/_common.py
+        # fetch_sync (the tunnel's PJRT reports readiness early)
+        fetch_sync(out.loss)
         warm_s = time.perf_counter() - t_c
         log(f"compile+warmup took {warm_s:.1f}s")
         return dp, batch, flops, warm_s
@@ -350,7 +356,8 @@ def main():
     t0 = time.perf_counter()
     for _ in range(steps):
         out = dp.train_step(batch)
-    out.loss.block_until_ready()
+    fetch_sync(out.loss)  # the final loss value transitively forces
+    # every step in the donated-state chain
     dt = time.perf_counter() - t0
 
     img_per_sec = global_batch * steps / dt

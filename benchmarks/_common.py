@@ -103,3 +103,29 @@ def setup(simulate: int | None, *, needs_backend: bool = True) -> None:
         from tpu_syncbn.runtime import probe
 
         probe.ensure_backend(1)
+
+
+def fetch_sync(out) -> float:
+    """Timing barrier for on-chip measurements: FETCH a value instead of
+    calling ``block_until_ready``.
+
+    The axon tunnel's PJRT was caught reporting buffer readiness before
+    execution completed (``tpu_overlap_probe.json``, round 5: the
+    per-step "blocked" arm timed FASTER than the chained arm, and the
+    implied TFLOP/s exceeded the chip's own measured matmul ceiling). A
+    device-to-host copy cannot complete before the value exists, so
+    fetching one scalar of the last output is the only barrier trusted
+    here. For chained computations (donated train-step state, fori_loop
+    carries) the fetched leaf transitively forces the whole chain; for a
+    loop of independent dispatches it bounds the batch under the TPU
+    runtime's FIFO single-stream execution.
+
+    Accepts any array / StepOutput / pytree; fetches the first leaf's
+    first element and returns it as a float (f32-cast so bf16 leaves
+    fetch cleanly).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(jnp.ravel(leaf)[0].astype(jnp.float32))

@@ -17,7 +17,7 @@ import json
 import re
 import time
 
-from _common import setup
+from _common import fetch_sync, setup
 
 
 def parse_args():
@@ -80,11 +80,11 @@ def main():
         n_ar = len(re.findall(r" all-reduce(?:-start)?\(", hlo))
         for _ in range(3):
             out = dp.train_step(batch)
-        out.loss.block_until_ready()
+        fetch_sync(out.loss)  # warmup must be DONE before t0
         t0 = time.perf_counter()
         for _ in range(args.steps):
             out = dp.train_step(batch)
-        out.loss.block_until_ready()
+        fetch_sync(out.loss)  # not block: tunnel PJRT lies
         dt = (time.perf_counter() - t0) / args.steps
         results[key] = {
             "all_reduces_per_step": n_ar,

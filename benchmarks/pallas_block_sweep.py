@@ -17,7 +17,7 @@ import os
 import sys
 import time
 
-from _common import log, setup
+from _common import fetch_sync, log, setup
 
 
 def parse_args():
@@ -144,7 +144,17 @@ def main():
             total = 0.0
             ok = True
             for m, c in shapes:
-                key = f"{block}:{m}x{c}"
+                # The VMEM-aware clamp (pallas_bn._block_m) treats
+                # _BLOCK_M as a MAX: where it clamps this shape below the
+                # requested block, the kernel actually runs the clamped
+                # size — key the timing by what RUNS, so no label ever
+                # names a configuration that doesn't exist and the
+                # clamped row is measured/reused exactly once.
+                effective = pallas_bn._block_m(c, 4)
+                if effective != block:
+                    log(f"[sweep] block={block} shape=({m},{c}) clamps "
+                        f"to {effective}")
+                key = f"{effective}:{m}x{c}"
                 if key in shape_ms:
                     total += shape_ms[key] / 1e3
                     continue
@@ -172,7 +182,7 @@ def main():
 
                 g = jax.jit(jax.grad(loss))
                 try:
-                    g(x).block_until_ready()  # compile + warm
+                    fetch_sync(g(x))  # compile + warm (fetch: PJRT lies)
                 except Exception as e:  # e.g. VMEM overflow at big blocks
                     failures[f"{block}@({m},{c})"] = (
                         f"{type(e).__name__}: {e}"[:200]
@@ -182,7 +192,9 @@ def main():
                 t0 = time.perf_counter()
                 for _ in range(args.iters):
                     out = g(x)
-                out.block_until_ready()
+                # iters dispatches of the same args are independent;
+                # fetching the last bounds the batch under FIFO execution
+                fetch_sync(out)
                 dt = (time.perf_counter() - t0) / args.iters
                 log(f"[sweep] block={block} shape=({m},{c}) {dt*1e3:.3f} ms")
                 shape_ms[key] = round(dt * 1e3, 4)

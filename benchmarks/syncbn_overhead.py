@@ -66,11 +66,11 @@ def main():
             b = jax.device_put((x, y), dp.batch_sharding)
             for _ in range(3):
                 out = dp.train_step(b)  # traces under the selected mode
-            out.loss.block_until_ready()
+            _common.fetch_sync(out.loss)  # warmup must be DONE before t0
             t0 = time.perf_counter()
             for _ in range(args.steps):
                 out = dp.train_step(b)
-            out.loss.block_until_ready()
+            _common.fetch_sync(out.loss)  # not block: tunnel PJRT lies
             return (time.perf_counter() - t0) / args.steps * 1e3
 
     from tpu_syncbn.ops.batch_norm import _use_pallas

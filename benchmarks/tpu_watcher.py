@@ -56,10 +56,10 @@ ART = os.path.join(ROOT, "benchmarks", "artifacts")
 # (entry_compile for the driver's compile check, bench_compile for
 # bench's EXACT train-step program — they are different XLA programs),
 # then the headline number rides the warmed cache
-STAGES = ["entry_compile", "bench_compile", "bench", "vma_probe",
-          "syncbn_overhead", "buffer_broadcast", "pallas_parity",
-          "flash_parity", "flash_overhead", "pallas_sweep",
-          "bench_batch_sweep"]
+STAGES = ["entry_compile", "bench_compile", "bench", "peak_probe",
+          "overlap_probe", "vma_probe", "syncbn_overhead", "buffer_broadcast",
+          "pallas_parity", "flash_parity", "flash_overhead",
+          "pallas_sweep", "bench_batch_sweep"]
 
 
 def _current_fingerprints(stage: str):
@@ -108,7 +108,7 @@ def stage_done(stage: str) -> bool:
                        if stage == "flash_parity" else True)
         return payload.get("code_version") == current and criteria_ok
     if stage in ("entry_compile", "bench_compile", "vma_probe",
-                 "bench_batch_sweep"):
+                 "bench_batch_sweep", "peak_probe", "overlap_probe"):
         # written in-process; complete means the evidence was recorded
         if not (bool(payload.get("complete"))
                 and payload.get("backend") == "tpu"):
@@ -139,6 +139,15 @@ def stage_done(stage: str) -> bool:
     parsed = payload.get("parsed") or {}
     if parsed.get("budget_exhausted"):
         return False  # a truncated sweep should use later windows to finish
+    if stage == "syncbn_overhead":
+        # the artifact feeds ops.batch_norm's evidence-gated 'auto' (which
+        # already ignores version-mismatched evidence in-process); a BN
+        # kernel edit — e.g. the sweep-driven _BLOCK_M retune — must also
+        # re-queue the measurement itself, or 'auto' starves on a stale
+        # file that reads as done
+        fps = _current_fingerprints(stage)
+        if fps is None or parsed.get("kernel_code_version") != fps[0]:
+            return False
     return parsed.get("backend") == "tpu" and not parsed.get("skipped")
 
 

@@ -389,6 +389,41 @@ class TestKernelEditInvalidatesVmaProbe:
         assert not w.stage_done("vma_probe")
 
 
+class TestKernelEditInvalidatesSyncbnOverhead:
+    """The overhead artifact is the input to ops.batch_norm's
+    evidence-gated 'auto' (which already ignores version-mismatched
+    evidence in-process). A BN kernel edit — e.g. the sweep-driven
+    _BLOCK_M retune — must also re-queue the measurement itself in the
+    watcher, or 'auto' starves forever on a stale file that reads as
+    done."""
+
+    def _payload(self, version):
+        return {"rc": 0, "tail": "",
+                "parsed": {"metric": "syncbn_overhead", "backend": "tpu",
+                           "pallas_speedup_vs_xla": 0.49,
+                           "kernel_code_version": version}}
+
+    def test_stale_fingerprint_not_done(self, tmp_path):
+        w = _load_watcher(tmp_path)
+        _write(tmp_path, "syncbn_overhead",
+               self._payload("0000deadbeef0000"))
+        assert not w.stage_done("syncbn_overhead")
+
+    def test_absent_fingerprint_not_done(self, tmp_path):
+        w = _load_watcher(tmp_path)
+        payload = self._payload(None)
+        del payload["parsed"]["kernel_code_version"]
+        _write(tmp_path, "syncbn_overhead", payload)
+        assert not w.stage_done("syncbn_overhead")
+
+    def test_current_fingerprint_done(self, tmp_path):
+        w = _load_watcher(tmp_path)
+        v = _load_validation()
+        _write(tmp_path, "syncbn_overhead",
+               self._payload(v._bn_code_version()))
+        assert w.stage_done("syncbn_overhead")
+
+
 def test_every_battery_stage_has_a_runner():
     """A stage in the inventory without a runner must fail at resolve
     time (before any window is spent), not silently no-op as 'passed'."""

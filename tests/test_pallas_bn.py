@@ -32,7 +32,7 @@ def test_bn_stats_matches_xla():
 
 
 def test_bn_stats_nonaligned_rows():
-    """M=60 rows is not a multiple of the 256-row block: padding must not
+    """M=60 rows is not a multiple of the row block: padding must not
     perturb the sums."""
     x = rand(1, shape=(1, 60, 1, C))
     s_p, sq_p, n_p = pallas_bn.bn_stats(x)
@@ -318,3 +318,54 @@ def test_group_scoped_model_keeps_vma_checker_under_pallas_mode():
         )
         out = dp.train_step(batch)
         assert np.isfinite(float(out.loss))
+
+
+class TestVmemAwareBlock:
+    """The first on-chip full-model run at a fixed block of 512 hit the
+    TPU's 16 MiB scoped-VMEM ceiling in bn_backward_reduce at C=2048 f32
+    (2 operands x 2 pipeline buffers x 512*2048*4 B = 16 MiB + scratch).
+    _block_m must keep the fattest kernel's double-buffered working set
+    under budget while preserving the sweep-measured 512 wherever it
+    fits."""
+
+    def test_measured_oom_case_clamped(self):
+        # the exact failing configuration: C=2048, f32
+        assert pallas_bn._block_m(2048, 4) == 256
+
+    def test_sweep_winner_kept_where_it_fits(self):
+        assert pallas_bn._block_m(64, 4) == 512
+        assert pallas_bn._block_m(1024, 4) == 512
+        assert pallas_bn._block_m(2048, 2) == 512  # bf16 halves the rows
+
+    def test_budget_invariant(self):
+        for c in (8, 64, 256, 512, 1024, 2048, 4096, 8192, 16384):
+            for itemsize in (2, 4):
+                m = pallas_bn._block_m(c, itemsize)
+                assert m >= 64
+                assert (4 * m * c * itemsize <= pallas_bn._VMEM_BUDGET_BYTES
+                        or m == 64)
+
+    def test_wide_channel_kernels_correct_at_clamped_block(self):
+        """Functional check at a C wide enough to clamp the block (f32
+        C=2048 -> 256): sums and normalize must be exact across the
+        block-size change, including non-multiple row counts."""
+        c = 2048
+        x = jnp.asarray(
+            np.random.RandomState(7).randn(300, c).astype(np.float32)
+        )
+        s, sq, n = pallas_bn.bn_stats(x)
+        np.testing.assert_allclose(
+            np.asarray(s), np.asarray(x).sum(0), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(sq), (np.asarray(x) ** 2).sum(0), rtol=1e-3)
+        assert float(n) == 300
+        mean = s / n
+        var = sq / n - mean**2
+        y = pallas_bn.bn_normalize(x, mean, var, None, None, 1e-5)
+        ref = (np.asarray(x) - np.asarray(mean)) / np.sqrt(
+            np.asarray(var) + 1e-5)
+        np.testing.assert_allclose(np.asarray(y), ref, atol=2e-4)
+        sdy, sdyx = pallas_bn.bn_backward_reduce(
+            x, x, mean, jax.lax.rsqrt(var + 1e-5))
+        np.testing.assert_allclose(
+            np.asarray(sdy), np.asarray(x).sum(0), rtol=1e-3, atol=1e-4)

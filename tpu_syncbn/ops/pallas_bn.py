@@ -41,8 +41,33 @@ from jax.experimental.pallas import tpu as pltpu
 
 from tpu_syncbn.parallel.collectives import moments_from_stats
 
-# rows per grid step (sublane-aligned); channels ride the 128-wide lane axis
-_BLOCK_M = 256
+# Max rows per grid step (sublane-aligned); channels ride the 128-wide
+# lane axis. 512 is the measured overall best of {128, 256, 512, 1024}
+# over the ResNet-50 BN shape set on a v5e chip (sum of fused fwd+bwd:
+# 23.9 ms vs 27.0 at 256, 1.13x; benchmarks/artifacts/
+# tpu_pallas_sweep.json). Per-shape winners vary (256 leads the C=64
+# case), but the per-shape spread on a 10-iter tunnel run is too noisy
+# to justify a full adaptive table.
+_BLOCK_M = 512
+
+# The fattest kernel (bn_backward_reduce) streams TWO (block, C) operands
+# through Pallas's double-buffered pipeline: working set = 2 operands x 2
+# buffers x block*C*itemsize. The first on-chip run of the full ResNet-50
+# step at block 512, C=2048, f32 hit the TPU's scoped-VMEM ceiling at
+# exactly that arithmetic (16.02 MiB vs the 16 MiB limit, watcher log
+# 06:57) — a failure the standalone kernel sweep and interpret mode both
+# miss. Budget leaves headroom for scratch/semaphores.
+_VMEM_BUDGET_BYTES = 14 * 2**20
+
+
+def _block_m(c: int, itemsize: int) -> int:
+    """Largest power-of-two block <= _BLOCK_M whose double-buffered
+    two-stream working set fits the scoped-VMEM budget (>= 64 always:
+    64*C*16 bytes = 2 MiB even at C=2048 f32)."""
+    m = _BLOCK_M
+    while m > 64 and 4 * m * c * itemsize > _VMEM_BUDGET_BYTES:
+        m //= 2
+    return m
 
 
 from tpu_syncbn.ops._pallas_common import interpret as _interpret
@@ -94,19 +119,20 @@ def bn_stats(x: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
     but raw sums compose across replicas with a single psum (SURVEY §7).
     """
     x2, c = _as_2d(x)
-    x2, m = _pad_rows(x2, _BLOCK_M)  # zero rows contribute 0 to both sums
-    s, sq = _stats_2d(x2, c)
+    block = _block_m(c, x.dtype.itemsize)
+    x2, m = _pad_rows(x2, block)  # zero rows contribute 0 to both sums
+    s, sq = _stats_2d(x2, c, block)
     return s, sq, jnp.float32(m)
 
 
-def _stats_2d(x2: jax.Array, c: int) -> tuple[jax.Array, jax.Array]:
-    """Stats kernel over an already-padded (M', C) view."""
-    grid = (x2.shape[0] // _BLOCK_M,)
+def _stats_2d(x2: jax.Array, c: int, block: int) -> tuple[jax.Array, jax.Array]:
+    """Stats kernel over an (M', C) view already padded to ``block``."""
+    grid = (x2.shape[0] // block,)
     s, sq = pl.pallas_call(
         _stats_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_BLOCK_M, c), lambda i: (i, 0), memory_space=pltpu.VMEM)
+            pl.BlockSpec((block, c), lambda i: (i, 0), memory_space=pltpu.VMEM)
         ],
         out_specs=[
             pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
@@ -146,24 +172,25 @@ def bn_normalize(
 
     scale, shift = fold_scale_shift(mean, var, weight, bias, eps)
     x2, c = _as_2d(x)
-    x2p, m = _pad_rows(x2, _BLOCK_M)
-    y = _normalize_2d(x2p, scale, shift, c, x.dtype)
+    block = _block_m(c, x.dtype.itemsize)
+    x2p, m = _pad_rows(x2, block)
+    y = _normalize_2d(x2p, scale, shift, c, x.dtype, block)
     return y[:m].reshape(x.shape)
 
 
-def _normalize_2d(x2p, scale, shift, c, out_dtype):
-    """Normalize kernel over an already-padded (M', C) view."""
-    grid = (x2p.shape[0] // _BLOCK_M,)
+def _normalize_2d(x2p, scale, shift, c, out_dtype, block):
+    """Normalize kernel over an (M', C) view already padded to ``block``."""
+    grid = (x2p.shape[0] // block,)
     return pl.pallas_call(
         _normalize_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_BLOCK_M, c), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, c), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
-            (_BLOCK_M, c), lambda i: (i, 0), memory_space=pltpu.VMEM
+            (block, c), lambda i: (i, 0), memory_space=pltpu.VMEM
         ),
         out_shape=_sds(x2p.shape, out_dtype, x2p),
         interpret=_interpret(),
@@ -201,15 +228,16 @@ def bn_backward_reduce(
     dy=0, so the sums are exact."""
     dy2, c = _as_2d(dy)
     x2, _ = _as_2d(x)
-    dy2, m = _pad_rows(dy2, _BLOCK_M)
-    x2, _ = _pad_rows(x2, _BLOCK_M)
-    grid = (dy2.shape[0] // _BLOCK_M,)
+    block = _block_m(c, max(dy.dtype.itemsize, x.dtype.itemsize))
+    dy2, m = _pad_rows(dy2, block)
+    x2, _ = _pad_rows(x2, block)
+    grid = (dy2.shape[0] // block,)
     sdy, sdyx = pl.pallas_call(
         _bwd_reduce_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_BLOCK_M, c), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((_BLOCK_M, c), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, c), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, c), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
@@ -248,14 +276,15 @@ def _fbn_fwd_impl(x, weight, bias, eps, axis_name):
 
     # pad the (M, C) view ONCE; both kernels share it
     x2, c = _as_2d(x)
-    x2p, m = _pad_rows(x2, _BLOCK_M)
-    s, sq = _stats_2d(x2p, c)
+    block = _block_m(c, x.dtype.itemsize)
+    x2p, m = _pad_rows(x2, block)
+    s, sq = _stats_2d(x2p, c, block)
     count = jnp.float32(m)
     if axis_name is not None:
         s, sq, count = jax.lax.psum((s, sq, count), axis_name)
     mean, var = moments_from_stats(s, sq, count)
     scale, shift = fold_scale_shift(mean, var, weight, bias, eps)
-    y = _normalize_2d(x2p, scale, shift, c, x.dtype)[:m].reshape(x.shape)
+    y = _normalize_2d(x2p, scale, shift, c, x.dtype, block)[:m].reshape(x.shape)
     invstd = jax.lax.rsqrt(var + eps)
     return y, mean, var, count, invstd
 
