@@ -348,9 +348,13 @@ def main():
 
     # A disk-hit compile (bench_compile prewarmed this exact program)
     # leaves most of the window unspent — buy timing fidelity with it.
-    # Only when the user didn't pin BENCH_STEPS explicitly.
+    # 6x (60 steps): the fetch-sync barrier costs one ~70 ms tunnel
+    # round-trip per timed loop (tpu_overlap_probe.json), so more steps
+    # shrink its per-step share (~8% at 30 steps -> ~4% at 60) along
+    # with the one-step post-loss tail. Only when the user didn't pin
+    # BENCH_STEPS explicitly.
     if on_accel and warm_s < 60 and "BENCH_STEPS" not in os.environ:
-        steps *= 3
+        steps *= 6
         log(f"compile was a cache hit ({warm_s:.1f}s); extending to {steps} steps")
 
     t0 = time.perf_counter()

@@ -325,17 +325,30 @@ class TestVmemAwareBlock:
     TPU's 16 MiB scoped-VMEM ceiling in bn_backward_reduce at C=2048 f32
     (2 operands x 2 pipeline buffers x 512*2048*4 B = 16 MiB + scratch).
     _block_m must keep the fattest kernel's double-buffered working set
-    under budget while preserving the sweep-measured 512 wherever it
-    fits."""
+    under budget while preserving the sweep-chosen cap (256 per the
+    fetch-synced sweep, tpu_pallas_sweep.json; the earlier 512 ranking
+    was a readiness-bug artifact) wherever it fits."""
 
-    def test_measured_oom_case_clamped(self):
-        # the exact failing configuration: C=2048, f32
+    def test_measured_oom_case_fires_clamp(self, monkeypatch):
+        # the historical failure: cap 512, C=2048, f32 must CLAMP to 256
+        # (not merely fit) — pinned with the cap forced to 512 so the
+        # regression stays detectable whatever cap ships
+        monkeypatch.setattr(pallas_bn, "_BLOCK_M", 512)
         assert pallas_bn._block_m(2048, 4) == 256
 
+    def test_clamp_fires_at_shipping_cap(self):
+        # at the shipping cap there must exist a real clamping C so the
+        # halving path stays exercised: C=4096 f32 (4*256*4096*4 = 16
+        # MiB > budget) -> 128
+        cap = pallas_bn._BLOCK_M
+        assert pallas_bn._block_m(4096, 4) < cap
+
     def test_sweep_winner_kept_where_it_fits(self):
-        assert pallas_bn._block_m(64, 4) == 512
-        assert pallas_bn._block_m(1024, 4) == 512
-        assert pallas_bn._block_m(2048, 2) == 512  # bf16 halves the rows
+        # narrow/medium channels run the full sweep-chosen cap
+        cap = pallas_bn._BLOCK_M
+        assert pallas_bn._block_m(64, 4) == cap
+        assert pallas_bn._block_m(1024, 4) == cap
+        assert pallas_bn._block_m(2048, 2) == cap  # bf16 halves the rows
 
     def test_budget_invariant(self):
         for c in (8, 64, 256, 512, 1024, 2048, 4096, 8192, 16384):
@@ -346,10 +359,12 @@ class TestVmemAwareBlock:
                         or m == 64)
 
     def test_wide_channel_kernels_correct_at_clamped_block(self):
-        """Functional check at a C wide enough to clamp the block (f32
-        C=2048 -> 256): sums and normalize must be exact across the
-        block-size change, including non-multiple row counts."""
-        c = 2048
+        """Functional check at a C wide enough to clamp the block below
+        the shipping cap (f32 C=4096: 256 -> 128): sums and normalize
+        must be exact across the clamp-induced block change, including
+        non-multiple row counts."""
+        c = 4096
+        assert pallas_bn._block_m(c, 4) < pallas_bn._BLOCK_M
         x = jnp.asarray(
             np.random.RandomState(7).randn(300, c).astype(np.float32)
         )

@@ -42,13 +42,16 @@ from jax.experimental.pallas import tpu as pltpu
 from tpu_syncbn.parallel.collectives import moments_from_stats
 
 # Max rows per grid step (sublane-aligned); channels ride the 128-wide
-# lane axis. 512 is the measured overall best of {128, 256, 512, 1024}
-# over the ResNet-50 BN shape set on a v5e chip (sum of fused fwd+bwd:
-# 23.9 ms vs 27.0 at 256, 1.13x; benchmarks/artifacts/
-# tpu_pallas_sweep.json). Per-shape winners vary (256 leads the C=64
-# case), but the per-shape spread on a 10-iter tunnel run is too noisy
-# to justify a full adaptive table.
-_BLOCK_M = 512
+# lane axis. 256 is the measured overall best of {128, 256, 512, 1024}
+# over the ResNet-50 BN shape set on a v5e chip under the FETCH-SYNCED
+# sweep (sum of fused fwd+bwd: 256 -> 28.2 ms, 1024 -> 32.3, 128 ->
+# 36.5, 512 -> 44.8; benchmarks/artifacts/tpu_pallas_sweep.json). The
+# earlier block-synced sweep ranked 512 first, but that timing was
+# voided with the rest of the block-sync artifacts when the tunnel's
+# early-readiness bug was caught (tpu_overlap_probe.json); 1024 ranking
+# worse than 256 despite being measured last also argues the honest
+# ranking is real rather than window drift.
+_BLOCK_M = 256
 
 # The fattest kernel (bn_backward_reduce) streams TWO (block, C) operands
 # through Pallas's double-buffered pipeline: working set = 2 operands x 2
