@@ -52,6 +52,15 @@ _PEAK_FLOPS = [
 ]
 
 
+def _host_load() -> float | None:
+    """1-minute load average, or None where unavailable — an annotation
+    must never kill the measurement it annotates."""
+    try:
+        return round(os.getloadavg()[0], 2)
+    except (AttributeError, OSError):
+        return None
+
+
 def _vs_baseline(backend: str) -> float | None:
     """The TPU measurement defines the baseline (ratio 1.0); any fallback
     backend reports null so a CPU line can never read as a baseline ratio
@@ -406,9 +415,10 @@ def main():
         "device_kind": getattr(jax.devices()[0], "device_kind", None),
         # dispatch is host-driven: on a contended 1-CPU host the timed
         # loop becomes dispatch-bound and the number collapses (observed:
-        # 2319 -> 150 img/s with a test suite pinning the core). Load is
+        # 2319 -> 150 img/s with a test suite pinning the core; load ~6.5
+        # vs the ~1-2 a lone bench run shows on this container). Load is
         # recorded so a contaminated sample is identifiable post hoc.
-        "host_load_1m": round(os.getloadavg()[0], 2),
+        "host_load_1m": _host_load(),
         # a fallback line is a liveness smoke signal, not a measurement
         # of anything the project tracks — cross-round diffs of it are
         # meaningless and tagged as such
