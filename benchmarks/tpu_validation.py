@@ -40,7 +40,7 @@ ART = os.path.join(ROOT, "benchmarks", "artifacts")
 # the prewarmed (cache-hit) compile, not just the watcher's ordering
 STAGES = ["pallas_parity", "flash_parity", "flash_overhead", "pallas_sweep",
           "syncbn_overhead", "buffer_broadcast", "bench_compile", "bench",
-          "entry_compile", "vma_probe", "bench_batch_sweep", "peak_probe", "overlap_probe"]
+          "entry_compile", "vma_probe", "bench_batch_sweep", "peak_probe", "overlap_probe", "scan_dispatch"]
 
 
 def save(name, payload):
@@ -663,6 +663,64 @@ def stage_overlap_probe():
     save("overlap_probe", results)
 
 
+def stage_scan_dispatch():
+    """Measure what per-step host dispatch costs through the tunnel, and
+    what the scanned multi-step API (``DataParallel.train_steps`` —
+    ``lax.scan`` of the optimizer step inside ONE compiled program)
+    wins back.
+
+    Two arms at bench's exact program/batch, both fetch-synced: N
+    host-dispatched ``train_step`` calls vs one ``train_steps(batch, N)``.
+    The difference is pure host-loop overhead — the scan arm's chip
+    never waits on the host between steps. (The same-batch semantics
+    match bench's measurement loop, so the arms run identical math.)"""
+    import jax
+
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
+    from _common import fetch_sync
+    from bench import build_program
+
+    from tpu_syncbn import runtime
+
+    runtime.initialize()
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    results = {"backend": "tpu", "complete": False}
+
+    dp, batch, _ = build_program(64, 224, with_flops=False)
+    n = 30
+
+    for _ in range(3):
+        out = dp.train_step(batch)
+    fetch_sync(out.loss)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = dp.train_step(batch)
+    fetch_sync(out.loss)
+    dispatched_s = (time.perf_counter() - t0) / n
+    results["host_loop_ms_per_step"] = round(dispatched_s * 1e3, 3)
+    save("scan_dispatch", results)
+
+    out = dp.train_steps(batch, n)  # compile
+    fetch_sync(out.loss)
+    t0 = time.perf_counter()
+    out = dp.train_steps(batch, n)
+    fetch_sync(out.loss)
+    scanned_s = (time.perf_counter() - t0) / n
+    results["scanned_ms_per_step"] = round(scanned_s * 1e3, 3)
+    results["dispatch_overhead_ms_per_step"] = round(
+        (dispatched_s - scanned_s) * 1e3, 3)
+    results["scan_speedup"] = round(dispatched_s / scanned_s, 3)
+    results["img_per_s_per_chip_scanned"] = round(
+        64 / scanned_s, 1)
+    results["steps"] = n
+    results["complete"] = True
+    save("scan_dispatch", results)
+    log(f"[scan_dispatch] host-loop {dispatched_s*1e3:.2f} ms/step vs "
+        f"scanned {scanned_s*1e3:.2f} ms/step "
+        f"(x{dispatched_s/scanned_s:.2f})")
+
+
 def stage_bench_compile():
     """AOT-compile bench's *exact* train-step program (bf16 SyncBN
     ResNet-50, bench_config(True) shapes) into the persistent cache.
@@ -973,6 +1031,7 @@ def _stage_runner(stage: str):
         "bench_batch_sweep": stage_bench_batch_sweep,
         "peak_probe": stage_peak_probe,
         "overlap_probe": stage_overlap_probe,
+        "scan_dispatch": stage_scan_dispatch,
     }
     subprocess_cmds = {
         "pallas_sweep": [sys.executable, "benchmarks/pallas_block_sweep.py",

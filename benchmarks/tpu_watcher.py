@@ -59,7 +59,7 @@ ART = os.path.join(ROOT, "benchmarks", "artifacts")
 STAGES = ["entry_compile", "bench_compile", "bench", "peak_probe",
           "overlap_probe", "vma_probe", "syncbn_overhead", "buffer_broadcast",
           "pallas_parity", "flash_parity", "flash_overhead",
-          "pallas_sweep", "bench_batch_sweep"]
+          "pallas_sweep", "bench_batch_sweep", "scan_dispatch"]
 
 
 def _current_fingerprints(stage: str):
@@ -108,7 +108,8 @@ def stage_done(stage: str) -> bool:
                        if stage == "flash_parity" else True)
         return payload.get("code_version") == current and criteria_ok
     if stage in ("entry_compile", "bench_compile", "vma_probe",
-                 "bench_batch_sweep", "peak_probe", "overlap_probe"):
+                 "bench_batch_sweep", "peak_probe", "overlap_probe",
+                 "scan_dispatch"):
         # written in-process; complete means the evidence was recorded
         if not (bool(payload.get("complete"))
                 and payload.get("backend") == "tpu"):

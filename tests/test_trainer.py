@@ -445,3 +445,59 @@ def test_dp_composes_with_2d_mesh():
         ),
         dp2.params, dp1.params,
     )
+
+
+class TestScannedTrainSteps:
+    """train_steps(batch, n) — n optimizer steps in ONE compiled program
+    (on-device lax.scan, no per-step host dispatch) — must be exactly n
+    sequential train_step calls: same params, same BN running stats,
+    same optimizer state, same per-step losses."""
+
+    def _build(self, donate=False):
+        m = tnn.convert_sync_batchnorm(SmallCNN(nnx.Rngs(0)))
+        return parallel.DataParallel(
+            m, optax.sgd(0.05, momentum=0.9), ce_loss, donate=donate
+        )
+
+    @pytest.mark.parametrize("donate", [False, True])
+    def test_matches_sequential_steps(self, donate):
+        # donate=True is the production default (and what the on-chip
+        # scan_dispatch stage runs): the scanned jit must donate state
+        # but never the batch, which every iteration re-reads
+        batch = make_batch(11)
+        dp_seq = self._build(donate)
+        seq_losses = [float(dp_seq.train_step(batch).loss) for _ in range(3)]
+        dp_scan = self._build(donate)
+        out = dp_scan.train_steps(batch, 3)
+        assert out.loss.shape == (3,)
+        np.testing.assert_allclose(
+            np.asarray(out.loss), np.asarray(seq_losses), rtol=1e-5
+        )
+        for name, a, b in (
+            ("params", dp_scan.params, dp_seq.params),
+            ("rest", dp_scan.rest, dp_seq.rest),
+            ("opt", dp_scan.opt_state, dp_seq.opt_state),
+        ):
+            jax.tree_util.tree_map(
+                lambda x, y: np.testing.assert_allclose(
+                    np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-6,
+                    err_msg=name,
+                ),
+                a, b,
+            )
+
+    def test_composes_with_train_step_and_caches(self):
+        batch = make_batch(12)
+        dp = self._build()
+        dp.train_step(batch)
+        out = dp.train_steps(batch, 2)
+        assert out.loss.shape == (2,)
+        assert 2 in dp._train_steps_cache
+        dp.train_steps(batch, 2)  # cache hit, state threads on
+        dp.train_step(batch)  # and back to single steps
+        assert np.isfinite(float(dp.train_step(batch).loss))
+
+    def test_rejects_bad_n(self):
+        dp = self._build()
+        with pytest.raises(ValueError, match="n_steps"):
+            dp.train_steps(make_batch(13), 0)
