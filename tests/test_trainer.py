@@ -501,3 +501,37 @@ class TestScannedTrainSteps:
         dp = self._build()
         with pytest.raises(ValueError, match="n_steps"):
             dp.train_steps(make_batch(13), 0)
+
+    @pytest.mark.parametrize("kwargs", [{"zero": True}, {"accum_steps": 2}],
+                             ids=["zero", "accum"])
+    def test_composes_with_zero_and_accum(self, kwargs):
+        """The scanned loop shares the step body with the single-step
+        path, so it must compose with the orthogonal trainer modes:
+        ZeRO-sharded state and microbatch accumulation — with the FULL
+        state equal to sequential steps (params, BN running stats,
+        optimizer state), not just the loss."""
+        batch = make_batch(14)
+
+        def build():
+            m = tnn.convert_sync_batchnorm(SmallCNN(nnx.Rngs(0)))
+            return parallel.DataParallel(
+                m, optax.sgd(0.05, momentum=0.9), ce_loss,
+                donate=False, **kwargs,
+            )
+
+        dp_seq = build()
+        seq = [float(dp_seq.train_step(batch).loss) for _ in range(2)]
+        dp_scan = build()
+        out = dp_scan.train_steps(batch, 2)
+        np.testing.assert_allclose(np.asarray(out.loss), seq, rtol=1e-5)
+        for name, a, b in (
+            ("params", dp_scan.params, dp_seq.params),
+            ("rest", dp_scan.rest, dp_seq.rest),
+            ("opt", dp_scan.opt_state, dp_seq.opt_state),
+        ):
+            jax.tree_util.tree_map(
+                lambda x, y: np.testing.assert_allclose(
+                    np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-6,
+                    err_msg=name),
+                a, b,
+            )
