@@ -317,6 +317,118 @@ def prewarm() -> dict:
     }
 
 
+def measure_recovery(dp, *, repeats: int = 3) -> dict:
+    """The ``recovery`` block of the bench line: what robustness costs.
+
+    Times, on bench's exact training state (the real ResNet-50 + SyncBN
+    + optimizer pytree):
+
+    * ``ckpt_roundtrip_s`` — save + load through utils.checkpoint WITH
+      manifest write + CRC verification (the shipped path);
+    * ``ckpt_roundtrip_seed_s`` — the seed path (payload only: msgpack
+      bytes + atomic write + read + deserialize), re-measured here so the
+      overhead claim is always against THIS machine/state;
+    * ``manifest_overhead_frac`` — the verification machinery's own cost
+      (checksum passes at save + load, tree hash, manifest file I/O),
+      timed component-wise against the seed round-trip. Component timing,
+      not total differencing: two ~seconds-long totals differenced on a
+      contended host swing ±15%, an order of magnitude more than the
+      quantity being measured. This is the <5% acceptance bound's number.
+    * ``resume_after_kill_s`` — time-to-resume when the newest checkpoint
+      was killed mid-write (injected truncation): detection + fallback to
+      the older verified step + state restore.
+
+    Best-of-``repeats`` per quantity (same denoising convention as the
+    throughput loop: we report capability, the history log keeps spread).
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    from flax import serialization
+
+    from tpu_syncbn.testing import faults
+    from tpu_syncbn.utils import checkpoint as ckpt
+
+    d = tempfile.mkdtemp(prefix="bench_recovery_")
+    try:
+        state = dp.state_dict()
+        template = dp.state_dict()
+
+        def timed(fn):
+            best = None
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                fn()
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            return best
+
+        # shipped path: manifest + CRC verify
+        def shipped():
+            ckpt.save_checkpoint(d, 1, state, keep=0)
+            ckpt.load_checkpoint(d, template)
+
+        # seed path: payload only, no manifest, no verification
+        seed_file = os.path.join(d, "seed.msgpack")
+
+        def seed():
+            host = jax.device_get(ckpt._purify(state))
+            data = serialization.to_bytes(host)
+            ckpt._atomic_write(d, seed_file, data)
+            with open(seed_file, "rb") as f:
+                serialization.from_bytes(ckpt._purify(template), f.read())
+
+        shipped_s = timed(shipped)
+        seed_s = timed(seed)
+        ckpt_bytes = os.path.getsize(ckpt._path(d, 1))
+
+        # the verification machinery, timed component-wise on the real
+        # payload: checksum at save + checksum at load (+ CRC32 when the
+        # payload is under its size tier), tree hash, manifest write+read
+        host = jax.device_get(ckpt._purify(state))
+        from flax import serialization as _ser
+        import zlib as _zlib
+
+        data = _ser.to_bytes(host)
+
+        def verify_components():
+            ckpt.payload_sum64(data)  # save-side
+            ckpt.payload_sum64(data)  # load-side
+            if len(data) <= ckpt._CRC32_MAX_BYTES:
+                _zlib.crc32(data)
+                _zlib.crc32(data)
+            ckpt.tree_structure_hash(host)
+            mpath = os.path.join(d, "probe.manifest.json")
+            ckpt._atomic_write(d, mpath, b"{}" * 64)
+            with open(mpath, "rb") as f:
+                f.read()
+
+        overhead_s = timed(verify_components)
+
+        # injected kill: newest checkpoint truncated mid-write; resume
+        # must detect + fall back to the older verified step
+        ckpt.save_checkpoint(d, 1, state, keep=0)
+        ckpt.save_checkpoint(d, 2, state, keep=0)
+        faults.truncate_file(ckpt._path(d, 2))
+        t0 = time.perf_counter()
+        _, resumed_step = ckpt.load_checkpoint(d, template)
+        resume_s = time.perf_counter() - t0
+
+        return {
+            "ckpt_roundtrip_s": round(shipped_s, 4),
+            "ckpt_roundtrip_seed_s": round(seed_s, 4),
+            "manifest_overhead_s": round(overhead_s, 4),
+            "manifest_overhead_frac": round(overhead_s / seed_s, 4)
+            if seed_s > 0 else None,
+            "resume_after_kill_s": round(resume_s, 4),
+            "resumed_step_after_kill": resumed_step,
+            "ckpt_bytes": ckpt_bytes,
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def main():
     from tpu_syncbn.runtime import probe
 
@@ -387,6 +499,17 @@ def main():
             per_chip_batch, side, n_chips,
             "pallas" if bn_backend == "pallas" else "xla",
         )
+    # robustness cost, measured on the SAME training state the
+    # throughput number used — an annotation, never fatal to the metric
+    try:
+        recovery = measure_recovery(dp)
+        log(f"recovery: manifest overhead "
+            f"{recovery['manifest_overhead_frac']:+.1%}, resume-after-kill "
+            f"{recovery['resume_after_kill_s']:.3f}s")
+    except Exception as e:
+        log(f"recovery measurement failed: {type(e).__name__}: {e}")
+        recovery = None
+
     mfu = None
     peak, peak_source = (_peak_flops(jax.devices()[0], backend)
                          if on_accel else (None, None))
@@ -419,6 +542,10 @@ def main():
         # vs the ~1-2 a lone bench run shows on this container). Load is
         # recorded so a contaminated sample is identifiable post hoc.
         "host_load_1m": _host_load(),
+        # docs/RESILIENCE.md: recovery overhead is tracked here, NOT in
+        # the steady-state img/s value above (which measures the fault-
+        # free step loop)
+        "recovery": recovery,
         # a fallback line is a liveness smoke signal, not a measurement
         # of anything the project tracks — cross-round diffs of it are
         # meaningless and tagged as such

@@ -75,6 +75,13 @@ def parse_args():
     p.add_argument("--accum-steps", type=int, default=1)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--divergence-guard", default=None,
+                   choices=["skip_step", "halve_lr", "restore_last_good"],
+                   help="on-device non-finite loss/grad policy "
+                        "(docs/RESILIENCE.md)")
+    p.add_argument("--data-deadline", type=float, default=None,
+                   help="seconds before a hung batch fetch raises "
+                        "StallError instead of hanging the job")
     p.add_argument("--eval-every", type=int, default=0,
                    help="eval every N epochs (0 = only at the end)")
     p.add_argument("--profile-dir", default=None)
@@ -133,17 +140,16 @@ def main():
         optax.sgd(schedule, momentum=0.9, nesterov=True),
     )
     dp = parallel.DataParallel(
-        model, opt, loss_fn, mesh=mesh, accum_steps=args.accum_steps
+        model, opt, loss_fn, mesh=mesh, accum_steps=args.accum_steps,
+        divergence_guard=args.divergence_guard,
     )
 
     start_epoch = 0
     if args.ckpt_dir and args.resume:
-        try:
-            restored, step = utils.load_checkpoint(args.ckpt_dir, dp.state_dict())
-            dp.load_state_dict(restored)
-            start_epoch = step
-            log.info("resumed from epoch %d", step)
-        except FileNotFoundError:
+        # newest VERIFIED checkpoint (corrupt/truncated ones are skipped
+        # with a warning); 0 means fresh start
+        start_epoch = parallel.resume_latest(dp, args.ckpt_dir)
+        if not start_epoch:
             log.info("no checkpoint found; starting fresh")
 
     sampler = tdata.DistributedSampler(
@@ -169,6 +175,15 @@ def main():
             meter.update(float(out.metrics["top1"]), n=args.batch_size)
         return meter.avg
 
+    def train_batches():
+        it = tdata.device_prefetch(iter(loader), sharding=dp.batch_sharding)
+        if args.data_deadline:
+            # a wedged data worker becomes a catchable StallError at the
+            # deadline instead of an indefinite hang
+            it = runtime.stall_guard(it, args.data_deadline,
+                                     name="train-batch")
+        return it
+
     tput = utils.ThroughputMeter()
     # resume restarts from a checkpointed epoch: keep the logged step
     # monotonic across runs (the JSONL file is append-mode). len(loader)
@@ -188,10 +203,13 @@ def main():
             utils.profiler_trace(args.profile_dir or "",
                                  enabled=bool(args.profile_dir))
         )
+        # SIGTERM/SIGINT (preemption notice) → finish the in-flight step,
+        # checkpoint at the boundary, exit 0; the restarted job resumes
+        # at this epoch via --resume
+        guard = stack.enter_context(runtime.PreemptionGuard())
         for epoch in range(start_epoch, args.epochs):
             sampler.set_epoch(epoch)
-            for batch in tdata.device_prefetch(iter(loader),
-                                               sharding=dp.batch_sharding):
+            for batch in train_batches():
                 out = dp.train_step(batch)
                 step += 1
                 out.loss.block_until_ready()
@@ -206,6 +224,17 @@ def main():
                         scalars.log(step, epoch=epoch, loss=out.loss,
                                     top1=out.metrics["top1"],
                                     img_per_sec=tput.samples_per_sec)
+                if guard.preempted:
+                    break
+            if guard.preempted:
+                if args.ckpt_dir:
+                    # step-boundary snapshot tagged with the CURRENT epoch:
+                    # resume replays this epoch from its deterministic
+                    # sampler order rather than trusting a mid-epoch cursor
+                    utils.save_checkpoint(args.ckpt_dir, epoch, dp.state_dict())
+                log.warning("preempted: checkpointed at epoch %d boundary; "
+                            "exiting cleanly", epoch)
+                break
             if args.ckpt_dir:
                 utils.save_checkpoint(args.ckpt_dir, epoch + 1, dp.state_dict())
             if args.eval_every and (epoch + 1) % args.eval_every == 0:

@@ -23,7 +23,7 @@ from tpu_syncbn import runtime
 runtime.initialize()
 
 import jax.numpy as jnp
-from jax import shard_map
+from tpu_syncbn.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpu_syncbn import nn as tnn, parallel

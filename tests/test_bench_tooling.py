@@ -432,3 +432,45 @@ def test_every_battery_stage_has_a_runner():
         assert callable(v._stage_runner(stage)), stage
     with pytest.raises(KeyError, match="no runner"):
         v._stage_runner("nonexistent_stage")
+
+
+class TestRecoveryBlock:
+    """bench's `recovery` block: the robustness-cost measurement that
+    rides the BENCH_*.json line (manifest overhead + time-to-resume
+    after an injected mid-write kill)."""
+
+    def test_schema_and_fallback_resume(self):
+        import jax.numpy as jnp
+        import optax
+        from flax import nnx
+
+        from tpu_syncbn import nn as tnn, parallel
+
+        bench = _load_bench()
+
+        class Net(nnx.Module):
+            def __init__(self, rngs):
+                self.fc = nnx.Linear(8, 8, rngs=rngs)
+                self.bn = tnn.BatchNorm1d(8)
+
+            def __call__(self, x):
+                return self.bn(self.fc(x))
+
+        dp = parallel.DataParallel(
+            tnn.convert_sync_batchnorm(Net(nnx.Rngs(0))),
+            optax.sgd(0.1), lambda m, b: (m(b) ** 2).mean(),
+        )
+        dp.train_step(jnp.ones((8, 8), jnp.float32))
+        rec = bench.measure_recovery(dp, repeats=1)
+        assert set(rec) == {
+            "ckpt_roundtrip_s", "ckpt_roundtrip_seed_s",
+            "manifest_overhead_s", "manifest_overhead_frac",
+            "resume_after_kill_s", "resumed_step_after_kill", "ckpt_bytes",
+        }
+        assert rec["manifest_overhead_s"] >= 0
+        # the injected kill truncated step 2: resume must land on the
+        # older verified step, and quickly
+        assert rec["resumed_step_after_kill"] == 1
+        assert rec["ckpt_bytes"] > 0
+        assert rec["ckpt_roundtrip_s"] > 0
+        assert rec["resume_after_kill_s"] < 10

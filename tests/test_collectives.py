@@ -10,7 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from tpu_syncbn.compat import shard_map
 
 from tpu_syncbn import parallel, runtime
 from tpu_syncbn.parallel import collectives

@@ -322,6 +322,7 @@ def test_process_loader_matches_sequential_order():
         np.testing.assert_array_equal(sy, py)
 
 
+@pytest.mark.slow  # spawn-heavy: tier-1 runs against an 870s kill
 def test_process_loader_propagates_worker_error():
     loader = tdata.DataLoader(
         _FailAt(7), batch_size=4, num_workers=2, worker_type="process"
@@ -330,6 +331,7 @@ def test_process_loader_propagates_worker_error():
         list(loader)
 
 
+@pytest.mark.slow  # spawn-heavy: tier-1 runs against an 870s kill
 def test_process_loader_worker_init_error():
     ds = tdata.ArrayDataset(np.zeros((8, 2), np.float32))
     loader = tdata.DataLoader(
@@ -382,6 +384,7 @@ def _tag_with_worker_id(wid):
     info.dataset.tag = wid + 100
 
 
+@pytest.mark.slow  # spawn/compile-heavy: tier-1 runs against an 870s kill
 def test_process_worker_init_reaches_worker_dataset_copy():
     ds = _TaggedDS()
     loader = tdata.DataLoader(
@@ -463,6 +466,7 @@ def _reseed_by_worker(wid):
     tdata.get_worker_info().dataset.transform.reseed(1000 + wid)
 
 
+@pytest.mark.slow  # spawn/compile-heavy: tier-1 runs against an 870s kill
 def test_compose_reseed_decorrelates_process_workers():
     ds = _CropValueDS()
     loader = tdata.DataLoader(

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from tpu_syncbn.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from tpu_syncbn import data as tdata

@@ -1,0 +1,576 @@
+"""Fault matrix: every injected failure mode has a dedicated test pinning
+the documented recovery behavior (docs/RESILIENCE.md).
+
+Faults come from the deterministic harness (``tpu_syncbn.testing.faults``:
+env-keyed seeds, no wall-clock randomness), so a red test replays
+bit-for-bit. The whole file carries the ``fault`` marker and must stay
+tier-1 fast (<60 s total — pytest.ini).
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import nnx
+
+from tpu_syncbn import nn as tnn, parallel, utils
+from tpu_syncbn.data.loader import DataLoader, WorkerError
+from tpu_syncbn.runtime import resilience
+from tpu_syncbn.testing import faults
+from tpu_syncbn.utils import checkpoint as ckpt
+from tpu_syncbn.utils.checkpoint import CheckpointCorruptError
+
+pytestmark = pytest.mark.fault
+
+
+class TinyNet(nnx.Module):
+    def __init__(self, rngs):
+        self.fc = nnx.Linear(4, 4, rngs=rngs)
+        self.bn = tnn.BatchNorm1d(4)
+
+    def __call__(self, x):
+        return self.bn(self.fc(x))
+
+
+def loss_fn(m, batch):
+    x, y = batch
+    return ((m(x) - y) ** 2).mean()
+
+
+def make_batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.randn(16, 4), jnp.float32),
+        jnp.asarray(rng.randn(16, 4), jnp.float32),
+    )
+
+
+def make_trainer(seed=0, **kw):
+    model = tnn.convert_sync_batchnorm(TinyNet(nnx.Rngs(seed)))
+    return parallel.DataParallel(model, optax.adam(1e-2), loss_fn, **kw)
+
+
+def snap(tree):
+    """Host-side COPY of a param tree: on the CPU backend device_get can
+    return zero-copy views whose storage is recycled by the next donated
+    step, silently mutating a "snapshot"."""
+    return jax.tree_util.tree_map(lambda x: np.array(x, copy=True), tree)
+
+
+def params_equal(a, b):
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+class RangeDataset:
+    """Module-level (spawn-picklable) dataset for process-worker tests."""
+
+    def __init__(self, n=64):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.full((2,), i, np.float32)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint corruption
+
+
+class TestCorruptCheckpoint:
+    def _two_checkpoints(self, d):
+        dp = make_trainer()
+        batch = make_batch()
+        dp.train_step(batch)
+        ckpt.save_checkpoint(d, 1, dp.state_dict())
+        good = snap(dp.params)
+        dp.train_step(batch)
+        ckpt.save_checkpoint(d, 2, dp.state_dict())
+        return dp, good
+
+    def test_truncated_newest_falls_back_to_verified(self, tmp_path):
+        d = str(tmp_path)
+        dp, good_step1 = self._two_checkpoints(d)
+        faults.corrupt_checkpoint(d, 2, "truncate")
+        assert not ckpt.verify_checkpoint(d, 2)
+        assert ckpt.verified_steps(d) == [1]
+        dp2 = make_trainer(seed=9)
+        restored, step = utils.load_checkpoint(d, dp2.state_dict())
+        assert step == 1  # newest VERIFIED, not newest
+        dp2.load_state_dict(restored)
+        params_equal(dp2.params, good_step1)
+
+    def test_bitflipped_newest_falls_back_to_verified(self, tmp_path):
+        d = str(tmp_path)
+        dp, good_step1 = self._two_checkpoints(d)
+        faults.corrupt_checkpoint(d, 2, "bitflip", seed=123)
+        assert not ckpt.verify_checkpoint(d, 2)
+        dp2 = make_trainer(seed=9)
+        restored, step = utils.load_checkpoint(d, dp2.state_dict())
+        assert step == 1
+        dp2.load_state_dict(restored)
+        params_equal(dp2.params, good_step1)
+
+    def test_bitflip_is_deterministic_by_seed(self, tmp_path):
+        p1, p2 = str(tmp_path / "a.bin"), str(tmp_path / "b.bin")
+        for p in (p1, p2):
+            with open(p, "wb") as f:
+                f.write(bytes(range(256)) * 8)
+        assert faults.bitflip_file(p1, seed=7) == faults.bitflip_file(p2, seed=7)
+        with open(p1, "rb") as f1, open(p2, "rb") as f2:
+            assert f1.read() == f2.read()
+
+    def test_all_corrupt_raises_loudly(self, tmp_path):
+        d = str(tmp_path)
+        self._two_checkpoints(d)
+        faults.corrupt_checkpoint(d, 1, "truncate")
+        faults.corrupt_checkpoint(d, 2, "bitflip")
+        dp = make_trainer()
+        with pytest.raises(CheckpointCorruptError, match="failed verification"):
+            utils.load_checkpoint(d, dp.state_dict())
+
+    def test_explicit_corrupt_step_raises_not_falls_back(self, tmp_path):
+        d = str(tmp_path)
+        self._two_checkpoints(d)
+        faults.corrupt_checkpoint(d, 2, "truncate")
+        dp = make_trainer()
+        with pytest.raises(CheckpointCorruptError, match="step 2"):
+            utils.load_checkpoint(d, dp.state_dict(), step=2)
+
+    def test_resume_latest_skips_corrupt(self, tmp_path):
+        d = str(tmp_path)
+        dp, good_step1 = self._two_checkpoints(d)
+        faults.corrupt_checkpoint(d, 2, "truncate")
+        dp2 = make_trainer(seed=5)
+        assert parallel.resume_latest(dp2, d) == 1
+        params_equal(dp2.params, good_step1)
+
+    def test_resume_latest_empty_dir_is_fresh_start(self, tmp_path):
+        dp = make_trainer()
+        assert parallel.resume_latest(dp, str(tmp_path / "none")) == 0
+
+
+# ---------------------------------------------------------------------------
+# worker kill
+
+
+class TestWorkerKill:
+    def test_killed_worker_surfaces_not_hangs(self):
+        loader = DataLoader(RangeDataset(64), batch_size=4, num_workers=2,
+                            worker_type="process")
+        it = iter(loader)
+        next(it)  # pool is live
+        faults.kill_loader_worker(loader, wid=0)
+        with pytest.raises(WorkerError, match="died"):
+            # bounded: the idle_check declares the dead worker within the
+            # consumer's polling loop, not after an indefinite hang
+            for _ in range(64):
+                next(it)
+        loader.close()
+        loader.close()  # idempotent double close
+
+    def test_abandoned_loader_reaps_workers_via_finalizer(self):
+        import weakref
+
+        loader = DataLoader(RangeDataset(8), batch_size=4, num_workers=1,
+                            worker_type="process")
+        it = iter(loader)
+        next(it)
+        procs = loader._pool["procs"]
+        fin = loader._pool_finalizer
+        assert isinstance(fin, weakref.finalize) and fin.alive
+        del it, loader  # dropped WITHOUT close()
+        import gc
+
+        gc.collect()
+        assert not fin.alive  # finalizer ran
+        deadline = time.monotonic() + 10
+        while any(p.is_alive() for p in procs):
+            assert time.monotonic() < deadline, "workers were orphaned"
+            time.sleep(0.05)
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM (preemption)
+
+
+class TestPreemption:
+    def test_sigterm_checkpoints_at_boundary_and_resumes_identically(
+        self, tmp_path
+    ):
+        d = str(tmp_path)
+        dp = make_trainer()
+        batch = make_batch()
+        loop = resilience.ResilientLoop(dp, d, ckpt_every=100)
+        # SIGTERM lands right before batch 3 → the loop must finish step 3
+        # and checkpoint at that boundary
+        batches = faults.signal_at(iter([batch] * 10), at_step=3)
+        summary = loop.run(batches)
+        assert summary["preempted"] is True
+        assert summary["steps"] == 4  # steps 1..4; flag seen after step 4
+        assert ckpt.verified_steps(d) == [summary["step"]]
+        saved = snap(dp.params)
+
+        # "all hosts" of the restarted job agree: two fresh trainers
+        # resume from the same directory to identical params at the same
+        # step
+        resumed = []
+        for seed in (7, 8):
+            dp_r = make_trainer(seed=seed)
+            r_loop = resilience.ResilientLoop(dp_r, d)
+            assert r_loop.resume() == summary["step"]
+            resumed.append(dp_r)
+        params_equal(resumed[0].params, saved)
+        params_equal(resumed[0].params, resumed[1].params)
+
+        # and the resumed trajectory continues: one more step changes
+        # params finitely
+        out = resumed[0].train_step(batch)
+        assert np.isfinite(float(out.loss))
+
+    def test_second_signal_is_not_swallowed(self):
+        # the guard defers ONE signal; the flag is visible immediately
+        with resilience.PreemptionGuard(signals=(resilience.signal.SIGUSR1,)) as g:
+            os.kill(os.getpid(), resilience.signal.SIGUSR1)
+            assert g.wait(2)
+            assert g.preempted and g.signum == resilience.signal.SIGUSR1
+
+
+# ---------------------------------------------------------------------------
+# NaN gradient
+
+
+class TestNaNGradient:
+    def test_skip_step_never_pollutes_params(self):
+        dp = make_trainer(divergence_guard="skip_step")
+        batch = make_batch()
+        dp.train_step(batch)
+        before = snap(dp.params)
+        out = dp.train_step(next(faults.poison_nan(iter([batch]), 0)))
+        assert float(out.metrics["nonfinite"]) == 1.0
+        params_equal(dp.params, before)
+        # optimizer moments also rolled back: next finite step exactly
+        # matches a trainer that never saw the NaN batch
+        control = make_trainer(divergence_guard="skip_step")
+        control.train_step(batch)
+        out_a = dp.train_step(batch)
+        out_b = control.train_step(batch)
+        np.testing.assert_allclose(float(out_a.loss), float(out_b.loss),
+                                   rtol=1e-6)
+
+    def test_halve_lr_decays_scale_per_event(self):
+        dp = make_trainer(divergence_guard="halve_lr")
+        batch = make_batch()
+        poisoned = list(faults.poison_nan(iter([batch] * 4), 1))
+        poisoned = list(faults.poison_nan(iter(poisoned), 2))
+        for b in poisoned:
+            out = dp.train_step(b)
+        guard = dp.opt_state[1]
+        assert float(guard["lr_scale"]) == 0.25  # two halvings
+        assert int(guard["nonfinite_count"]) == 2
+        assert np.isfinite(float(out.loss))
+
+    def test_skip_step_composes_with_zero(self):
+        # guard state rides inside opt_state, so the ZeRO-sharded layout
+        # must carry it too (its scalars replicate; shards stay sharded)
+        class Net(nnx.Module):
+            def __init__(self, rngs):
+                self.fc = nnx.Linear(4, 4, rngs=rngs)
+
+            def __call__(self, x):
+                return self.fc(x)
+
+        dp = parallel.DataParallel(
+            Net(nnx.Rngs(0)), optax.adam(1e-2), loss_fn,
+            zero=True, divergence_guard="skip_step",
+        )
+        batch = make_batch()
+        dp.train_step(batch)
+        before = snap(dp.params)
+        out = dp.train_step(next(faults.poison_nan(iter([batch]), 0)))
+        assert float(out.metrics["nonfinite"]) == 1.0
+        params_equal(dp.params, before)
+        assert np.isfinite(float(dp.train_step(batch).loss))
+
+    def test_restore_last_good_reloads_checkpoint(self, tmp_path):
+        d = str(tmp_path)
+        dp = make_trainer(divergence_guard="restore_last_good")
+        batch = make_batch()
+        loop = resilience.ResilientLoop(dp, d, ckpt_every=2)
+        loop.run(iter([batch] * 4))  # checkpoints at steps 2 and 4
+        good = snap(dp.params)
+        summary = loop.run(faults.poison_nan(iter([batch] * 3), 1))
+        assert summary["divergence_restores"] == 1
+        assert summary["nonfinite_steps"] == 1
+        # restored state is the last verified checkpoint's
+        dp_ref = make_trainer(seed=3, divergence_guard="restore_last_good")
+        assert parallel.resume_latest(dp_ref, d) >= 4
+
+    def test_restore_last_good_without_checkpoint_degrades_to_skip(
+        self, tmp_path
+    ):
+        # divergence before the first save: nothing to restore — the
+        # on-device guard already skipped the update, so the loop must
+        # continue (step counter intact), not fabricate a restore
+        dp = make_trainer(divergence_guard="restore_last_good")
+        batch = make_batch()
+        loop = resilience.ResilientLoop(dp, str(tmp_path), ckpt_every=100)
+        summary = loop.run(faults.poison_nan(iter([batch] * 3), 1))
+        assert summary["steps"] == 3 and summary["step"] == 3
+        assert summary.get("divergence_restores", 0) == 0
+        assert summary["divergence_skips_without_checkpoint"] == 1
+        assert np.isfinite(float(dp.train_step(batch).loss))
+
+    def test_restore_last_good_bounds_thrash(self, tmp_path):
+        d = str(tmp_path)
+        dp = make_trainer(divergence_guard="restore_last_good")
+        batch = make_batch()
+        loop = resilience.ResilientLoop(dp, d, ckpt_every=1,
+                                        max_restores=2)
+        loop.run(iter([batch] * 2))
+
+        def always_nan():
+            while True:
+                yield next(faults.poison_nan(iter([batch]), 0))
+
+        with pytest.raises(FloatingPointError, match="refusing to thrash"):
+            loop.run(always_nan())
+
+
+# ---------------------------------------------------------------------------
+# stalled batch
+
+
+class TestStalledBatch:
+    def test_stall_guard_raises_within_deadline(self):
+        batch = make_batch()
+        # batch 2 delayed 10s; the guard must raise around its 0.5s
+        # deadline — the "never hangs past the watchdog deadline" contract
+        delayed = faults.delay_batch(iter([batch] * 5), at_step=2,
+                                     delay_s=10.0)
+        guarded = resilience.stall_guard(delayed, deadline_s=0.5,
+                                         name="test-batch")
+        t0 = time.monotonic()
+        with pytest.raises(resilience.StallError, match="deadline"):
+            for _ in guarded:
+                pass
+        assert time.monotonic() - t0 < 5.0  # bounded, nowhere near 10s
+
+    def test_stall_guard_transparent_when_healthy(self):
+        items = [1, 2, 3]
+        assert list(resilience.stall_guard(iter(items), deadline_s=5)) == items
+
+    def test_stall_guard_propagates_source_errors(self):
+        def bad():
+            yield 1
+            raise RuntimeError("source died")
+
+        g = resilience.stall_guard(bad(), deadline_s=5)
+        assert next(g) == 1
+        with pytest.raises(RuntimeError, match="source died"):
+            next(g)
+
+
+# ---------------------------------------------------------------------------
+# multi-host checkpoint agreement (simulated follower/master)
+
+
+class _FakeMultiHost:
+    """Patch the dist surface checkpoint.load_checkpoint consults so a
+    single process behaves as one host of a 2-host world."""
+
+    def __init__(self, monkeypatch, *, is_master, master_step):
+        from tpu_syncbn.runtime import distributed as dist
+        from jax.experimental import multihost_utils
+
+        monkeypatch.setattr(dist, "process_count", lambda: 2)
+        monkeypatch.setattr(dist, "is_master", lambda: is_master)
+        monkeypatch.setattr(dist, "process_index",
+                            lambda: 0 if is_master else 1)
+        monkeypatch.setattr(dist, "barrier", lambda name="b": None)
+        self.broadcast_args = []
+
+        def fake_broadcast(x, is_source):
+            self.broadcast_args.append((np.asarray(x).item(), is_source))
+            # the coordination service returns the MASTER's value on
+            # every host
+            return np.int32(master_step if not is_source
+                            else np.asarray(x).item())
+
+        monkeypatch.setattr(multihost_utils, "broadcast_one_to_all",
+                            fake_broadcast)
+
+
+class TestMultiHostAgreement:
+    def _save_steps(self, d):
+        dp = make_trainer()
+        batch = make_batch()
+        dp.train_step(batch)
+        ckpt.save_checkpoint(d, 1, dp.state_dict())
+        dp.train_step(batch)
+        ckpt.save_checkpoint(d, 2, dp.state_dict())
+        return snap(dp.params)
+
+    def test_follower_with_lagging_listing_restores_agreed_step(
+        self, tmp_path, monkeypatch
+    ):
+        d = str(tmp_path)
+        newest = self._save_steps(d)
+        _FakeMultiHost(monkeypatch, is_master=False, master_step=2)
+        # the follower's directory listing lags the master's rename: it
+        # sees NOTHING — but the agreed file itself is readable
+        monkeypatch.setattr(ckpt, "available_steps", lambda _d: [])
+        dp2 = make_trainer(seed=9)
+        restored, step = ckpt.load_checkpoint(d, dp2.state_dict())
+        assert step == 2
+        dp2.load_state_dict(restored)
+        params_equal(dp2.params, newest)
+
+    def test_follower_retries_until_rename_lands(self, tmp_path, monkeypatch):
+        d = str(tmp_path)
+        newest = self._save_steps(d)
+        _FakeMultiHost(monkeypatch, is_master=False, master_step=2)
+        # simulate the rename becoming visible only after a delay
+        payload = ckpt._path(d, 2)
+        hidden = payload + ".hidden"
+        os.rename(payload, hidden)
+        t = threading.Timer(0.3, os.rename, args=(hidden, payload))
+        t.start()
+        try:
+            dp2 = make_trainer(seed=9)
+            restored, step = ckpt.load_checkpoint(d, dp2.state_dict())
+        finally:
+            t.join()
+        assert step == 2
+        dp2.load_state_dict(restored)
+        params_equal(dp2.params, newest)
+
+    def test_master_agreement_skips_its_own_corrupt_newest(
+        self, tmp_path, monkeypatch
+    ):
+        d = str(tmp_path)
+        self._save_steps(d)
+        faults.corrupt_checkpoint(d, 2, "truncate")
+        fake = _FakeMultiHost(monkeypatch, is_master=True, master_step=-99)
+        dp2 = make_trainer(seed=9)
+        restored, step = ckpt.load_checkpoint(d, dp2.state_dict())
+        assert step == 1  # newest VERIFIED is what gets broadcast
+        assert fake.broadcast_args[0] == (1, True)
+
+    def test_master_mixed_legacy_dir_falls_back_to_legacy_step(
+        self, tmp_path, monkeypatch
+    ):
+        """Mid-upgrade directory: an old manifest-less checkpoint plus a
+        newer manifested one killed mid-write. Multi-host agreement must
+        fall back to the legacy step exactly as a single host would, not
+        declare the directory unloadable."""
+        from flax import serialization
+
+        d = str(tmp_path)
+        with open(ckpt._path(d, 100), "wb") as f:  # legacy, no manifest
+            f.write(serialization.to_bytes(
+                {"x": np.full((2,), 7.0, np.float32)}))
+        ckpt.save_checkpoint(d, 200, {"x": jnp.ones(2)})
+        faults.corrupt_checkpoint(d, 200, "truncate")
+        fake = _FakeMultiHost(monkeypatch, is_master=True, master_step=-99)
+        tree, step = ckpt.load_checkpoint(d, {"x": jnp.zeros(2)})
+        assert step == 100
+        assert fake.broadcast_args[0] == (100, True)
+        np.testing.assert_allclose(np.asarray(tree["x"]), 7.0)
+
+    def test_master_prefers_newest_loadable_regardless_of_manifest(
+        self, tmp_path, monkeypatch
+    ):
+        """A legacy step NEWER than the newest verified one must win the
+        agreement, matching the single-host newest-first walk — the same
+        directory may not resume to different states by process_count."""
+        from flax import serialization
+
+        d = str(tmp_path)
+        ckpt.save_checkpoint(d, 8, {"x": jnp.ones(2)})  # verified
+        with open(ckpt._path(d, 10), "wb") as f:  # newer, legacy
+            f.write(serialization.to_bytes(
+                {"x": np.full((2,), 3.0, np.float32)}))
+        fake = _FakeMultiHost(monkeypatch, is_master=True, master_step=-99)
+        tree, step = ckpt.load_checkpoint(d, {"x": jnp.zeros(2)})
+        assert step == 10
+        assert fake.broadcast_args[0] == (10, True)
+        np.testing.assert_allclose(np.asarray(tree["x"]), 3.0)
+
+    def test_follower_detects_locally_corrupt_payload(
+        self, tmp_path, monkeypatch
+    ):
+        d = str(tmp_path)
+        self._save_steps(d)
+        _FakeMultiHost(monkeypatch, is_master=False, master_step=2)
+        faults.corrupt_checkpoint(d, 2, "bitflip")  # follower's copy is bad
+        dp2 = make_trainer(seed=9)
+        with pytest.raises(CheckpointCorruptError, match="host 1"):
+            ckpt.load_checkpoint(d, dp2.state_dict())
+
+
+# ---------------------------------------------------------------------------
+# manifest mechanics
+
+
+class TestManifest:
+    def test_save_writes_certifying_manifest(self, tmp_path):
+        d = str(tmp_path)
+        utils.save_checkpoint(d, 5, {"x": jnp.arange(8, dtype=jnp.float32)})
+        m = ckpt.read_manifest(d, 5)
+        assert m["step"] == 5 and m["format"] == ckpt.MANIFEST_FORMAT
+        assert m["nbytes"] == os.path.getsize(ckpt._path(d, 5))
+        assert ckpt.verify_checkpoint(d, 5)
+        assert ckpt.verified_steps(d) == [5]
+
+    def test_prune_removes_manifests_and_is_idempotent(self, tmp_path):
+        d = str(tmp_path)
+        for s in range(5):
+            utils.save_checkpoint(d, s, {"x": jnp.ones(2)}, keep=2)
+        assert utils.available_steps(d) == [3, 4]
+        assert ckpt.verified_steps(d) == [3, 4]
+        assert not os.path.exists(ckpt._manifest_path(d, 0))
+        # concurrent prune already removed a path save is about to prune:
+        # the suppress(FileNotFoundError) keeps save alive
+        os.unlink(ckpt._path(d, 3))
+        os.unlink(ckpt._manifest_path(d, 3))
+        utils.save_checkpoint(d, 9, {"x": jnp.ones(2)}, keep=1)
+        assert utils.available_steps(d) == [9]
+
+    def test_legacy_checkpoint_without_manifest_still_loads(self, tmp_path):
+        from flax import serialization
+
+        d = str(tmp_path)
+        os.makedirs(d, exist_ok=True)
+        with open(ckpt._path(d, 3), "wb") as f:
+            f.write(serialization.to_bytes({"x": np.full((2,), 3.0,
+                                                         np.float32)}))
+        tree, step = utils.load_checkpoint(d, {"x": jnp.zeros(2)})
+        assert step == 3
+        np.testing.assert_allclose(np.asarray(tree["x"]), 3.0)
+        assert not ckpt.verify_checkpoint(d, 3)  # loadable, not certified
+
+    def test_tree_hash_stable_and_shape_sensitive(self):
+        a = {"x": np.zeros((2, 3), np.float32)}
+        b = {"x": np.ones((2, 3), np.float32)}   # same structure
+        c = {"x": np.zeros((3, 2), np.float32)}  # different shape
+        assert (ckpt.tree_structure_hash(a)
+                == ckpt.tree_structure_hash(b))
+        assert (ckpt.tree_structure_hash(a)
+                != ckpt.tree_structure_hash(c))
+
+    def test_manifest_json_is_strict(self, tmp_path):
+        d = str(tmp_path)
+        utils.save_checkpoint(d, 1, {"x": jnp.ones(2)})
+        with open(ckpt._manifest_path(d, 1)) as f:
+            m = json.load(f)  # parses strictly
+        assert set(m) >= {"format", "step", "nbytes", "crc32", "tree_hash"}

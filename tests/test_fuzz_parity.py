@@ -32,8 +32,8 @@ def test_bn_fuzz_vs_torch(trial):
             b_np = rng.uniform(-0.5, 0.5, c).astype(np.float32)
             tbn.weight.copy_(torch.from_numpy(w_np))
             tbn.bias.copy_(torch.from_numpy(b_np))
-        bn.weight[...] = jnp.asarray(w_np)
-        bn.bias[...] = jnp.asarray(b_np)
+        bn.weight.value = jnp.asarray(w_np)
+        bn.bias.value = jnp.asarray(b_np)
 
     for s in range(steps):
         x = (rng.randn(b, h, w, c) * rng.uniform(0.5, 3)
@@ -127,7 +127,7 @@ def test_psum_in_groups_fuzz_random_partitions(trial):
     gather): every replica must receive its own group's exact sum, for
     any membership — the full torch process_group space."""
     import jax
-    from jax import shard_map
+    from tpu_syncbn.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from tpu_syncbn import runtime

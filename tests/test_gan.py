@@ -8,6 +8,7 @@ import optax
 import pytest
 from flax import nnx
 
+from tpu_syncbn import compat
 from tpu_syncbn import nn as tnn, parallel
 from tpu_syncbn.models import gan
 from tpu_syncbn.parallel.gan_trainer import GANTrainer
@@ -42,7 +43,7 @@ def test_snconv_normalizes_spectral_norm():
     """After SN, the effective kernel's top singular value ≈ 1."""
     conv = gan.SNConv(3, 8, (3, 3), (1, 1), nnx.Rngs(0))
     # scale the kernel up so sigma is clearly > 1 pre-normalization
-    conv.conv.kernel[...] = conv.conv.kernel[...] * 10.0
+    conv.conv.kernel.value = conv.conv.kernel[...] * 10.0
     x = jnp.zeros((1, 8, 8, 3))
     for _ in range(30):  # power iteration converges across forwards
         conv(x)
@@ -152,7 +153,7 @@ def test_snconv_gradient_flows_through_sigma():
     instead."""
     conv = gan.SNConv(2, 1, (1, 1), (1, 1), nnx.Rngs(0), padding="VALID")
     w = np.asarray([3.0, 4.0], np.float32)  # |w| = 5
-    conv.conv.kernel[...] = jnp.asarray(w.reshape(1, 1, 2, 1))
+    conv.conv.kernel.value = jnp.asarray(w.reshape(1, 1, 2, 1))
     # converge power iteration (rank-1: converges immediately)
     x = jnp.zeros((1, 1, 1, 2))
     for _ in range(3):
@@ -161,7 +162,7 @@ def test_snconv_gradient_flows_through_sigma():
     c = np.asarray([1.0, 0.0], np.float32)
 
     def f(p):
-        m = nnx.merge(graphdef, p, rest, copy=True)
+        m = compat.nnx_merge(graphdef, p, rest, copy=True)
         m.eval()
         kernel = m.conv.kernel[...]
         w2 = kernel.reshape(-1, 1)

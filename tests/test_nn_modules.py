@@ -8,7 +8,8 @@ import numpy as np
 import pytest
 import torch
 from flax import nnx
-from jax import shard_map
+from tpu_syncbn import compat
+from tpu_syncbn.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from tpu_syncbn import nn as tnn
@@ -68,8 +69,8 @@ class _Tower(nnx.Module):
     def __init__(self):
         self.conv = nnx.Conv(C, C, (1, 1), rngs=nnx.Rngs(0))
         self.bn = tnn.BatchNorm2d(C)
-        self.blocks = nnx.List([tnn.BatchNorm2d(C), tnn.BatchNorm2d(C)])
-        self.named = nnx.Dict({"head": tnn.BatchNorm1d(C)})
+        self.blocks = compat.nnx_list([tnn.BatchNorm2d(C), tnn.BatchNorm2d(C)])
+        self.named = compat.nnx_dict({"head": tnn.BatchNorm1d(C)})
 
     def __call__(self, x):
         x = self.conv(x)
@@ -82,8 +83,8 @@ class _Tower(nnx.Module):
 def test_convert_sync_batchnorm_tree_rewrite():
     m = _Tower()
     # move state so we can check it is carried over by reference
-    m.bn.running_mean[...] = jnp.full((C,), 2.5)
-    m.bn.weight[...] = jnp.full((C,), 1.5)
+    m.bn.running_mean.value = jnp.full((C,), 2.5)
+    m.bn.weight.value = jnp.full((C,), 1.5)
     m.eval()
     old_weight_var = m.bn.weight
     old_rm_var = m.bn.running_mean
@@ -226,7 +227,7 @@ _BNPair = collections.namedtuple("_BNPair", "a b")
 class _WithNamedTuple(nnx.Module):
     def __init__(self):
         # nnx requires explicit nnx.data() for module-bearing namedtuples
-        self.pair = nnx.data(_BNPair(tnn.BatchNorm2d(C), tnn.BatchNorm2d(C)))
+        self.pair = compat.nnx_data(_BNPair(tnn.BatchNorm2d(C), tnn.BatchNorm2d(C)))
 
 
 def test_convert_namedtuple_attr():
@@ -248,7 +249,7 @@ def test_syncbn_group_size_syncs_within_subgroups():
 
     f = jax.jit(
         shard_map(
-            lambda st, xs: nnx.merge(graphdef, st, copy=True)(xs),
+            lambda st, xs: compat.nnx_merge(graphdef, st, copy=True)(xs),
             mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"),
         )
     )
@@ -286,7 +287,7 @@ def test_syncbn_arbitrary_group_partition_golden():
 
     f = jax.jit(
         shard_map(
-            lambda st, xs: nnx.merge(graphdef, st, copy=True)(xs),
+            lambda st, xs: compat.nnx_merge(graphdef, st, copy=True)(xs),
             mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"),
         )
     )
@@ -313,7 +314,7 @@ def test_group_size_must_divide_world():
     sbn = tnn.SyncBatchNorm(C, group_size=3, track_running_stats=False)
     graphdef, state = nnx.split(sbn)
     f = shard_map(
-        lambda st, xs: nnx.merge(graphdef, st, copy=True)(xs),
+        lambda st, xs: compat.nnx_merge(graphdef, st, copy=True)(xs),
         mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"),
     )
     with pytest.raises(ValueError, match="must divide"):
@@ -352,7 +353,7 @@ def test_grouped_sync_butterfly_collectives():
     graphdef, state = nnx.split(sbn)
     f = jax.jit(
         shard_map(
-            lambda st, xs: nnx.merge(graphdef, st, copy=True)(xs),
+            lambda st, xs: compat.nnx_merge(graphdef, st, copy=True)(xs),
             mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"),
             check_vma=False,
         )

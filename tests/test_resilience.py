@@ -1,0 +1,298 @@
+"""Unit tests for the resilience primitives (runtime/resilience.py) and
+their satellites: deterministic backoff, watchdog diagnostics, preemption
+flag mechanics, event counters, and the rendezvous-retry wiring in
+runtime.distributed.initialize."""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from tpu_syncbn.runtime import distributed as dist
+from tpu_syncbn.runtime import resilience
+from tpu_syncbn.utils.metrics import EventCounter
+
+pytestmark = pytest.mark.fault
+
+
+class TestBackoff:
+    def test_delays_deterministic_for_key(self):
+        a = resilience.backoff_delays(5, base_s=1.0, key="host0")
+        b = resilience.backoff_delays(5, base_s=1.0, key="host0")
+        assert a == b
+        assert len(a) == 4
+
+    def test_jitter_differs_across_keys(self):
+        a = resilience.backoff_delays(5, base_s=1.0, key="host0")
+        b = resilience.backoff_delays(5, base_s=1.0, key="host1")
+        assert a != b  # de-synchronized retry storms
+
+    def test_exponential_capped_and_bounded_jitter(self):
+        delays = resilience.backoff_delays(
+            6, base_s=1.0, max_s=4.0, jitter=0.25, key="k"
+        )
+        for i, d in enumerate(delays):
+            nominal = min(4.0, 2.0 ** i)
+            assert nominal * 0.75 <= d <= nominal * 1.25
+
+    def test_retry_succeeds_after_failures(self):
+        calls, sleeps = [], []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("coordinator not up")
+            return "joined"
+
+        out = resilience.retry_with_backoff(
+            flaky, attempts=4, base_s=0.5, key="h", sleep=sleeps.append
+        )
+        assert out == "joined" and len(calls) == 3
+        assert sleeps == resilience.backoff_delays(4, base_s=0.5, key="h")[:2]
+
+    def test_retry_exhaustion_reraises_last(self):
+        def always():
+            raise TimeoutError("never")
+
+        with pytest.raises(TimeoutError, match="never"):
+            resilience.retry_with_backoff(
+                always, attempts=3, base_s=0.01, sleep=lambda s: None
+            )
+
+    def test_retry_does_not_catch_unlisted(self):
+        def boom():
+            raise KeyboardInterrupt
+
+        with pytest.raises(KeyboardInterrupt):
+            resilience.retry_with_backoff(boom, attempts=5,
+                                          sleep=lambda s: None)
+
+
+class TestRendezvousRetry:
+    def test_initialize_retries_rendezvous(self, monkeypatch):
+        import jax
+
+        attempts = []
+
+        def fake_init(**kwargs):
+            attempts.append(kwargs)
+            if len(attempts) < 2:
+                raise RuntimeError("DNS not ready")
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        monkeypatch.setattr(jax.distributed, "shutdown", lambda: None)
+        monkeypatch.setattr(resilience.time, "sleep", lambda s: None)
+        dist.shutdown()
+        try:
+            dist.initialize(
+                dist.DistributedConfig(
+                    coordinator_address="127.0.0.1:1", num_processes=2,
+                    process_id=0,
+                ),
+                rendezvous_attempts=3,
+                rendezvous_backoff_s=0.01,
+            )
+            assert len(attempts) == 2  # failed once, then joined
+            assert dist.is_initialized()
+        finally:
+            # fake jax.distributed state: reset our module flags only
+            monkeypatch.setattr(jax.distributed, "shutdown", lambda: None)
+            dist.shutdown()
+
+    def test_initialize_timeout_forwarded_when_supported(self, monkeypatch):
+        import jax
+
+        seen = {}
+
+        def fake_init(coordinator_address=None, num_processes=None,
+                      process_id=None, initialization_timeout=300):
+            seen["timeout"] = initialization_timeout
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        dist.shutdown()
+        try:
+            dist.initialize(
+                dist.DistributedConfig(
+                    coordinator_address="127.0.0.1:1", num_processes=2,
+                    process_id=0,
+                ),
+                rendezvous_timeout_s=42,
+            )
+            assert seen["timeout"] == 42
+        finally:
+            monkeypatch.setattr(jax.distributed, "shutdown", lambda: None)
+            dist.shutdown()
+
+    def test_env_knobs_resolve(self, monkeypatch):
+        import jax
+
+        attempts = []
+
+        def fake_init(**kwargs):
+            attempts.append(kwargs)
+            raise RuntimeError("down")
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        monkeypatch.setattr(jax.distributed, "shutdown", lambda: None)
+        monkeypatch.setattr(resilience.time, "sleep", lambda s: None)
+        monkeypatch.setenv("TPU_SYNCBN_RENDEZVOUS_ATTEMPTS", "5")
+        monkeypatch.setenv("TPU_SYNCBN_RENDEZVOUS_BACKOFF_S", "0.01")
+        dist.shutdown()
+        try:
+            with pytest.raises(RuntimeError, match="down"):
+                dist.initialize(
+                    dist.DistributedConfig(
+                        coordinator_address="127.0.0.1:1",
+                        num_processes=2, process_id=0,
+                    )
+                )
+            assert len(attempts) == 5
+        finally:
+            dist.shutdown()
+
+    def test_single_host_never_touches_rendezvous(self, monkeypatch):
+        import jax
+
+        def explode(**kwargs):
+            raise AssertionError("rendezvous must not run single-host")
+
+        monkeypatch.setattr(jax.distributed, "initialize", explode)
+        dist.shutdown()
+        try:
+            dist.initialize()  # single host: flag-only
+            assert dist.is_initialized()
+        finally:
+            dist.shutdown()
+
+
+class TestPreemptionGuard:
+    def test_flag_set_and_handlers_restored(self):
+        before = signal.getsignal(signal.SIGUSR1)
+        with resilience.PreemptionGuard(signals=(signal.SIGUSR1,)) as g:
+            assert not g.preempted
+            os.kill(os.getpid(), signal.SIGUSR1)
+            assert g.wait(2) and g.preempted
+        assert signal.getsignal(signal.SIGUSR1) is before
+
+    def test_callback_invoked(self):
+        got = []
+        with resilience.PreemptionGuard(
+            signals=(signal.SIGUSR1,), callback=got.append
+        ) as g:
+            os.kill(os.getpid(), signal.SIGUSR1)
+            g.wait(2)
+        assert got == [signal.SIGUSR1]
+
+
+class TestWatchdog:
+    def test_stall_dumps_diagnostics_and_fires_callback(self):
+        stalls = []
+        with resilience.Watchdog(0.15, name="unit", on_stall=stalls.append) as w:
+            time.sleep(0.6)
+        assert w.stall_count >= 1
+        assert stalls and "WATCHDOG" in stalls[0]
+        assert "thread" in stalls[0]  # per-thread stacks present
+
+    def test_pat_keeps_it_quiet(self):
+        stalls = []
+        with resilience.Watchdog(0.3, on_stall=stalls.append) as w:
+            for _ in range(6):
+                time.sleep(0.05)
+                w.pat()
+        assert w.stall_count == 0 and not stalls
+
+    def test_one_dump_per_stall_not_per_poll(self):
+        stalls = []
+        with resilience.Watchdog(
+            0.1, on_stall=stalls.append, poll_s=0.02
+        ) as w:
+            time.sleep(0.5)  # several polls past the deadline
+        assert w.stall_count == 1 == len(stalls)
+
+    def test_start_unarmed_waits_for_first_pat(self):
+        stalls = []
+        with resilience.Watchdog(
+            0.15, on_stall=stalls.append, start_armed=False, poll_s=0.02
+        ) as w:
+            time.sleep(0.5)           # cold start (compile): no stall
+            assert w.stall_count == 0
+            w.pat()                   # armed now
+            time.sleep(0.5)           # idle past deadline: real stall
+        assert w.stall_count == 1 and len(stalls) == 1
+
+    def test_abandoned_stall_guard_stops_pulling_source(self):
+        pulled = []
+
+        def source():
+            for i in range(100):
+                pulled.append(i)
+                yield i
+
+        g = resilience.stall_guard(source(), deadline_s=5)
+        assert next(g) == 0
+        g.close()  # consumer abandons (or StallError propagated)
+        time.sleep(0.5)  # would keep pulling without the stop flag
+        # item 0 consumed + one queued + one blocked in-flight put, and
+        # NOTHING more once the consumer is gone
+        assert len(pulled) <= 3
+
+    def test_rejects_bad_deadline(self):
+        with pytest.raises(ValueError, match="deadline"):
+            resilience.Watchdog(0)
+
+    def test_dump_stacks_mentions_host_identity(self):
+        d = resilience.dump_stacks("hdr")
+        assert d.startswith("hdr")
+        assert "host 0/1" in d
+
+
+class TestEventCounter:
+    def test_bump_count_summary(self):
+        c = EventCounter()
+        assert c.count("x") == 0
+        assert c.bump("x") == 1
+        assert c.bump("x", 2) == 3
+        c.bump("y")
+        assert c.summary() == {"x": 3, "y": 1}
+
+    def test_thread_safety(self):
+        c = EventCounter()
+
+        def work():
+            for _ in range(1000):
+                c.bump("n")
+
+        ts = [threading.Thread(target=work) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert c.count("n") == 8000
+
+
+class TestResilientLoopValidation:
+    def test_rejects_bad_ckpt_every(self, tmp_path):
+        with pytest.raises(ValueError, match="ckpt_every"):
+            resilience.ResilientLoop(object(), str(tmp_path), ckpt_every=0)
+
+    def test_trainer_rejects_bad_guard_policy(self):
+        import optax
+        from flax import nnx
+
+        from tpu_syncbn import nn as tnn, parallel
+
+        class Net(nnx.Module):
+            def __init__(self, rngs):
+                self.fc = nnx.Linear(2, 2, rngs=rngs)
+
+            def __call__(self, x):
+                return self.fc(x)
+
+        with pytest.raises(ValueError, match="divergence_guard"):
+            parallel.DataParallel(
+                Net(nnx.Rngs(0)), optax.sgd(0.1),
+                lambda m, b: (m(b[0]) ** 2).mean(),
+                divergence_guard="explode",
+            )

@@ -10,6 +10,7 @@ import pytest
 import torch
 from flax import nnx
 
+from tpu_syncbn import compat
 from tpu_syncbn import nn as tnn, parallel
 from tpu_syncbn.models import detection as det
 from tpu_syncbn.models import retinanet as rn
@@ -109,6 +110,7 @@ def test_retinanet_forward_shapes():
     assert 0.005 < float(p.mean()) < 0.02
 
 
+@pytest.mark.slow  # spawn/compile-heavy: tier-1 runs against an 870s kill
 def test_retinanet_loss_and_grad_finite():
     model = _small_retinanet()
     B, M = 2, 4
@@ -124,7 +126,7 @@ def test_retinanet_loss_and_grad_finite():
     graphdef, params, rest = nnx.split(model, nnx.Param, ...)
 
     def loss_fn(p):
-        m = nnx.merge(graphdef, p, rest, copy=True)
+        m = compat.nnx_merge(graphdef, p, rest, copy=True)
         t, _ = m.loss(images, gt_boxes, gt_labels, gt_valid)
         return t
 

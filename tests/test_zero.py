@@ -102,6 +102,7 @@ def test_zero_matches_replicated_trajectory(opt_name):
     )
 
 
+@pytest.mark.slow  # spawn/compile-heavy: tier-1 runs against an 870s kill
 def test_zero_composes_with_accum_and_compression():
     mesh = mesh_of(4)
     batches = [make_batch(n=8, seed=s) for s in range(2)]

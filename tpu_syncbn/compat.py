@@ -1,0 +1,157 @@
+"""Version-compat shims: gate the few current-jax/flax APIs this codebase
+uses so the package *imports and degrades* instead of crashing on an older
+baked toolchain (observed container: jax 0.4.x / flax 0.10, where
+``jax.shard_map`` lives in ``jax.experimental.shard_map`` with a
+``check_rep`` flag instead of the VMA type system's ``check_vma``, and the
+``nnx.to_pure_dict`` module functions are still ``State`` methods).
+
+Robustness contract (docs/RESILIENCE.md): a missing optional API selects a
+documented fallback path once, at import; it never raises mid-step. The
+fallbacks are semantic no-ops for correctness-relevant behavior:
+
+* ``shard_map(check_vma=...)`` → legacy shard_map with ``check_rep=False``.
+  The VMA checker is an extra *validator*; legacy shard_map without
+  ``lax.pvary`` has no implicit varying-cast/psum insertion, so gradients
+  stay replica-local and the trainer's explicit ``pmean`` remains the one
+  aggregation (the round-1 "8x off" hazard does not exist on this path).
+* ``HAS_VMA=False`` additionally makes ``pcast_varying`` the identity —
+  there is no VMA type to cast.
+* ``nnx_merge(..., copy=True)`` falls back to plain ``nnx.merge`` (flax
+  versions without the kwarg construct fresh Variables already).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+#: True when this jax has the VMA (varying-manual-axes) type system —
+#: ``lax.pvary``/``lax.pcast`` and shard_map's ``check_vma``.
+HAS_VMA: bool = hasattr(jax.lax, "pvary")
+
+_NATIVE_SHARD_MAP: bool = hasattr(jax, "shard_map")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the ``check_vma`` kwarg, on any supported
+    jax. On pre-VMA jax the legacy ``jax.experimental.shard_map`` runs
+    with ``check_rep=False``: ``check_rep`` is a different (replication)
+    checker that several of our step programs legitimately fail — e.g.
+    per-replica buffer storage — and the VMA-cast machinery that keeps
+    the modern checker satisfied is an identity here (``HAS_VMA``)."""
+    if _NATIVE_SHARD_MAP:
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as legacy_shard_map
+
+    return legacy_shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        # check_rep=False unconditionally: the legacy checker neither
+        # fixes the legacy transpose limitation for replicated args
+        # (tested) nor accepts all our step programs; the modern
+        # checker's guarantees simply don't exist on this toolchain
+        check_rep=False,
+    )
+
+
+def axis_size(axis_name: str):
+    """``lax.axis_size`` where available; otherwise the classic
+    ``psum(1, axis)`` identity (folded to a static constant at trace
+    time — no runtime collective)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def vma_of(x) -> frozenset:
+    """The VMA (varying axes) set of a traced value; empty on pre-VMA
+    jax, where every value is effectively unvarying."""
+    if not hasattr(jax, "typeof"):
+        return frozenset()
+    return getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+
+
+def nnx_list(items):
+    """``nnx.List`` where flax has it; a plain Python list otherwise
+    (older nnx registers plain lists as graph nodes, so child modules
+    and their params stay visible to split/merge either way)."""
+    from flax import nnx
+
+    if hasattr(nnx, "List"):
+        return nnx.List(items)
+    return list(items)
+
+
+def nnx_dict(mapping):
+    """``nnx.Dict`` where flax has it; a plain dict otherwise (older nnx
+    registers plain dicts as graph nodes)."""
+    from flax import nnx
+
+    if hasattr(nnx, "Dict"):
+        return nnx.Dict(mapping)
+    return dict(mapping)
+
+
+def nnx_data(value):
+    """``nnx.data`` (explicit data-attribute annotation on current flax)
+    — identity on older flax, which treats container attributes as graph
+    data without annotation."""
+    from flax import nnx
+
+    if hasattr(nnx, "data"):
+        return nnx.data(value)
+    return value
+
+
+_MERGE_HAS_COPY: bool | None = None
+
+
+def nnx_merge(graphdef, *states, copy: bool = True):
+    """``nnx.merge`` forwarding ``copy=`` only where flax supports it
+    (the kwarg exists to force fresh trace-local Variables on flax
+    versions whose merge aliases the originals; older merges already
+    materialize fresh Variables). Support is probed from the signature
+    once — NOT by catching TypeError, which would silently retry a merge
+    whose *real* failure was elsewhere and reintroduce the aliasing bug
+    ``copy=True`` exists to prevent."""
+    import inspect
+
+    from flax import nnx
+
+    global _MERGE_HAS_COPY
+    if _MERGE_HAS_COPY is None:
+        try:
+            params = inspect.signature(nnx.merge).parameters
+            _MERGE_HAS_COPY = "copy" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values()
+            )
+        except (TypeError, ValueError):
+            _MERGE_HAS_COPY = True  # unsignaturable: assume modern flax
+    if _MERGE_HAS_COPY:
+        return nnx.merge(graphdef, *states, copy=copy)
+    return nnx.merge(graphdef, *states)
+
+
+def nnx_to_pure_dict(state) -> Any:
+    """``nnx.to_pure_dict`` (module function on current flax, ``State``
+    method on older)."""
+    from flax import nnx
+
+    if hasattr(nnx, "to_pure_dict"):
+        return nnx.to_pure_dict(state)
+    return state.to_pure_dict()
+
+
+def nnx_replace_by_pure_dict(state, pure) -> None:
+    """``nnx.replace_by_pure_dict`` (module function on current flax,
+    ``State`` method on older). Mutates ``state`` in place."""
+    from flax import nnx
+
+    if hasattr(nnx, "replace_by_pure_dict"):
+        nnx.replace_by_pure_dict(state, pure)
+    else:
+        state.replace_by_pure_dict(pure)

@@ -152,20 +152,31 @@ def _consume_ordered(out_queues, dispatch_error, *, epoch=0, idle_check=None):
 
 
 def _close_pool(pool) -> None:
-    """Terminate a process-worker pool (GC finalizer / explicit close)."""
+    """Terminate a process-worker pool. Reached from THREE owners —
+    explicit ``close()``, the ``weakref.finalize`` GC/atexit finalizer,
+    and interpreter shutdown — so it must be idempotent and must not
+    assume queue liveness (a dead worker's queue can already be closed);
+    a cleanup path that can crash orphans the very workers it exists to
+    reap."""
+    if pool.get("closed"):
+        return
+    pool["closed"] = True
     for q in pool["index_queues"]:
         try:
             q.put_nowait(("stop",))
-        except queue.Full:
-            pass
+        except (queue.Full, ValueError, OSError):
+            pass  # full, or queue already closed
     for p in pool["procs"]:
         p.join(timeout=0.5)
         if p.is_alive():
             p.terminate()
             p.join(timeout=5)
     for q in (*pool["index_queues"], *pool["out_queues"]):
-        q.cancel_join_thread()
-        q.close()
+        try:
+            q.cancel_join_thread()
+            q.close()
+        except (ValueError, OSError):
+            pass
 
 
 def default_collate(samples: Sequence[Any]):
@@ -332,11 +343,21 @@ class DataLoader:
         return pool
 
     def close(self) -> None:
-        """Shut down persistent process workers (no-op otherwise)."""
+        """Shut down persistent process workers. Idempotent: double
+        close, close-after-GC-finalize, and close on a thread-mode loader
+        (which has no pool) are all safe no-ops. A loader dropped
+        *without* close() is reaped by the ``weakref.finalize`` installed
+        at pool spawn (which also runs at interpreter exit), so abandoned
+        loaders never orphan worker processes."""
         if self._pool is not None:
-            self._pool_finalizer.detach()
+            if self._pool_finalizer is not None:
+                # detach() is None-safe and False when the finalizer
+                # already ran (GC beat us): _close_pool is idempotent
+                # either way
+                self._pool_finalizer.detach()
             _close_pool(self._pool)
             self._pool = None
+            self._pool_finalizer = None
 
     def _iter_processes(self):
         """The reference's worker-process model (``README.md:87``): same

@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 from flax import nnx
 
+from tpu_syncbn import compat
+
 from tpu_syncbn.nn import BatchNorm2d
 
 _g_init = nnx.initializers.normal(0.02)  # DCGAN init
@@ -55,7 +57,7 @@ class SNConv(nnx.Module):
         u_new = w2_sg.T @ v
         u_new = u_new / (jnp.linalg.norm(u_new) + 1e-12)
         if not self.use_running_average:
-            self.u[...] = u_new
+            self.u.value = u_new
         # ...but sigma = v^T W u keeps the gradient path THROUGH W, exactly
         # torch.nn.utils.spectral_norm (only u/v are detached there)
         sigma = v @ w2 @ u_new
@@ -78,7 +80,7 @@ class DCGANGenerator(nnx.Module):
         self.latent_dim = latent_dim
         self.fc = nnx.Linear(latent_dim, 4 * 4 * width, kernel_init=_g_init, rngs=rngs)
         self.bn0 = BatchNorm2d(width)
-        self.deconvs = nnx.List([
+        self.deconvs = compat.nnx_list([
             nnx.ConvTranspose(width, width // 2, (4, 4), strides=(2, 2),
                               padding="SAME", kernel_init=_g_init, rngs=rngs),
             nnx.ConvTranspose(width // 2, width // 4, (4, 4), strides=(2, 2),
@@ -86,7 +88,7 @@ class DCGANGenerator(nnx.Module):
             nnx.ConvTranspose(width // 4, width // 4, (4, 4), strides=(2, 2),
                               padding="SAME", kernel_init=_g_init, rngs=rngs),
         ])
-        self.bns = nnx.List([
+        self.bns = compat.nnx_list([
             BatchNorm2d(width // 2),
             BatchNorm2d(width // 4),
             BatchNorm2d(width // 4),

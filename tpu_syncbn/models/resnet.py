@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from flax import nnx
 
+from tpu_syncbn import compat
+
 from tpu_syncbn.nn import BatchNorm2d
 
 # torch resnet uses Kaiming/He fan-out normal for convs
@@ -131,8 +133,8 @@ class ResNet(nnx.Module):
                           dtype=dtype)
                 )
                 cin = planes * block.expansion
-            stages.append(nnx.List(blocks))
-        self.stages = nnx.List(stages)
+            stages.append(compat.nnx_list(blocks))
+        self.stages = compat.nnx_list(stages)
         self.fc = nnx.Linear(
             cin, num_classes,
             kernel_init=nnx.initializers.normal(0.01),

@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from flax import nnx
 
+from tpu_syncbn import compat
+
 from tpu_syncbn.models import detection as det
 from tpu_syncbn.models.resnet import ResNet, Bottleneck, _conv_init
 
@@ -44,11 +46,11 @@ class FPN(nnx.Module):
     relu(P6) — torchvision LastLevelP6P7)."""
 
     def __init__(self, in_channels: tuple[int, int, int], out_channels: int, rngs):
-        self.lateral = nnx.List([
+        self.lateral = compat.nnx_list([
             nnx.Conv(c, out_channels, (1, 1), kernel_init=_conv_init, rngs=rngs)
             for c in in_channels
         ])
-        self.output = nnx.List([
+        self.output = compat.nnx_list([
             _conv3(out_channels, out_channels, rngs) for _ in in_channels
         ])
         self.p6 = nnx.Conv(
@@ -76,10 +78,10 @@ class RetinaHead(nnx.Module):
     """Shared classification/regression subnets (4 conv256 + output)."""
 
     def __init__(self, channels: int, num_anchors: int, num_classes: int, rngs):
-        self.cls_tower = nnx.List(
+        self.cls_tower = compat.nnx_list(
             [_conv3(channels, channels, rngs) for _ in range(4)]
         )
-        self.box_tower = nnx.List(
+        self.box_tower = compat.nnx_list(
             [_conv3(channels, channels, rngs) for _ in range(4)]
         )
         # focal-loss prior: bias so initial P(fg) ≈ 0.01 (RetinaNet paper)

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpu_syncbn.compat import axis_size as _compat_axis_size
 from tpu_syncbn.parallel.sequence import (
     _single_device_attention,
     ring_attention,
@@ -158,7 +159,7 @@ def transformer_lm(
         # dynamic_slice CLAMPS an out-of-range start, which would silently
         # reuse trailing positions on far shards — check at trace time
         # (axis_size is static) instead
-        n_shards = 1 if axis_name is None else lax.axis_size(axis_name)
+        n_shards = 1 if axis_name is None else _compat_axis_size(axis_name)
         if n_shards * l > max_len:
             raise ValueError(
                 f"global sequence {n_shards * l} exceeds max_len {max_len}"

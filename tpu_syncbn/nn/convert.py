@@ -102,13 +102,16 @@ def convert_sync_batchnorm(
         if not isinstance(node, nnx.Module) or id(node) in seen:
             continue
         seen.add(id(node))
-        if isinstance(node, nnx.List):
+        if isinstance(node, getattr(nnx, "List", ())):
+            # flax without nnx.List registers plain Python lists as graph
+            # nodes; those are rewritten through the owning module's
+            # vars() walk below instead
             for i in range(len(node)):
                 new = _swap_in_container(node[i], axis_name, group_size)
                 if new is not node[i]:
                     node[i] = new
             continue
-        if isinstance(node, nnx.Dict):
+        if isinstance(node, getattr(nnx, "Dict", ())):
             for k in list(node):
                 new = _swap_in_container(node[k], axis_name, group_size)
                 if new is not node[k]:

@@ -37,8 +37,10 @@ def _axis_in_scope(axis_name: str) -> bool:
     we are inside shard_map/pmap over it) — the analogue of the reference's
     ``need_sync = training and dist.is_initialized() and world > 1`` check
     (``[torch] nn/modules/batchnorm.py:837-860``)."""
+    from tpu_syncbn import compat
+
     try:
-        jax.lax.axis_size(axis_name)
+        compat.axis_size(axis_name)
         return True
     except (NameError, KeyError):
         return False
@@ -160,9 +162,12 @@ class BatchNorm(nnx.Module):
             mask=mask,
         )
         if self.track_running_stats:
-            self.running_mean[...] = new_rm
-            self.running_var[...] = new_rv
-            self.num_batches_tracked[...] = new_nbt
+            # .value assignment (not var[...] = x): portable across
+            # flax versions whose Variable.__setitem__ writes through to
+            # the (immutable) jax array instead of rebinding it
+            self.running_mean.value = new_rm
+            self.running_var.value = new_rv
+            self.num_batches_tracked.value = new_nbt
         return y
 
 

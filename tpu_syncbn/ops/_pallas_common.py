@@ -24,7 +24,9 @@ def sds(shape, dtype, like: jax.Array):
     """ShapeDtypeStruct whose varying-axes type matches ``like``: inside
     a ``check_vma=True`` shard_map, pallas_call outputs must declare
     their vma explicitly or lowering fails."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    from tpu_syncbn import compat
+
+    vma = compat.vma_of(like)
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

@@ -1,7 +1,12 @@
 """Parallelism layer: collectives over mesh axes and data-parallel training
 utilities (the reference's L2+L3: NCCL process group + DDP wrapper)."""
 
-from tpu_syncbn.parallel.trainer import DataParallel, StepOutput, sync_module_states
+from tpu_syncbn.parallel.trainer import (
+    DataParallel,
+    StepOutput,
+    resume_latest,
+    sync_module_states,
+)
 from tpu_syncbn.parallel.gan_trainer import GANTrainer, GANStepOutput
 from tpu_syncbn.parallel.collectives import (
     axis_index,
@@ -48,6 +53,7 @@ __all__ = [
     "GANStepOutput",
     "DataParallel",
     "StepOutput",
+    "resume_latest",
     "sync_module_states",
     "axis_index",
     "axis_size",

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpu_syncbn.compat import axis_size as _compat_axis_size
 from tpu_syncbn.runtime.distributed import DATA_AXIS
 
 Pytree = Any
@@ -42,7 +43,7 @@ Pytree = Any
 def axis_size(axis_name: str = DATA_AXIS) -> int:
     """World size along a mesh axis — the reference's ``world_size``
     (``README.md:33``), available inside the compiled step."""
-    return lax.axis_size(axis_name)
+    return _compat_axis_size(axis_name)
 
 
 def axis_index(axis_name: str = DATA_AXIS) -> jax.Array:
@@ -95,7 +96,7 @@ def broadcast(tree: Pytree, src: int = 0, axis_name: str = DATA_AXIS) -> Pytree:
     SPMD formulation: gather all replicas' values and select ``src``'s.
     XLA folds the gather+index; for the init-time use the cost is a one-off.
     """
-    size = lax.axis_size(axis_name)  # static at trace time
+    size = _compat_axis_size(axis_name)  # static at trace time
     if not -size <= src < size:
         raise ValueError(
             f"broadcast src={src} out of range for axis {axis_name!r} of size {size}"
@@ -117,6 +118,11 @@ def pcast_varying(tree: Pytree, axis_name: str = DATA_AXIS) -> Pytree:
     stats stay varying). Shared home for the VMA-cast used by the
     trainers and the sequence-parallel scan carries — one place to adapt
     if jax's vma/pcast API shifts again."""
+
+    from tpu_syncbn import compat
+
+    if not compat.HAS_VMA:
+        return tree  # pre-VMA jax: no varying type to cast to
 
     def leaf(x):
         if axis_name in getattr(jax.typeof(x), "vma", frozenset()):
@@ -279,7 +285,7 @@ def psum_in_groups(
     groups prefer ``group_size=None`` (full-world psum) or an explicit
     unequal partition (which takes the gather path).
     """
-    world = lax.axis_size(axis_name)
+    world = _compat_axis_size(axis_name)
     group_size = normalize_group_spec(group_size)
     if isinstance(group_size, int):
         if group_size < 1 or world % group_size:
@@ -359,7 +365,7 @@ def ring_all_reduce(
     (ring attention passes KV blocks around the same neighbor cycle
     while overlapping compute — SURVEY §5.7's extension point).
     """
-    n = lax.axis_size(axis_name)
+    n = _compat_axis_size(axis_name)
     if n == 1:
         return x
     orig_shape = x.shape

@@ -25,6 +25,7 @@ gradients in ``tests/test_expert_parallel.py``.
 from __future__ import annotations
 
 import jax
+from tpu_syncbn.compat import axis_size as _compat_axis_size
 import jax.numpy as jnp
 from jax import lax
 
@@ -123,7 +124,7 @@ def expert_parallel_moe(
     exactly :func:`dense_moe` per shard. Returns ``(y_local, aux)`` with
     aux ``pmean``'d across the axis.
     """
-    n = lax.axis_size(axis_name)
+    n = _compat_axis_size(axis_name)
     t, d = x.shape
     e_local = w_in.shape[0]
     e = router_w.shape[-1]

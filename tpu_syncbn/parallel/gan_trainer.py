@@ -25,8 +25,10 @@ import jax
 import jax.numpy as jnp
 import optax
 from flax import nnx
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_syncbn import compat
+from tpu_syncbn.compat import shard_map
 
 from tpu_syncbn.models.gan import bce_gan_losses, hinge_gan_losses
 from tpu_syncbn.parallel import collectives
@@ -80,7 +82,7 @@ class GANTrainer:
         # same contract as DataParallel: checker on unless pallas traces
         # for either network under the interpret lowering (snapshotted at
         # construction)
-        self._check_vma = not _pallas_forces_vma_off(
+        self._check_vma = compat.HAS_VMA and not _pallas_forces_vma_off(
             generator, discriminator
         )
 
@@ -107,11 +109,11 @@ class GANTrainer:
         def step(gp, gr, dp_, dr, og, od, real, z_d, z_g):
             # ---- D step ------------------------------------------------
             def d_loss_fn(dp_in, gr_in, dr_in):
-                G = nnx.merge(g_def, gp, gr_in, copy=True)
+                G = compat.nnx_merge(g_def, gp, gr_in, copy=True)
                 G.train()
                 fake = G(z_d)  # train-mode forward: G stats update
                 _, _, gr_out = nnx.split(G, nnx.Param, ...)
-                D = nnx.merge(d_def, dp_in, dr_in, copy=True)
+                D = compat.nnx_merge(d_def, dp_in, dr_in, copy=True)
                 D.train()
                 real_logits = D(real)
                 fake_logits = D(jax.lax.stop_gradient(fake))
@@ -133,11 +135,11 @@ class GANTrainer:
 
             # ---- G step ------------------------------------------------
             def g_loss_fn(gp_in, gr_in, dr_in):
-                G = nnx.merge(g_def, gp_in, gr_in, copy=True)
+                G = compat.nnx_merge(g_def, gp_in, gr_in, copy=True)
                 G.train()
                 fake = G(z_g)
                 _, _, gr_out = nnx.split(G, nnx.Param, ...)
-                D = nnx.merge(d_def, dp_, dr_in, copy=True)
+                D = compat.nnx_merge(d_def, dp_, dr_in, copy=True)
                 D.train()
                 fake_logits = D(fake)
                 _, _, dr_out = nnx.split(D, nnx.Param, ...)
@@ -222,7 +224,7 @@ class GANTrainer:
         """
         if getattr(self, "_gen_step", None) is None:
             def gen(gp, gr, zs):
-                G = nnx.merge(self.g_def, gp, gr, copy=True)
+                G = compat.nnx_merge(self.g_def, gp, gr, copy=True)
                 G.eval()
                 return G(zs)
 

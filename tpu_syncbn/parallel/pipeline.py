@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpu_syncbn.compat import axis_size as _compat_axis_size
 from tpu_syncbn.parallel.collectives import pcast_varying
 
 PIPE_AXIS = "pipe"
@@ -69,7 +70,7 @@ def pipeline_apply(
       ``P(axis, ...)`` on a leading stage axis and take the last row, or
       psum-mask — the array-level helper below does the latter).
     """
-    n = lax.axis_size(axis_name)
+    n = _compat_axis_size(axis_name)
     s = lax.axis_index(axis_name)
     m = microbatches.shape[0]
     mb_shape = microbatches.shape[1:]
@@ -118,13 +119,14 @@ def pipeline_parallel(
     ``microbatches`` is ``(M, mb, ...)``. The result is the true pipeline
     output (stage ``N-1``'s), extracted with a psum over a one-hot stage
     mask so the out-spec stays replicated."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from tpu_syncbn.compat import shard_map
 
     def shardwise(stacked_local, microbatches):
         params = jax.tree_util.tree_map(lambda x: x[0], stacked_local)
         acc = pipeline_apply(stage_fn, params, microbatches, axis_name)
-        n = lax.axis_size(axis_name)
+        n = _compat_axis_size(axis_name)
         is_last = lax.axis_index(axis_name) == n - 1
         return lax.psum(
             jnp.where(is_last, acc, jnp.zeros_like(acc)), axis_name

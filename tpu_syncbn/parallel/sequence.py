@@ -50,6 +50,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_syncbn.compat import axis_size as _compat_axis_size
 from tpu_syncbn.parallel.collectives import pcast_varying
 
 SEQ_AXIS = "seq"
@@ -107,7 +108,7 @@ def ring_attention(
     origin, so the result is identical to masking the full sequence.
     The loop is a ``lax.scan`` — compile size stays O(1) in world size.
     """
-    n = lax.axis_size(axis_name)
+    n = _compat_axis_size(axis_name)
     me = lax.axis_index(axis_name)
     b, l_q, h, d = q.shape
     qf = q.astype(jnp.float32) * _qk_scale(d, scale)
@@ -231,7 +232,7 @@ def ring_attention_zigzag(
     undo the layout with ``zigzag_unshard`` (as ``sharded_self_attention``
     does).
     """
-    n = lax.axis_size(axis_name)
+    n = _compat_axis_size(axis_name)
     me = lax.axis_index(axis_name)
     if n == 1:
         return _single_device_attention(q, k, v, causal=True, scale=scale)
@@ -380,7 +381,7 @@ def ulysses_attention(
         raise ValueError(
             "local_backward applies to local_impl='flash' only"
         )
-    n = lax.axis_size(axis_name)
+    n = _compat_axis_size(axis_name)
     h = q.shape[2]
     if local_impl == "flash":
         from tpu_syncbn.ops.pallas_attention import flash_attention
@@ -477,13 +478,15 @@ def sharded_self_attention(
     # checker off ONLY for the interpret lowering of the flash kernel
     # (hlo_interpreter dynamic_slice rejects check_vma=True around pallas
     # bodies on the CPU mesh); on TPU the checker stays on
-    check_vma = True
+    from tpu_syncbn import compat
+
+    check_vma = compat.HAS_VMA
     if local_impl == "flash":
         from tpu_syncbn.ops._pallas_common import interpret
 
-        check_vma = not interpret()
+        check_vma = check_vma and not interpret()
     seq_sharded = P(None, axis_name, None, None)
-    shard_fn = jax.shard_map(
+    shard_fn = compat.shard_map(
         fn,
         mesh=mesh,
         in_specs=(seq_sharded, seq_sharded, seq_sharded),

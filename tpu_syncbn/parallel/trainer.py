@@ -46,9 +46,10 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from flax import nnx
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_syncbn import compat
+from tpu_syncbn.compat import shard_map
 from tpu_syncbn.parallel import collectives
 from tpu_syncbn.parallel.collectives import pcast_varying as _pcast_varying
 from tpu_syncbn.runtime import distributed as dist
@@ -208,6 +209,7 @@ class DataParallel:
         remat: bool = False,
         grad_compression: str | None = None,
         zero: bool = False,
+        divergence_guard: str | None = None,
     ):
         """``remat=True`` rematerializes the forward during backward
         (``jax.checkpoint``) — trades ~1/3 more FLOPs for activation
@@ -232,9 +234,33 @@ class DataParallel:
         *elementwise* optimizer transforms (SGD/momentum/Adam/AdamW,
         schedules, per-leaf clipping); transforms needing a global view
         across parameters (``clip_by_global_norm``) would compute their
-        statistic per-shard and are unsupported under ``zero``."""
+        statistic per-shard and are unsupported under ``zero``.
+
+        ``divergence_guard`` (default ``None``) arms the on-device
+        non-finite guard (docs/RESILIENCE.md): every step computes a
+        world-consensus "loss and all grads finite" flag; a non-finite
+        step NEVER reaches the weights — params, optimizer state, and BN
+        buffers are rolled back to their pre-step values inside the
+        compiled step (an exact skip, not a zero-grad update: Adam
+        moments and step counts are untouched). The policy string picks
+        what else happens: ``"skip_step"`` nothing; ``"halve_lr"``
+        additionally halves a persistent update scale each non-finite
+        step (applied multiplicatively to every subsequent update);
+        ``"restore_last_good"`` behaves like skip on-device and signals
+        the host loop (``runtime.resilience.ResilientLoop``) to reload
+        the last verified checkpoint. The step's metrics gain
+        ``nonfinite`` (1.0 on a skipped step) and ``lr_scale``; the
+        occurrence count persists in the guard state (and therefore in
+        checkpoints)."""
         if accum_steps < 1:
             raise ValueError("accum_steps must be >= 1")
+        if divergence_guard not in (
+            None, "skip_step", "halve_lr", "restore_last_good"
+        ):
+            raise ValueError(
+                "divergence_guard must be None, 'skip_step', 'halve_lr', "
+                f"or 'restore_last_good', got {divergence_guard!r}"
+            )
         if grad_compression not in (None, "bf16"):
             raise ValueError(
                 f"grad_compression must be None or 'bf16', got {grad_compression!r}"
@@ -269,8 +295,10 @@ class DataParallel:
         # bodies. With the checker off, replication is guaranteed
         # structurally, exactly as in round 1. Snapshotted at
         # construction — set_pallas_mode() must be called before building
-        # the trainer (its docstring says so).
-        self._check_vma = not _pallas_forces_vma_off(model)
+        # the trainer (its docstring says so). On pre-VMA jax
+        # (compat.HAS_VMA False) there is no checker and no cast to
+        # drive: stay off.
+        self._check_vma = compat.HAS_VMA and not _pallas_forces_vma_off(model)
 
         self.zero = bool(zero)
         self.graphdef, params, rest = nnx.split(model, nnx.Param, ...)
@@ -324,6 +352,27 @@ class DataParallel:
             self.opt_state = jax.device_put(
                 optimizer.init(params), self._replicated
             )
+        self.divergence_guard = divergence_guard
+        if divergence_guard is not None:
+            # guard state rides inside opt_state so every existing code
+            # path (donation, scan carries, state_dict/load, shard specs)
+            # treats it as optimizer state — which semantically it is:
+            # per-update bookkeeping that must survive checkpoints
+            guard0 = jax.device_put(
+                {
+                    "lr_scale": jnp.ones((), jnp.float32),
+                    "nonfinite_count": jnp.zeros((), jnp.int32),
+                },
+                self._replicated,
+            )
+            self.opt_state = (self.opt_state, guard0)
+            if self.zero:
+                self._opt_spec = (
+                    self._opt_spec,
+                    {"lr_scale": P(), "nonfinite_count": P()},
+                )
+            # non-zero mode: _opt_spec is the single prefix spec P(),
+            # which covers the (opt_state, guard) tuple unchanged
         if broadcast_buffers:
             self.rest = jax.device_put(self.rest, self._replicated)
         else:
@@ -351,7 +400,7 @@ class DataParallel:
             # copy=True: fresh trace-local Variables, so BN's BatchStat
             # mutation happens at this trace level (nnx 0.12 merge
             # otherwise aliases the original module's variables)
-            model = nnx.merge(self.graphdef, p, r, copy=True)
+            model = compat.nnx_merge(self.graphdef, p, r, copy=True)
             model.train()
             out = self.loss_fn(model, b)
             loss, metrics = out if isinstance(out, tuple) else (out, {})
@@ -414,10 +463,15 @@ class DataParallel:
         axis = self.axis_name
 
         def step(pstore, rest, opt_state, batch):
+            guard_in = None
+            if self.divergence_guard is not None:
+                opt_state, guard_in = opt_state
+            pstore_in, opt_in = pstore, opt_state
             params = self._gather_params(pstore) if self.zero else pstore
             if not self.broadcast_buffers:
                 # per-replica storage: strip the local leading axis of 1
                 rest = jax.tree_util.tree_map(lambda x: x[0], rest)
+            rest_in = rest
             if self.accum_steps == 1:
                 loss, metrics, rest, grads = self._microbatch_grads(
                     params, rest, batch
@@ -480,6 +534,19 @@ class DataParallel:
             loss = collectives.pmean(loss, axis)
             metrics = collectives.pmean(metrics, axis)
 
+            ok = None
+            if guard_in is not None:
+                # world-consensus finiteness: the pmean'd loss catches a
+                # NaN loss on ANY replica, but grads can blow up (inf in
+                # the backward) with a finite loss — and a replica-local
+                # verdict would let replicas take different branches and
+                # diverge. pmin over the local flags is the consensus.
+                gfin = jnp.bool_(True)
+                for leaf in jax.tree_util.tree_leaves(grads):
+                    gfin &= jnp.all(jnp.isfinite(leaf))
+                gfin = collectives.pmin(gfin.astype(jnp.int32), axis) > 0
+                ok = jnp.isfinite(loss) & gfin
+
             if self.zero:
                 # average + shard the gradients in ONE collective: a
                 # psum_scatter is the reduce-scatter half of the
@@ -501,6 +568,11 @@ class DataParallel:
                 updates, opt_state = self.optimizer.update(
                     gshard, opt_state, pstore
                 )
+                if (self.divergence_guard == "halve_lr"
+                        and guard_in is not None):
+                    updates = jax.tree_util.tree_map(
+                        lambda u: u * guard_in["lr_scale"], updates
+                    )
                 pstore = optax.apply_updates(pstore, updates)
             else:
                 # DDP gradient averaging: one compiler-scheduled all-reduce
@@ -519,7 +591,42 @@ class DataParallel:
                 updates, opt_state = self.optimizer.update(
                     grads, opt_state, params
                 )
+                if (self.divergence_guard == "halve_lr"
+                        and guard_in is not None):
+                    updates = jax.tree_util.tree_map(
+                        lambda u: u * guard_in["lr_scale"], updates
+                    )
                 pstore = optax.apply_updates(params, updates)
+
+            if guard_in is not None:
+                # exact skip of a non-finite step: params, optimizer
+                # state, and BN buffers all roll back to their pre-step
+                # values — jnp.where never propagates the not-taken
+                # branch's NaNs
+                def sel(new, old):
+                    return jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(ok, n, o.astype(n.dtype)),
+                        new, old,
+                    )
+
+                pstore = sel(pstore, pstore_in)
+                opt_state = sel(opt_state, opt_in)
+                rest = sel(rest, rest_in)
+                notok_i = 1 - ok.astype(jnp.int32)
+                lr_scale = guard_in["lr_scale"]
+                if self.divergence_guard == "halve_lr":
+                    lr_scale = jnp.where(ok, lr_scale, lr_scale * 0.5)
+                guard_out = {
+                    "lr_scale": lr_scale,
+                    "nonfinite_count":
+                        guard_in["nonfinite_count"] + notok_i,
+                }
+                metrics = {
+                    **metrics,
+                    "nonfinite": notok_i.astype(jnp.float32),
+                    "lr_scale": guard_in["lr_scale"],
+                }
+                opt_state = (opt_state, guard_out)
 
             if self.broadcast_buffers:
                 if self._per_step_broadcast:
@@ -620,7 +727,7 @@ class DataParallel:
             params = self._gather_params(pstore) if self.zero else pstore
             if not self.broadcast_buffers:
                 rest = jax.tree_util.tree_map(lambda x: x[0], rest)
-            model = nnx.merge(self.graphdef, params, rest, copy=True)
+            model = compat.nnx_merge(self.graphdef, params, rest, copy=True)
             model.eval()
             out = self.loss_fn(model, batch)
             loss, metrics = out if isinstance(out, tuple) else (out, {})
@@ -763,3 +870,32 @@ class DataParallel:
             self.opt_state = jax.device_put(
                 state["opt_state"], self._replicated
             )
+
+
+def resume_latest(trainer, directory: str) -> int:
+    """Restore ``trainer`` from the newest *verified* checkpoint in
+    ``directory`` (manifest-certified; corrupt/truncated candidates are
+    skipped by ``utils.checkpoint.load_checkpoint``'s fallback chain).
+    Returns the restored step, or 0 when the directory holds no
+    checkpoints at all — the "first boot or resume, caller doesn't care
+    which" orchestration a preemptible job wants::
+
+        dp = DataParallel(model, opt, loss_fn)
+        start = resume_latest(dp, ckpt_dir)   # 0 on first boot
+        for step in range(start, total_steps): ...
+
+    Works with any trainer exposing ``state_dict``/``load_state_dict``
+    (``DataParallel``, ``GANTrainer``). A directory where every candidate
+    fails verification raises ``CheckpointCorruptError`` — that is an
+    operator problem, not a fresh start."""
+    from tpu_syncbn.utils import checkpoint as ckpt
+
+    try:
+        state, step = ckpt.load_checkpoint(directory, trainer.state_dict())
+    except FileNotFoundError:
+        return 0
+    trainer.load_state_dict(state)
+    dist.get_logger("tpu_syncbn.resilience").info(
+        "resumed from verified checkpoint step %d in %s", step, directory
+    )
+    return step

@@ -18,8 +18,24 @@ from tpu_syncbn.runtime.distributed import (
     barrier,
     DistributedConfig,
 )
+from tpu_syncbn.runtime.resilience import (
+    PreemptionGuard,
+    ResilientLoop,
+    StallError,
+    Watchdog,
+    backoff_delays,
+    retry_with_backoff,
+    stall_guard,
+)
 
 __all__ = [
+    "PreemptionGuard",
+    "ResilientLoop",
+    "StallError",
+    "Watchdog",
+    "backoff_delays",
+    "retry_with_backoff",
+    "stall_guard",
     "initialize",
     "is_initialized",
     "shutdown",

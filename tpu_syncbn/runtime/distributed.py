@@ -22,6 +22,7 @@ process/rendezvous stack (reference ``README.md:22-36``):
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 import os
@@ -79,7 +80,13 @@ class DistributedConfig:
         )
 
 
-def initialize(config: DistributedConfig | None = None) -> None:
+def initialize(
+    config: DistributedConfig | None = None,
+    *,
+    rendezvous_attempts: int | None = None,
+    rendezvous_timeout_s: float | None = None,
+    rendezvous_backoff_s: float | None = None,
+) -> None:
     """Join the distributed job. One call replaces the reference's step 1+2
     (``--local_rank`` parse, ``cuda.set_device``, ``init_process_group``;
     ``README.md:11-36``).
@@ -93,12 +100,34 @@ def initialize(config: DistributedConfig | None = None) -> None:
     (``[torch] distributed/distributed_c10d.py:1889``) but against the TPU
     coordination service. On a Cloud TPU slice all arguments are discovered
     from slice metadata and ``config`` may be ``None``.
+
+    The rendezvous is retried with exponential backoff and deterministic
+    per-host jitter (docs/RESILIENCE.md): coordinator DNS that isn't up
+    yet, a coordinator restarting after preemption, or a slow-starting
+    peer should cost a retry, not the job. Knobs (argument > env >
+    default): ``rendezvous_attempts`` / ``TPU_SYNCBN_RENDEZVOUS_ATTEMPTS``
+    (default 3), ``rendezvous_timeout_s`` /
+    ``TPU_SYNCBN_RENDEZVOUS_TIMEOUT_S`` (per-attempt timeout handed to
+    ``jax.distributed.initialize`` where supported; jax's default
+    otherwise), ``rendezvous_backoff_s`` /
+    ``TPU_SYNCBN_RENDEZVOUS_BACKOFF_S`` (base backoff, default 1.0).
     """
     global _initialized, _jax_distributed_active
     if _initialized:
         return
     if config is None:
         config = DistributedConfig.from_env()
+
+    def _env_num(name, cast, default):
+        v = os.environ.get(name)
+        return cast(v) if v is not None else default
+
+    attempts = (rendezvous_attempts if rendezvous_attempts is not None
+                else _env_num("TPU_SYNCBN_RENDEZVOUS_ATTEMPTS", int, 3))
+    timeout_s = (rendezvous_timeout_s if rendezvous_timeout_s is not None
+                 else _env_num("TPU_SYNCBN_RENDEZVOUS_TIMEOUT_S", float, None))
+    backoff_s = (rendezvous_backoff_s if rendezvous_backoff_s is not None
+                 else _env_num("TPU_SYNCBN_RENDEZVOUS_BACKOFF_S", float, 1.0))
     # A coordinator address alone (e.g. a stale MASTER_ADDR export from an
     # old GPU script) must not force the multi-host path: require an actual
     # world size > 1, or TPU slice metadata advertising multiple workers
@@ -108,18 +137,75 @@ def initialize(config: DistributedConfig | None = None) -> None:
     )
     slice_multi = _tpu_slice_is_multihost()
     if explicit_multi:
-        jax.distributed.initialize(
+        kwargs = dict(
             coordinator_address=config.coordinator_address,
             num_processes=config.num_processes,
             process_id=config.process_id,
         )
-        _jax_distributed_active = True
     elif slice_multi:
         # Argless: every parameter is discovered from slice metadata — the
         # TPU-native replacement for env:// rendezvous (README.md:32-35).
-        jax.distributed.initialize()
-        _jax_distributed_active = True
+        kwargs = {}
+    else:
+        _initialized = True
+        return
+    # per-host jitter identity: explicit rank when configured; otherwise
+    # slice metadata or the hostname (the argless TPU-slice path discovers
+    # rank from metadata, so process_id is None on every host — keying off
+    # it alone would put all hosts on an identical retry schedule)
+    ident = config.process_id
+    if ident is None:
+        import socket
+
+        ident = os.environ.get("TPU_WORKER_ID") or socket.gethostname()
+    _rendezvous_with_retry(
+        kwargs, attempts=attempts, timeout_s=timeout_s, backoff_s=backoff_s,
+        jitter_key=f"host{ident}",
+    )
+    _jax_distributed_active = True
     _initialized = True
+
+
+def _rendezvous_with_retry(
+    kwargs: dict,
+    *,
+    attempts: int,
+    timeout_s: float | None,
+    backoff_s: float,
+    jitter_key: str,
+) -> None:
+    """``jax.distributed.initialize(**kwargs)`` under bounded exponential
+    backoff with deterministic per-host jitter — N restarted hosts must
+    not re-storm a recovering coordinator in lockstep. A per-attempt
+    ``initialization_timeout`` is forwarded when this jax supports it."""
+    import inspect
+
+    from tpu_syncbn.runtime import resilience
+
+    if timeout_s is not None:
+        try:
+            params = inspect.signature(jax.distributed.initialize).parameters
+        except (TypeError, ValueError):  # builtins without signatures
+            params = {}
+        if "initialization_timeout" in params:
+            kwargs = {**kwargs, "initialization_timeout": int(timeout_s)}
+
+    def attempt():
+        try:
+            jax.distributed.initialize(**kwargs)
+        except Exception:
+            # a half-open coordination client would poison the next try
+            with contextlib.suppress(Exception):
+                jax.distributed.shutdown()
+            raise
+
+    resilience.retry_with_backoff(
+        attempt,
+        attempts=attempts,
+        base_s=backoff_s,
+        key=jitter_key,
+        describe="distributed rendezvous",
+    )
 
 
 def _tpu_slice_is_multihost() -> bool:

@@ -158,6 +158,17 @@ def enable_persistent_compilation_cache() -> Optional[str]:
     """
     if os.environ.get("TPU_SYNCBN_NO_COMPILE_CACHE") == "1":
         return None
+    from tpu_syncbn import compat
+
+    if not compat.HAS_VMA:
+        # Pre-VMA jax (0.4.x): REPRODUCED returning wrong values from a
+        # warm cache directory (a GANTrainer restored into a fresh
+        # trainer computed a different loss with the cache on; fresh
+        # cache dirs behaved, the accumulated one did not — consistent
+        # with entries half-written by SIGKILLed runs being deserialized
+        # without validation on this jax). Silent numerical corruption is
+        # strictly worse than recompiling; stay off on this toolchain.
+        return None
     path = os.environ.get("JAX_COMPILATION_CACHE_DIR")
     if path is None:
         # Cached entries are deserialized compiled executables, so the

@@ -1,0 +1,559 @@
+"""Fault-tolerant training runtime: preemption, stalls, retries.
+
+The paper's recipe assumes every worker, every rendezvous, and every step
+succeeds; on real slices preemption, flaky coordinator DNS, hung data
+workers, and NaN blow-ups are the common case. This module is the host-side
+resilience layer (docs/RESILIENCE.md is the failure-mode → behavior map):
+
+* :class:`PreemptionGuard` — SIGTERM/SIGINT become a *checkpoint request at
+  the next step boundary* instead of a mid-step kill (the Cloud TPU
+  preemption contract: a grace window after SIGTERM, then SIGKILL).
+* :class:`Watchdog` / :func:`stall_guard` — a collective or data fetch that
+  stalls past a deadline dumps per-host diagnostics (thread stacks, device
+  and process identity) and surfaces a :class:`StallError` rather than
+  hanging the job silently until the scheduler reaps it.
+* :func:`retry_with_backoff` — bounded exponential backoff with
+  *deterministic* jitter (keyed, no wall-clock randomness) shared by the
+  rendezvous retry in ``runtime.distributed.initialize``.
+* :class:`ResilientLoop` — composes the above with the manifest-verified
+  checkpoint store (``utils.checkpoint``) and the trainer's on-device
+  divergence guard into a preemption-safe step loop with
+  ``resume_latest`` orchestration and a ``restore_last_good`` policy.
+
+Everything here is host-level control flow: no jax tracing, usable with any
+trainer exposing ``state_dict``/``load_state_dict``/``train_step``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+import zlib
+from typing import Any, Callable, Iterable, Iterator
+
+from tpu_syncbn.runtime import distributed as dist
+
+
+class StallError(RuntimeError):
+    """A step collective or data fetch exceeded its watchdog deadline."""
+
+
+# ---------------------------------------------------------------------------
+# preemption
+
+
+class PreemptionGuard:
+    """Convert SIGTERM/SIGINT into a polite "checkpoint at the next step
+    boundary, then exit" request.
+
+    Usage::
+
+        with PreemptionGuard() as guard:
+            for batch in loader:
+                dp.train_step(batch)
+                if guard.preempted:
+                    save_checkpoint(ckpt_dir, step, dp.state_dict())
+                    break
+
+    The first signal only sets a flag (checked via :attr:`preempted` at
+    step boundaries — never mid-step, so the saved state is a step-exact
+    snapshot). A *second* signal re-raises through the previously
+    installed handler: an impatient operator's double Ctrl-C still kills
+    the process immediately.
+
+    Signal handlers are process-global and only installable from the main
+    thread; constructing the guard elsewhere raises ``ValueError`` (from
+    ``signal.signal``) rather than silently not protecting anything.
+    """
+
+    def __init__(
+        self,
+        signals: tuple = (signal.SIGTERM, signal.SIGINT),
+        *,
+        callback: Callable[[int], None] | None = None,
+    ):
+        self._signals = tuple(signals)
+        self._callback = callback
+        self._event = threading.Event()
+        self._prev: dict[int, Any] = {}
+        self._received: int | None = None
+        self._installed = False
+
+    # -- handler ----------------------------------------------------------
+
+    def _handle(self, signum, frame):
+        if self._event.is_set():
+            # second delivery: defer to the original disposition (usually
+            # fatal) — the operator means it
+            self._restore()
+            os.kill(os.getpid(), signum)
+            return
+        self._received = signum
+        self._event.set()
+        dist.get_logger("tpu_syncbn.resilience").warning(
+            "received signal %d: will checkpoint at the next step boundary "
+            "and exit", signum,
+        )
+        if self._callback is not None:
+            self._callback(signum)
+
+    def __enter__(self) -> "PreemptionGuard":
+        for s in self._signals:
+            self._prev[s] = signal.signal(s, self._handle)
+        self._installed = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._restore()
+
+    def _restore(self) -> None:
+        if self._installed:
+            for s, prev in self._prev.items():
+                with contextlib.suppress(Exception):
+                    signal.signal(s, prev)
+            self._installed = False
+
+    # -- queries ----------------------------------------------------------
+
+    @property
+    def preempted(self) -> bool:
+        """True once a shutdown signal has been received."""
+        return self._event.is_set()
+
+    @property
+    def signum(self) -> int | None:
+        return self._received
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._event.wait(timeout)
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+
+
+def dump_stacks(header: str = "") -> str:
+    """Per-host diagnostic snapshot: process identity, device world, and
+    every Python thread's stack — what you need from EACH host to see
+    which rank a stalled collective is waiting on."""
+    import jax
+
+    buf = io.StringIO()
+    if header:
+        buf.write(header + "\n")
+    try:
+        buf.write(
+            f"host {dist.process_index()}/{dist.process_count()} "
+            f"({jax.local_device_count()} local / {jax.device_count()} "
+            "global devices)\n"
+        )
+    except Exception as e:  # diagnostics must never throw past themselves
+        buf.write(f"device world unavailable: {e}\n")
+    frames = sys._current_frames()
+    threads = {t.ident: t for t in threading.enumerate()}
+    for ident, frame in frames.items():
+        t = threads.get(ident)
+        name = t.name if t else f"thread-{ident}"
+        buf.write(f"--- thread {name} ---\n")
+        buf.write("".join(traceback.format_stack(frame)))
+    return buf.getvalue()
+
+
+class Watchdog:
+    """Deadline monitor for the step loop: if :meth:`pat` is not called
+    within ``deadline_s``, dump per-host diagnostics (once per stall) and
+    invoke ``on_stall`` — by default logging the dump at ERROR so a hung
+    collective leaves evidence on every host instead of an opaque freeze.
+
+    Pass ``on_stall=` + a raising callable (or use :func:`stall_guard` for
+    data iterators, which raises :class:`StallError` in the *consumer*)
+    when the stall should abort rather than just report. The monitor is a
+    daemon thread; ``close()`` (or context-manager exit) stops it.
+    """
+
+    def __init__(
+        self,
+        deadline_s: float,
+        *,
+        name: str = "step",
+        on_stall: Callable[[str], None] | None = None,
+        poll_s: float | None = None,
+        start_armed: bool = True,
+    ):
+        """``start_armed=False`` defers the deadline clock until the
+        first :meth:`pat` — for loops whose first iteration legitimately
+        dwarfs the steady-state deadline (XLA compiling the step on a
+        cold start would otherwise read as a stall)."""
+        if deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        self.deadline_s = float(deadline_s)
+        self.name = name
+        self._on_stall = on_stall
+        self._poll_s = poll_s if poll_s is not None else min(
+            0.05, deadline_s / 4
+        )
+        self._last = time.monotonic() if start_armed else None
+        self._stalled_since: float | None = None
+        self.stall_count = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"watchdog-{name}", daemon=True
+        )
+        self._thread.start()
+
+    def pat(self) -> None:
+        """Mark liveness (call once per step / per batch)."""
+        self._last = time.monotonic()
+        self._stalled_since = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            if self._last is None:
+                continue  # not armed yet (start_armed=False, no pat)
+            idle = time.monotonic() - self._last
+            if idle > self.deadline_s and self._stalled_since is None:
+                self._stalled_since = self._last
+                self.stall_count += 1
+                diag = dump_stacks(
+                    f"WATCHDOG: {self.name!r} stalled for {idle:.1f}s "
+                    f"(deadline {self.deadline_s}s)"
+                )
+                logger = dist.get_logger("tpu_syncbn.resilience")
+                logger.error("%s", diag)
+                if self._on_stall is not None:
+                    with contextlib.suppress(Exception):
+                        self._on_stall(diag)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+    def __enter__(self) -> "Watchdog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def stall_guard(
+    iterator: Iterable, deadline_s: float, *, name: str = "batch"
+) -> Iterator:
+    """Wrap a (possibly hanging) batch iterator so the consumer NEVER
+    blocks past ``deadline_s`` on one item: a fetcher thread pulls from
+    the source while the consumer waits on a queue with a timeout, raising
+    :class:`StallError` (with per-host stack diagnostics logged) when the
+    deadline passes — a hung data worker becomes a loud, catchable fault
+    at the step boundary instead of an indefinite hang.
+
+    The fetcher prefetches at most one item. Once the consumer is done —
+    StallError raised, generator closed, or the source exhausted — a stop
+    flag makes the fetcher exit as soon as its (possibly still-hung)
+    ``next()`` returns, rather than lingering blocked on the queue: an
+    abandoned guard must not keep pulling from a source iterator the
+    caller may hand to a fresh guard on retry. The one batch in flight at
+    stall time is dropped with the stalled fetch; only a fetcher stuck
+    inside the source forever remains (daemon — dies with the process).
+    """
+    import queue as _queue
+
+    if deadline_s <= 0:
+        raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+    q: Any = _queue.Queue(maxsize=1)
+    DONE, ERR = object(), object()
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def fetch():
+        try:
+            for item in iterator:
+                if not put(("ok", item)):
+                    return  # consumer gone: do not touch the source again
+        except BaseException as e:
+            put((ERR, e))
+            return
+        put((DONE, None))
+
+    t = threading.Thread(target=fetch, name=f"stall-guard-{name}",
+                         daemon=True)
+    t.start()
+    try:
+        while True:
+            try:
+                tag, payload = q.get(timeout=deadline_s)
+            except _queue.Empty:
+                diag = dump_stacks(
+                    f"WATCHDOG: {name!r} fetch exceeded {deadline_s}s"
+                )
+                dist.get_logger("tpu_syncbn.resilience").error("%s", diag)
+                raise StallError(
+                    f"{name} fetch exceeded the {deadline_s}s watchdog "
+                    "deadline"
+                ) from None
+            if tag is DONE:
+                return
+            if tag is ERR:
+                raise payload
+            yield payload
+    finally:
+        stop.set()
+
+
+# ---------------------------------------------------------------------------
+# retry / backoff
+
+
+def backoff_delays(
+    attempts: int,
+    *,
+    base_s: float = 1.0,
+    max_s: float = 30.0,
+    jitter: float = 0.25,
+    key: str = "",
+) -> list[float]:
+    """The ``attempts - 1`` sleep durations between retries: exponential
+    (``base * 2**i`` capped at ``max_s``) with ±``jitter`` fractional
+    spread. Jitter is *deterministic* — keyed off ``key`` (e.g. host
+    index) via CRC32, not wall-clock RNG — so retries are reproducible
+    under the fault harness yet de-synchronized across hosts (the point
+    of jitter: N preempted hosts must not re-storm the coordinator in
+    lockstep)."""
+    delays = []
+    for i in range(max(0, attempts - 1)):
+        d = min(max_s, base_s * (2 ** i))
+        # unit-interval hash of (key, attempt): stable across runs
+        u = (zlib.crc32(f"{key}:{i}".encode()) & 0xFFFFFFFF) / 0xFFFFFFFF
+        delays.append(d * (1.0 + jitter * (2.0 * u - 1.0)))
+    return delays
+
+
+def retry_with_backoff(
+    fn: Callable[[], Any],
+    *,
+    attempts: int = 3,
+    base_s: float = 1.0,
+    max_s: float = 30.0,
+    jitter: float = 0.25,
+    key: str = "",
+    retry_on: tuple = (Exception,),
+    describe: str = "operation",
+    sleep: Callable[[float], None] | None = None,
+) -> Any:
+    """Call ``fn`` up to ``attempts`` times with :func:`backoff_delays`
+    between failures; the final failure re-raises. Each retry is logged
+    with the exception — a rendezvous that needed 3 tries is an incident
+    worth seeing in the log even when it eventually succeeds."""
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    if sleep is None:
+        sleep = time.sleep  # late-bound: patchable via resilience.time
+    delays = backoff_delays(
+        attempts, base_s=base_s, max_s=max_s, jitter=jitter, key=key
+    )
+    logger = dist.get_logger("tpu_syncbn.resilience")
+    for i in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:
+            if i == attempts - 1:
+                raise
+            logger.warning(
+                "%s failed (attempt %d/%d: %s: %s); retrying in %.2fs",
+                describe, i + 1, attempts, type(e).__name__, e, delays[i],
+            )
+            sleep(delays[i])
+    raise AssertionError("unreachable")
+
+
+# ---------------------------------------------------------------------------
+# orchestration
+
+
+def _default_counters():
+    from tpu_syncbn.utils.metrics import EventCounter
+
+    return EventCounter()
+
+
+class ResilientLoop:
+    """Preemption-safe training driver over any trainer with the
+    ``state_dict``/``load_state_dict``/``train_step`` surface (the
+    ``DataParallel``/``GANTrainer`` contract).
+
+    Composes the resilience primitives into the loop the examples run::
+
+        loop = ResilientLoop(dp, ckpt_dir, ckpt_every=100)
+        start = loop.resume()                  # newest VERIFIED checkpoint
+        summary = loop.run(batches)            # SIGTERM-safe, NaN-guarded
+
+    Behavior (knobs → docs/RESILIENCE.md):
+
+    * resume: :meth:`resume` restores the newest *verified* checkpoint
+      (``utils.checkpoint`` manifest fallback) and returns the step to
+      continue from (0 when none exists).
+    * preemption: SIGTERM/SIGINT set a flag; the loop finishes the
+      in-flight step, saves a checkpoint at the boundary, and returns with
+      ``summary["preempted"] = True`` — exit code stays 0, the restarted
+      job resumes exactly there.
+    * divergence: when the trainer was built with
+      ``divergence_guard="restore_last_good"``, a step reporting a
+      non-finite loss/grad (the on-device ``nonfinite`` metric) reloads
+      the last verified checkpoint; ``max_restores`` bounds the
+      thrash-loop (beyond it the loop raises ``FloatingPointError``).
+      ``skip_step``/``halve_lr`` policies are entirely on-device and need
+      no host cooperation (the loop just counts them).
+    * liveness: ``step_deadline_s`` arms a :class:`Watchdog` patted every
+      step; a stall dumps per-host stacks. Data stalls should be guarded
+      at the iterator with :func:`stall_guard` (raises, so the loop can
+      checkpoint-and-exit via the normal exception path).
+    """
+
+    def __init__(
+        self,
+        trainer,
+        ckpt_dir: str,
+        *,
+        ckpt_every: int = 100,
+        keep: int = 3,
+        max_restores: int = 3,
+        step_deadline_s: float | None = None,
+        counters=None,
+    ):
+        if ckpt_every < 1:
+            raise ValueError(f"ckpt_every must be >= 1, got {ckpt_every}")
+        self.trainer = trainer
+        self.ckpt_dir = ckpt_dir
+        self.ckpt_every = ckpt_every
+        self.keep = keep
+        self.max_restores = max_restores
+        self.step_deadline_s = step_deadline_s
+        self.counters = counters if counters is not None else _default_counters()
+        self.step = 0
+        self._log = dist.get_logger("tpu_syncbn.resilience")
+
+    # -- checkpoint plumbing ----------------------------------------------
+
+    def resume(self) -> int:
+        """Restore the newest verified checkpoint (if any); returns the
+        step training should continue from."""
+        from tpu_syncbn.parallel.trainer import resume_latest
+
+        self.step = resume_latest(self.trainer, self.ckpt_dir)
+        if self.step:
+            self.counters.bump("resumes")
+        return self.step
+
+    def save(self) -> None:
+        from tpu_syncbn.utils import checkpoint as ckpt
+
+        ckpt.save_checkpoint(
+            self.ckpt_dir, self.step, self.trainer.state_dict(),
+            keep=self.keep,
+        )
+        self.counters.bump("checkpoints")
+
+    def _restore_last_good(self) -> None:
+        from tpu_syncbn.parallel.trainer import resume_latest
+        from tpu_syncbn.utils import checkpoint as ckpt
+
+        if not ckpt.available_steps(self.ckpt_dir):
+            # nothing durable yet (divergence before the first save):
+            # there is no state to restore — but the on-device guard
+            # already rolled the bad update back, so degrading to
+            # skip-step semantics (step counter untouched) is safe
+            self.counters.bump("divergence_skips_without_checkpoint")
+            self._log.warning(
+                "non-finite loss/grads at step %d with no checkpoint to "
+                "restore; on-device guard already skipped the update — "
+                "continuing", self.step,
+            )
+            return
+        restored = resume_latest(self.trainer, self.ckpt_dir)
+        self.counters.bump("divergence_restores")
+        self._log.warning(
+            "non-finite loss/grads at step %d: restored last good "
+            "checkpoint (step %d)", self.step, restored,
+        )
+        self.step = restored
+
+    # -- the loop ---------------------------------------------------------
+
+    def run(self, batches: Iterable, *, max_steps: int | None = None) -> dict:
+        """Drive ``trainer.train_step`` over ``batches`` with preemption,
+        divergence, and liveness handling. Returns a summary dict
+        (``steps``, ``preempted``, plus the counter snapshot)."""
+        policy = getattr(self.trainer, "divergence_guard", None)
+        preempted = False
+        with contextlib.ExitStack() as stack:
+            guard = stack.enter_context(PreemptionGuard())
+            watchdog = None
+            if self.step_deadline_s is not None:
+                # armed at the first pat: the first step's XLA compile
+                # legitimately dwarfs the steady-state deadline
+                watchdog = stack.enter_context(
+                    Watchdog(self.step_deadline_s, name="train-step",
+                             start_armed=False)
+                )
+            steps_run = 0
+            for batch in batches:
+                if max_steps is not None and steps_run >= max_steps:
+                    break
+                out = self.trainer.train_step(batch)
+                self.step += 1
+                steps_run += 1
+                if watchdog is not None:
+                    watchdog.pat()
+                if policy is not None:
+                    nonfinite = float(out.metrics.get("nonfinite", 0.0))
+                    if nonfinite > 0:
+                        self.counters.bump("nonfinite_steps")
+                        if policy == "restore_last_good":
+                            if (self.counters.count("divergence_restores")
+                                    >= self.max_restores):
+                                raise FloatingPointError(
+                                    "divergence persisted through "
+                                    f"{self.max_restores} restore_last_good "
+                                    "recoveries — refusing to thrash"
+                                )
+                            self._restore_last_good()
+                            if guard.preempted:
+                                # the restored state IS the last durable
+                                # checkpoint — exit now rather than burn
+                                # grace-window time on another step
+                                preempted = True
+                                self._log.warning(
+                                    "preempted during divergence recovery "
+                                    "at step %d; state already durable; "
+                                    "exiting cleanly", self.step,
+                                )
+                                break
+                            continue
+                if guard.preempted:
+                    self.save()
+                    preempted = True
+                    self._log.warning(
+                        "preemption checkpoint written at step %d; exiting "
+                        "cleanly", self.step,
+                    )
+                    break
+                if self.step % self.ckpt_every == 0:
+                    self.save()
+        return {
+            "steps": steps_run,
+            "step": self.step,
+            "preempted": preempted,
+            **self.counters.summary(),
+        }

@@ -1,0 +1,25 @@
+"""Deterministic fault injection for exercising the resilience layer."""
+
+from tpu_syncbn.testing.faults import (
+    FaultInjector,
+    fault_seed,
+    bitflip_file,
+    truncate_file,
+    corrupt_checkpoint,
+    kill_loader_worker,
+    poison_nan,
+    delay_batch,
+    signal_at,
+)
+
+__all__ = [
+    "FaultInjector",
+    "fault_seed",
+    "bitflip_file",
+    "truncate_file",
+    "corrupt_checkpoint",
+    "kill_loader_worker",
+    "poison_nan",
+    "delay_batch",
+    "signal_at",
+]

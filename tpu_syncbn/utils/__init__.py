@@ -1,12 +1,17 @@
 """Utilities: checkpoint/resume (rank-0 writes), meters, profiler hooks."""
 
 from tpu_syncbn.utils.checkpoint import (
+    CheckpointCorruptError,
     save_checkpoint,
     load_checkpoint,
     available_steps,
+    verified_steps,
+    verify_checkpoint,
+    read_manifest,
 )
 from tpu_syncbn.utils.metrics import (
     AverageMeter,
+    EventCounter,
     ScalarLogger,
     ThroughputMeter,
     profiler_trace,
@@ -19,10 +24,15 @@ __all__ = [
     "evaluate_detections",
     "frechet_distance",
     "gaussian_stats",
+    "CheckpointCorruptError",
     "save_checkpoint",
     "load_checkpoint",
     "available_steps",
+    "verified_steps",
+    "verify_checkpoint",
+    "read_manifest",
     "AverageMeter",
+    "EventCounter",
     "ScalarLogger",
     "ThroughputMeter",
     "profiler_trace",

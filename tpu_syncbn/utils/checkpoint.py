@@ -1,4 +1,4 @@
-"""Checkpoint / resume.
+"""Checkpoint / resume with integrity manifests.
 
 The reference has no checkpointing (SURVEY §5.4) — but evaluating top-1
 parity targets requires persisting params + BN running stats, and the
@@ -9,13 +9,29 @@ provides exactly that: master-host-only atomic writes of any pytree
 
 Serialization is ``flax.serialization`` msgpack — pure pytree bytes, no
 pickle execution risk, stable across processes.
+
+Integrity (docs/RESILIENCE.md): every ``ckpt_{N}.msgpack`` is certified by
+a sibling ``ckpt_{N}.manifest.json`` holding the payload's checksums
+(vectorized ``sum64`` always; CRC32 additionally while the payload is
+small enough for a serial pass to be free), byte length, step, and a hash
+of the pytree structure. Both files are written
+atomically (tmp + rename), payload strictly before manifest, so a crash or
+preemption at ANY byte leaves either a fully certified checkpoint or an
+uncertified leftover — never a certified-but-truncated one. ``load`` of
+the latest checkpoint skips candidates whose certification fails and falls
+back to the newest *verified* older step instead of dying on an opaque
+msgpack error mid-resume.
 """
 
 from __future__ import annotations
 
+import contextlib
+import hashlib
+import json
 import os
 import re
 import tempfile
+import zlib
 from typing import Any
 
 import jax
@@ -25,14 +41,53 @@ from tpu_syncbn.runtime import distributed as dist
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
 
+#: Bump when the manifest schema changes incompatibly.
+MANIFEST_FORMAT = 1
+
+#: Payloads up to this size also get a CRC32 (serial, ~1 GB/s); above it
+#: only the vectorized ``sum64`` checksum is computed, keeping manifest
+#: verification <5% of the checkpoint round-trip at any size (the
+#: bench.py ``recovery`` block records the measured fraction).
+_CRC32_MAX_BYTES = int(
+    float(os.environ.get("TPU_SYNCBN_CKPT_CRC32_MAX_MB", "32")) * (1 << 20)
+)
+
+
+def payload_sum64(data: bytes) -> str:
+    """Fast integrity checksum: little-endian uint64 block sum (mod 2^64)
+    plus the tail bytes and the length, hex-encoded. Runs at memory
+    bandwidth via numpy (~10-20x zlib.crc32), and *guarantees* detection
+    of truncation (length term) and any single bit flip (a flipped bit
+    changes one block by ±2^k, which cannot cancel mod 2^64) — the two
+    corruption modes a killed writer or bad disk actually produces."""
+    import numpy as np
+
+    mv = memoryview(data)
+    head = len(data) & ~7
+    if head:
+        blocks = np.frombuffer(mv[:head], dtype="<u8")
+        s = int(np.add.reduce(blocks, dtype=np.uint64))
+    else:
+        s = 0
+    tail = int.from_bytes(bytes(mv[head:]), "little")
+    s = (s + tail) & 0xFFFFFFFFFFFFFFFF
+    return f"{s:016x}:{len(data):x}"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """Raised when an explicitly requested checkpoint (or every available
+    candidate) fails integrity verification or deserialization."""
+
 
 def _purify(tree: Any) -> Any:
     """Recursively convert nnx State nodes (not msgpack-serializable) to
     pure nested dicts; leaves other structures alone."""
     from flax import nnx
 
+    from tpu_syncbn import compat
+
     if isinstance(tree, nnx.State):
-        return nnx.to_pure_dict(tree)
+        return compat.nnx_to_pure_dict(tree)
     if isinstance(tree, dict):
         return {k: _purify(v) for k, v in tree.items()}
     if isinstance(tree, tuple) and hasattr(tree, "_fields"):  # namedtuple
@@ -47,9 +102,11 @@ def _unpurify(template: Any, pure: Any) -> Any:
     using ``template``'s structure."""
     from flax import nnx
 
+    from tpu_syncbn import compat
+
     if isinstance(template, nnx.State):
         state = jax.tree_util.tree_map(lambda x: x, template)  # copy
-        nnx.replace_by_pure_dict(state, pure)
+        compat.nnx_replace_by_pure_dict(state, pure)
         return state
     if isinstance(template, dict):
         return {k: _unpurify(template[k], pure[k]) for k in template}
@@ -68,6 +125,36 @@ def _path(directory: str, step: int) -> str:
     return os.path.join(directory, f"ckpt_{step}.msgpack")
 
 
+def _manifest_path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"ckpt_{step}.manifest.json")
+
+
+def tree_structure_hash(pure_tree: Any) -> str:
+    """Stable hash of a pure pytree's *structure* (treedef + per-leaf
+    shape/dtype, values excluded) — written into the manifest so a
+    checkpoint records which model/optimizer shape produced it."""
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(pure_tree)
+    h = hashlib.sha256(repr(treedef).encode())
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        h.update(f"{arr.dtype.str}:{arr.shape};".encode())
+    return h.hexdigest()[:16]
+
+
+def _atomic_write(directory: str, final_path: str, data: bytes) -> None:
+    """tmp + rename in ``directory`` (same filesystem, hence atomic)."""
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, final_path)
+    finally:
+        with contextlib.suppress(FileNotFoundError):
+            os.unlink(tmp)
+
+
 def available_steps(directory: str) -> list[int]:
     if not os.path.isdir(directory):
         return []
@@ -79,6 +166,53 @@ def available_steps(directory: str) -> list[int]:
     return sorted(out)
 
 
+def read_manifest(directory: str, step: int) -> dict | None:
+    """The parsed manifest for ``step``, or None when absent/unreadable
+    (pre-manifest checkpoints are legal: they load, but cannot be
+    *verified* and lose fallback priority to certified ones)."""
+    try:
+        with open(_manifest_path(directory, step)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _payload_matches(manifest: dict, data: bytes) -> bool:
+    if manifest.get("nbytes") != len(data):
+        return False
+    sum64 = manifest.get("sum64")
+    crc32 = manifest.get("crc32")
+    if sum64 is None and crc32 is None:
+        return False  # a manifest that certifies nothing certifies nothing
+    if sum64 is not None and sum64 != payload_sum64(data):
+        return False
+    if crc32 is not None and crc32 != (zlib.crc32(data) & 0xFFFFFFFF):
+        return False
+    return True
+
+
+def verify_checkpoint(directory: str, step: int) -> bool:
+    """True iff ``step``'s payload exists AND its manifest certifies it
+    (byte length and CRC32 both match). Legacy checkpoints without a
+    manifest — and anything truncated, bit-flipped, or mid-write — report
+    False."""
+    manifest = read_manifest(directory, step)
+    if manifest is None:
+        return False
+    try:
+        with open(_path(directory, step), "rb") as f:
+            data = f.read()
+    except OSError:
+        return False
+    return _payload_matches(manifest, data)
+
+
+def verified_steps(directory: str) -> list[int]:
+    """Ascending steps whose manifest certifies the payload."""
+    return [s for s in available_steps(directory)
+            if verify_checkpoint(directory, s)]
+
+
 def save_checkpoint(
     directory: str,
     step: int,
@@ -86,79 +220,185 @@ def save_checkpoint(
     *,
     keep: int = 3,
 ) -> str | None:
-    """Write ``tree`` as ``ckpt_{step}.msgpack`` — master host only (other
-    hosts return None immediately); atomic via tmp+rename; prunes to the
-    newest ``keep`` checkpoints."""
+    """Write ``tree`` as ``ckpt_{step}.msgpack`` plus its integrity
+    manifest — master host only (other hosts return None immediately);
+    both writes atomic via tmp+rename, payload before manifest; prunes to
+    the newest ``keep`` checkpoints."""
     if not dist.is_master():
         return None
     os.makedirs(directory, exist_ok=True)
     # nnx State → pure dicts, then one batched device→host fetch
     host_tree = jax.device_get(_purify(tree))
     data = serialization.to_bytes(host_tree)
-    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            f.write(data)
-        os.replace(tmp, _path(directory, step))
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+    _atomic_write(directory, _path(directory, step), data)
+    manifest = {
+        "format": MANIFEST_FORMAT,
+        "step": int(step),
+        "nbytes": len(data),
+        "sum64": payload_sum64(data),
+        # serial CRC32 only while it's cheap; sum64 carries integrity
+        # above the threshold (see _CRC32_MAX_BYTES)
+        "crc32": (zlib.crc32(data) & 0xFFFFFFFF)
+        if len(data) <= _CRC32_MAX_BYTES else None,
+        "tree_hash": tree_structure_hash(host_tree),
+    }
+    _atomic_write(
+        directory, _manifest_path(directory, step),
+        json.dumps(manifest).encode(),
+    )
     if keep > 0:
         for old in available_steps(directory)[:-keep]:
-            os.unlink(_path(directory, old))
+            # Idempotent prune: a concurrent prune (crashed-and-restarted
+            # master, operator cleanup) may have removed a path between
+            # our listing and the unlink — losing a save to that race
+            # would turn cleanup into a fault. Manifest goes FIRST so an
+            # interrupted prune leaves an uncertified payload (skipped by
+            # the verified fallback), never a certified dangling manifest.
+            with contextlib.suppress(FileNotFoundError):
+                os.unlink(_manifest_path(directory, old))
+            with contextlib.suppress(FileNotFoundError):
+                os.unlink(_path(directory, old))
     return _path(directory, step)
+
+
+def _load_verified_local(directory: str, pure_target: Any, logger):
+    """Single-host latest-checkpoint selection with integrity fallback:
+    newest→oldest, skipping any candidate that fails manifest CRC or
+    deserialization. Returns (pure_tree, step)."""
+    steps = available_steps(directory)
+    if not steps:
+        raise FileNotFoundError(f"no checkpoints in {directory!r}")
+    tried: list[str] = []
+    for step in reversed(steps):
+        manifest = read_manifest(directory, step)
+        try:
+            with open(_path(directory, step), "rb") as f:
+                data = f.read()
+        except OSError as e:
+            tried.append(f"step {step}: unreadable ({e})")
+            continue
+        if manifest is not None and not _payload_matches(manifest, data):
+            tried.append(f"step {step}: payload fails manifest CRC/size "
+                         "(truncated or corrupt)")
+            logger.warning(
+                "checkpoint step %d in %s fails integrity verification; "
+                "falling back to an older checkpoint", step, directory,
+            )
+            continue
+        try:
+            return serialization.from_bytes(pure_target, data), step
+        except Exception as e:  # opaque msgpack/structure error
+            tried.append(f"step {step}: deserialization failed "
+                         f"({type(e).__name__}: {e})")
+            logger.warning(
+                "checkpoint step %d in %s failed to deserialize (%s); "
+                "falling back to an older checkpoint", step, directory, e,
+            )
+            continue
+    raise CheckpointCorruptError(
+        f"every checkpoint in {directory!r} failed verification:\n  "
+        + "\n  ".join(tried)
+    )
 
 
 def load_checkpoint(directory: str, target: Any, *, step: int | None = None):
     """Restore the latest (or a specific) checkpoint into the structure of
     ``target`` (a pytree template, e.g. ``dp.state_dict()``). Returns
-    ``(tree, step)``. Raises FileNotFoundError when nothing exists.
+    ``(tree, step)``. Raises FileNotFoundError when nothing exists, and
+    :class:`CheckpointCorruptError` when an explicitly requested step (or
+    every candidate) fails integrity verification.
+
+    Latest-selection (``step=None``) is fault-tolerant: a candidate whose
+    manifest does not certify its payload, or whose payload fails to
+    deserialize, is skipped with a warning and the newest *verified* older
+    checkpoint restores instead — a preempted/interrupted writer can never
+    brick resume.
 
     Multi-host (shared filesystem): hosts first synchronize, then agree on
-    the step by taking the *master host's* latest — listing independently
-    could race the master's in-flight write/prune and restore different
-    steps per host, breaking the replicas-identical invariant. Followers
-    then open the agreed path directly (with a short retry) instead of
-    validating it against their *own* directory listing: on a shared
-    filesystem with attribute-cache lag the listing can omit a file that
-    is already readable.
+    the step by taking the *master host's* newest verified — listing
+    independently could race the master's in-flight write/prune and
+    restore different steps per host, breaking the replicas-identical
+    invariant. Followers then open the agreed path directly (with a short
+    retry) instead of validating it against their *own* directory listing:
+    on a shared filesystem with attribute-cache lag the listing can omit a
+    file that is already readable. Followers re-verify the payload against
+    the (retry-read) manifest, so every host restores byte-identical state.
     """
+    logger = dist.get_logger("tpu_syncbn.checkpoint")
     multi_host = dist.process_count() > 1
+    pure_target = _purify(target)
     if multi_host:
         dist.barrier("ckpt-load")
         if step is None:
             from jax.experimental import multihost_utils
             import numpy as np
 
-            local = available_steps(directory)
-            mine = np.asarray(local[-1] if local else -1, dtype=np.int32)
+            mine = np.asarray(_best_step(directory), dtype=np.int32)
             agreed = int(
                 multihost_utils.broadcast_one_to_all(
                     mine, is_source=dist.is_master()
                 )
             )
             if agreed < 0:
-                # master sees nothing: fail identically on every host
+                # master sees nothing usable: fail identically everywhere
                 raise FileNotFoundError(
-                    f"no checkpoints in {directory!r} on the master host"
+                    f"no loadable checkpoints in {directory!r} on the "
+                    "master host"
                 )
             step = agreed
     if multi_host and not dist.is_master():
         data = _read_with_retry(_path(directory, step))
-    else:
-        steps = available_steps(directory)
-        if not steps or (step is not None and step not in steps):
-            raise FileNotFoundError(
-                f"step {step} not in {steps}" if steps
-                else f"no checkpoints in {directory!r}"
+        manifest = _read_manifest_with_retry(directory, step)
+        if manifest is not None and not _payload_matches(manifest, data):
+            raise CheckpointCorruptError(
+                f"host {dist.process_index()}: step {step} payload does "
+                "not match its manifest (local read corrupt/truncated)"
             )
-        if step is None:
-            step = steps[-1]
-        with open(_path(directory, step), "rb") as f:
-            data = f.read()
-    pure_target = _purify(target)
-    pure = serialization.from_bytes(pure_target, data)
+        pure = serialization.from_bytes(pure_target, data)
+        return _unpurify(target, pure), step
+    if step is None:
+        pure, step = _load_verified_local(directory, pure_target, logger)
+        return _unpurify(target, pure), step
+    # explicit step: no fallback — the caller asked for THIS state
+    steps = available_steps(directory)
+    if step not in steps:
+        raise FileNotFoundError(
+            f"step {step} not in {steps}" if steps
+            else f"no checkpoints in {directory!r}"
+        )
+    with open(_path(directory, step), "rb") as f:
+        data = f.read()
+    manifest = read_manifest(directory, step)
+    if manifest is not None and not _payload_matches(manifest, data):
+        raise CheckpointCorruptError(
+            f"checkpoint step {step} in {directory!r} fails manifest "
+            f"verification (expected {manifest.get('nbytes')} bytes "
+            f"sum64={manifest.get('sum64')}, got {len(data)} bytes "
+            f"sum64={payload_sum64(data)})"
+        )
+    try:
+        pure = serialization.from_bytes(pure_target, data)
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"checkpoint step {step} in {directory!r} failed to "
+            f"deserialize ({type(e).__name__}: {e})"
+        ) from e
     return _unpurify(target, pure), step
+
+
+def _best_step(directory: str) -> int:
+    """Master's choice for multi-host agreement, mirroring the
+    single-host fallback walk (:func:`_load_verified_local`): newest
+    first, skipping only candidates whose manifest FAILS to certify
+    them; a legacy (manifest-less) step is a trusted candidate exactly
+    as it is single-host — the same directory must resume to the same
+    step regardless of process_count. -1 when every candidate is a
+    corrupt manifested checkpoint (or nothing exists)."""
+    for step in reversed(available_steps(directory)):
+        manifest = read_manifest(directory, step)
+        if manifest is None or verify_checkpoint(directory, step):
+            return step
+    return -1
 
 
 def _read_with_retry(path: str, attempts: int = 5, delay: float = 0.2) -> bytes:
@@ -176,3 +416,19 @@ def _read_with_retry(path: str, attempts: int = 5, delay: float = 0.2) -> bytes:
                 raise
             time.sleep(delay * (2**i))
     raise AssertionError("unreachable")
+
+
+def _read_manifest_with_retry(
+    directory: str, step: int, attempts: int = 3, delay: float = 0.2
+) -> dict | None:
+    """Follower-side manifest read: retries FileNotFoundError like the
+    payload read, but resolves to None (legacy checkpoint / still-lagging
+    listing) instead of raising — the payload is the authority, the
+    manifest an extra check when visible."""
+    try:
+        data = _read_with_retry(
+            _manifest_path(directory, step), attempts=attempts, delay=delay
+        )
+        return json.loads(data)
+    except (OSError, json.JSONDecodeError):
+        return None

@@ -65,6 +65,39 @@ class ThroughputMeter:
         return n / dt if dt > 0 else 0.0
 
 
+class EventCounter:
+    """Monotonic named counters for fault/recovery events (non-finite
+    steps skipped, divergence restores, preemption checkpoints, rendezvous
+    retries) — the observability half of the resilience layer
+    (docs/RESILIENCE.md): recovery should leave a countable trace, not
+    just log lines. Thread-safe (signal handlers and watchdog threads
+    bump concurrently with the step loop)."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+
+    def bump(self, name: str, n: int = 1) -> int:
+        """Increment ``name`` by ``n``; returns the new count."""
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+            return self._counts[name]
+
+    def count(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def summary(self) -> dict:
+        """Snapshot of every counter (plain dict, JSON-ready)."""
+        with self._lock:
+            return dict(self._counts)
+
+    def __repr__(self):
+        return f"EventCounter({self.summary()!r})"
+
+
 @contextlib.contextmanager
 def profiler_trace(log_dir: str, *, enabled: bool = True):
     """``jax.profiler`` trace around a code region (view in TensorBoard /
