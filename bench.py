@@ -25,8 +25,16 @@ line swung 32% across rounds on identical code) and the line is tagged
 persistent cache ahead of time: the AOT ``lower().compile()`` goes
 through the same jit instance as ``train_step``, so a later bench run's
 first step is a disk-hit compile instead of a window-sized fresh one.
+
+Observability: the line carries a ``telemetry`` block (the process
+registry snapshot — step-time/data-wait histograms, checkpoint timings,
+probe outcome, collective tallies; schema pinned by
+tests/test_bench_tooling.py) and ``--trace <path>`` writes a Chrome
+trace-event JSON (Perfetto-loadable) of the run's data-wait / step /
+checkpoint spans. docs/OBSERVABILITY.md documents both.
 """
 
+import itertools
 import json
 import os
 import sys
@@ -429,7 +437,17 @@ def measure_recovery(dp, *, repeats: int = 3) -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
-def main():
+def main(trace_path: str | None = None):
+    """``trace_path`` (the ``--trace`` flag) writes a Chrome trace-event
+    JSON of the run — data-wait/step/checkpoint spans — that loads
+    directly in Perfetto (docs/OBSERVABILITY.md). Telemetry is force-
+    enabled for the run regardless of TPU_SYNCBN_TELEMETRY, so the
+    printed line always carries a populated ``telemetry`` block."""
+    from tpu_syncbn.obs import stepstats, telemetry, tracing
+
+    telemetry.set_enabled(True)
+    tracer = tracing.install() if trace_path else None
+
     from tpu_syncbn.runtime import probe
 
     info = probe.ensure_backend(1)
@@ -478,12 +496,19 @@ def main():
         steps *= 6
         log(f"compile was a cache hit ({warm_s:.1f}s); extending to {steps} steps")
 
+    # instrumented loop: per-step "data_wait"/"step" spans + the
+    # step.time_s histogram (host DISPATCH time per step — jax dispatch
+    # is async, the final fetch_sync settles the chain). perf_counter
+    # pairs per step are noise relative to a step; the timing math below
+    # is unchanged.
     t0 = time.perf_counter()
-    for _ in range(steps):
-        out = dp.train_step(batch)
+    for b in stepstats.instrumented_batches(itertools.repeat(batch, steps)):
+        with stepstats.timed_span("step", "step.time_s"):
+            out = dp.train_step(b)
     fetch_sync(out.loss)  # the final loss value transitively forces
     # every step in the donated-state chain
     dt = time.perf_counter() - t0
+    telemetry.set_gauge("step.wall_avg_s", dt / steps)  # incl. device time
 
     img_per_sec = global_batch * steps / dt
     img_per_sec_per_chip = img_per_sec / n_chips
@@ -502,7 +527,8 @@ def main():
     # robustness cost, measured on the SAME training state the
     # throughput number used — an annotation, never fatal to the metric
     try:
-        recovery = measure_recovery(dp)
+        with stepstats.timed_span("recovery", "bench.recovery_s"):
+            recovery = measure_recovery(dp)
         log(f"recovery: manifest overhead "
             f"{recovery['manifest_overhead_frac']:+.1%}, resume-after-kill "
             f"{recovery['resume_after_kill_s']:.3f}s")
@@ -550,7 +576,18 @@ def main():
         # of anything the project tracks — cross-round diffs of it are
         # meaningless and tagged as such
         "smoke_only": not on_accel,
+        # process-wide telemetry snapshot (obs.telemetry schema 1):
+        # step-time/data-wait histograms, checkpoint timings, probe
+        # outcome, trace-time collective tallies — validated by
+        # tests/test_bench_tooling.py so output drift fails tier-1
+        "telemetry": telemetry.snapshot(),
     }
+    if tracer is not None:
+        # written BEFORE the JSON line so a driver parsing stdout can
+        # rely on the trace already existing
+        tracer.save(trace_path)
+        log(f"chrome trace written to {trace_path} "
+            "(open in https://ui.perfetto.dev)")
     print(json.dumps(line))
     if backend == "tpu":
         # append every hardware sample to a history log: step times
@@ -568,7 +605,14 @@ def main():
 
 
 if __name__ == "__main__":
-    if "--flops-only" in sys.argv[1:]:
+    argv = sys.argv[1:]
+    if "--flops-only" in argv:
         flops_only()
     else:
-        main()
+        trace = None
+        if "--trace" in argv:
+            i = argv.index("--trace")
+            if i + 1 >= len(argv):
+                raise SystemExit("--trace requires a path argument")
+            trace = argv[i + 1]
+        main(trace_path=trace)

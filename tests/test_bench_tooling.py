@@ -434,6 +434,85 @@ def test_every_battery_stage_has_a_runner():
         v._stage_runner("nonexistent_stage")
 
 
+class TestTelemetryBlock:
+    """bench's `telemetry` block and `--trace` output: the schema the
+    perf trajectory is read through. Drift here must fail tier-1, not
+    silently break later rounds' analysis (ISSUE 2 satellite)."""
+
+    def _tiny_build(self):
+        """Stand-in for bench.build_program with the same contract —
+        the block's schema, not the ResNet-50 workload, is under test."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from flax import nnx
+
+        from tpu_syncbn import nn as tnn, parallel
+
+        class Net(nnx.Module):
+            def __init__(self, rngs):
+                self.fc = nnx.Linear(8, 8, rngs=rngs)
+                self.bn = tnn.BatchNorm1d(8)
+
+            def __call__(self, x):
+                return self.bn(self.fc(x))
+
+        def build(per_chip_batch, side, *, with_flops=True):
+            dp = parallel.DataParallel(
+                tnn.convert_sync_batchnorm(Net(nnx.Rngs(0))),
+                optax.sgd(0.1), lambda m, b: (m(b) ** 2).mean(),
+            )
+            batch = jax.device_put(
+                jnp.ones((8, 8), jnp.float32), dp.batch_sharding
+            )
+            return dp, batch, None
+
+        return build
+
+    def test_bench_line_telemetry_and_trace_validate(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        from tpu_syncbn.obs import telemetry, tracing
+
+        bench = _load_bench()
+        monkeypatch.setenv("TPU_SYNCBN_FORCE_CPU", "1")
+        monkeypatch.setenv("BENCH_STEPS", "3")
+        monkeypatch.setattr(bench, "build_program", self._tiny_build())
+        telemetry.REGISTRY.reset()
+        trace = str(tmp_path / "t.json")
+        try:
+            bench.main(trace_path=trace)
+        finally:
+            # main() force-enables telemetry and installs a tracer;
+            # restore the suite's ambient state
+            telemetry.set_enabled(None)
+            telemetry.REGISTRY.reset()
+            tracing.uninstall()
+        line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        # the block validates against the pinned schema...
+        tel = telemetry.validate_snapshot(line["telemetry"])
+        # ...with nonzero step-time histogram counts (the acceptance bar)
+        assert tel["histograms"]["step.time_s"]["count"] == 3
+        assert tel["histograms"]["step.data_wait_s"]["count"] == 3
+        # checkpoint + probe activity of the run is visible in the block
+        assert tel["counters"]["checkpoint.saves"] >= 1
+        assert tel["counters"]["probe.forced_cpu"] >= 1
+        # the --trace file is valid Chrome trace JSON with the three
+        # span families a step loop produces
+        events = tracing.validate_trace(tracing.load_trace(trace))
+        names = {e["name"] for e in events}
+        assert {"data_wait", "step"} <= names
+        assert any(n.startswith("checkpoint") for n in names)
+
+    def test_trace_flag_requires_path(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "bench.py"), "--trace"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode != 0
+        assert "--trace requires a path" in proc.stderr
+
+
 class TestRecoveryBlock:
     """bench's `recovery` block: the robustness-cost measurement that
     rides the BENCH_*.json line (manifest overhead + time-to-resume

@@ -1,0 +1,508 @@
+"""The observability subsystem (tpu_syncbn.obs): telemetry registry
+semantics, Chrome-trace span mechanics, the disabled-path cost contract,
+multi-host export merging, and the on-device step monitors riding
+``StepOutput``.
+
+Reference parity note: the torch recipe's observability is rank-0
+printing (reference ``README.md:9``) — everything here is OUR
+measurement substrate (docs/OBSERVABILITY.md), so its semantics are
+pinned directly.
+"""
+
+import json
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import nnx
+
+from tpu_syncbn import nn as tnn, parallel, utils
+from tpu_syncbn.obs import stepstats, telemetry, tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """Every test starts with telemetry at its env default, an empty
+    process registry, and no installed tracer — and leaves it that way."""
+    telemetry.set_enabled(None)
+    telemetry.REGISTRY.reset()
+    tracing.uninstall()
+    yield
+    telemetry.set_enabled(None)
+    telemetry.REGISTRY.reset()
+    tracing.uninstall()
+
+
+# ------------------------------------------------------------- instruments
+
+
+class TestCounterGaugeHistogram:
+    def test_counter_monotonic(self):
+        r = telemetry.Registry()
+        c = r.counter("x")
+        assert c.inc() == 1
+        assert c.inc(4) == 5
+        assert c.value == 5
+        assert r.counter("x") is c  # same instrument on re-lookup
+
+    def test_gauge_last_write_wins(self):
+        r = telemetry.Registry()
+        g = r.gauge("q")
+        g.set(3)
+        g.set(1.5)
+        assert g.value == 1.5
+
+    def test_histogram_bucketing(self):
+        r = telemetry.Registry()
+        h = r.histogram("h", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.1, 0.5, 1.0, 5.0, 100.0):
+            h.observe(v)
+        s = h.snapshot()
+        # <=0.1 | <=1.0 | <=10.0 | overflow — boundary values land in
+        # their "<=" bucket
+        assert s["counts"] == [2, 2, 1, 1]
+        assert s["count"] == 6 and s["min"] == 0.05 and s["max"] == 100.0
+        assert s["sum"] == pytest.approx(106.65)
+
+    def test_histogram_rejects_bad_buckets(self):
+        with pytest.raises(ValueError, match="increasing"):
+            telemetry.Histogram("h", buckets=(1.0, 1.0))
+
+    def test_kind_clash_is_loud(self):
+        r = telemetry.Registry()
+        r.counter("name")
+        with pytest.raises(ValueError, match="already a counter"):
+            r.gauge("name")
+
+    def test_snapshot_schema_validates(self):
+        r = telemetry.Registry()
+        r.counter("c").inc()
+        r.gauge("g").set(1.0)
+        r.histogram("h").observe(0.2)
+        snap = telemetry.validate_snapshot(r.snapshot())
+        assert snap["counters"]["c"] == 1
+        # and the validator is not a rubber stamp
+        bad = r.snapshot()
+        bad["histograms"]["h"]["count"] = 99
+        with pytest.raises(ValueError, match="count"):
+            telemetry.validate_snapshot(bad)
+
+    def test_counter_thread_safety(self):
+        r = telemetry.Registry()
+        c = r.counter("n")
+
+        def work():
+            for _ in range(1000):
+                c.inc()
+
+        ts = [threading.Thread(target=work) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert c.value == 8000
+
+
+# ---------------------------------------------------------- enable gating
+
+
+class TestDisabledPath:
+    def test_env_gate(self, monkeypatch):
+        monkeypatch.delenv("TPU_SYNCBN_TELEMETRY", raising=False)
+        telemetry.set_enabled(None)
+        assert not telemetry.enabled()
+        monkeypatch.setenv("TPU_SYNCBN_TELEMETRY", "1")
+        telemetry.set_enabled(None)  # re-read env
+        assert telemetry.enabled()
+
+    def test_disabled_ops_touch_nothing(self, monkeypatch):
+        monkeypatch.delenv("TPU_SYNCBN_TELEMETRY", raising=False)
+        telemetry.set_enabled(None)
+        telemetry.count("a")
+        telemetry.set_gauge("b", 1.0)
+        telemetry.observe("c", 0.5)
+        with telemetry.timed("d"):
+            pass
+        assert len(telemetry.REGISTRY) == 0
+
+    def test_disabled_overhead_guard(self, monkeypatch):
+        """The hot-path contract: registry helpers must stay cheap with
+        TPU_SYNCBN_TELEMETRY unset — bounded here at 200k no-op calls
+        in well under a second (a real regression, e.g. creating
+        instruments or taking locks when disabled, is an order of
+        magnitude slower)."""
+        monkeypatch.delenv("TPU_SYNCBN_TELEMETRY", raising=False)
+        telemetry.set_enabled(None)
+        t0 = time.perf_counter()
+        for _ in range(200_000):
+            telemetry.count("hot")
+        dt = time.perf_counter() - t0
+        assert len(telemetry.REGISTRY) == 0
+        assert dt < 2.0, f"disabled-path count() took {dt:.2f}s for 200k calls"
+
+    def test_enabled_ops_record(self):
+        telemetry.set_enabled(True)
+        telemetry.count("a", 2)
+        telemetry.observe("lat", 0.01)
+        snap = telemetry.snapshot()
+        assert snap["counters"]["a"] == 2
+        assert snap["histograms"]["lat"]["count"] == 1
+
+
+# -------------------------------------------------------- counter groups
+
+
+class TestCounterGroup:
+    def test_eventcounter_is_countergroup_alias(self):
+        assert issubclass(utils.EventCounter, telemetry.CounterGroup)
+        c = utils.EventCounter()
+        assert c.bump("x") == 1 and c.bump("x", 2) == 3
+        assert c.count("y") == 0
+        assert c.summary() == {"x": 3}
+
+    def test_group_counts_without_telemetry(self):
+        telemetry.set_enabled(False)
+        g = telemetry.CounterGroup("resilience")
+        g.bump("restores")
+        assert g.count("restores") == 1  # local counts unconditional
+        assert len(telemetry.REGISTRY) == 0  # no mirror when disabled
+
+    def test_group_mirrors_into_registry_when_enabled(self):
+        telemetry.set_enabled(True)
+        g = telemetry.CounterGroup("resilience")
+        g.bump("restores", 3)
+        assert telemetry.REGISTRY.counter("resilience.restores").value == 3
+
+
+# ------------------------------------------------------------- tracing
+
+
+class TestTracing:
+    def test_span_nesting_and_ids(self):
+        t = tracing.Tracer()
+        with t.span("outer") as outer_id:
+            assert t.current_span_id() == outer_id
+            assert t.latest_open_span_id() == outer_id
+            with t.span("inner", step=3) as inner_id:
+                assert inner_id != outer_id
+                assert t.current_span_id() == inner_id
+                assert t.latest_open_span_id() == inner_id
+        assert t.current_span_id() is None
+        assert t.latest_open_span_id() is None
+        by_name = {e["name"]: e for e in t.events}
+        assert by_name["inner"]["args"]["parent_id"] == outer_id
+        assert by_name["inner"]["args"]["step"] == 3
+        assert "parent_id" not in by_name["outer"]["args"]
+        # inner closed first, so it is appended first
+        assert [e["name"] for e in t.events] == ["inner", "outer"]
+
+    def test_trace_file_is_valid_chrome_trace_json(self, tmp_path):
+        t = tracing.Tracer()
+        with t.span("step"):
+            with t.span("data_wait"):
+                pass
+        t.instant("watchdog_stall", span_id=1)
+        p = str(tmp_path / "trace.json")
+        t.save(p)
+        doc = json.loads(open(p).read())  # plain JSON, no trailing junk
+        assert isinstance(doc["traceEvents"], list)
+        events = tracing.validate_trace(tracing.load_trace(p))
+        names = {e["name"] for e in events}
+        assert {"step", "data_wait", "watchdog_stall"} <= names
+        for e in events:
+            if e["ph"] == "X":
+                assert e["dur"] >= 0 and e["ts"] >= 0
+
+    def test_module_span_is_noop_without_tracer(self):
+        # no tracer installed: a shared null context, no events anywhere
+        with tracing.span("x"):
+            assert tracing.current_span_id() is None
+        assert tracing.latest_open_span_id() is None
+
+    def test_install_uninstall_roundtrip(self):
+        t = tracing.install()
+        with tracing.span("a") as sid:
+            assert sid is not None
+        assert tracing.uninstall() is t
+        assert tracing.get() is None
+        assert [e["name"] for e in t.events] == ["a"]
+
+    def test_spans_survive_exceptions(self):
+        t = tracing.Tracer()
+        with pytest.raises(RuntimeError):
+            with t.span("boom"):
+                raise RuntimeError("x")
+        assert t.events[0]["name"] == "boom"
+        assert t.latest_open_span_id() is None
+
+
+# ---------------------------------------------------------- export/merge
+
+
+class TestRank0Merge:
+    def test_merge_two_hosts(self, tmp_path):
+        r0, r1 = telemetry.Registry(), telemetry.Registry()
+        r0.counter("steps").inc(10)
+        r1.counter("steps").inc(12)
+        r0.histogram("step.time_s").observe(0.01)
+        r1.histogram("step.time_s").observe(3.0)
+        r0.gauge("queue_depth").set(1)
+        r1.gauge("queue_depth").set(7)
+        p0 = str(tmp_path / "host0.jsonl")
+        p1 = str(tmp_path / "host1.jsonl")
+        r0.export_jsonl(p0, host=0)
+        r1.export_jsonl(p1, host=1)
+        merged = telemetry.merge_exports([p0, p1])
+        assert merged["hosts"] == [0, 1]
+        assert merged["counters"]["steps"] == 22
+        h = merged["histograms"]["step.time_s"]
+        assert h["count"] == 2 and sum(h["counts"]) == 2
+        assert h["min"] == 0.01 and h["max"] == 3.0
+        assert merged["gauges"]["queue_depth"] == 7  # last write wins
+        # and the written summary round-trips
+        out = str(tmp_path / "summary.json")
+        summary = telemetry.write_merged_summary([p0, p1], out)
+        assert json.loads(open(out).read()) == summary
+
+    def test_bucket_drift_refuses_merge(self, tmp_path):
+        r0, r1 = telemetry.Registry(), telemetry.Registry()
+        r0.histogram("h", buckets=(1.0, 2.0)).observe(1.0)
+        r1.histogram("h", buckets=(1.0, 5.0)).observe(1.0)
+        p0 = str(tmp_path / "a.jsonl")
+        p1 = str(tmp_path / "b.jsonl")
+        r0.export_jsonl(p0, host=0)
+        r1.export_jsonl(p1, host=1)
+        with pytest.raises(ValueError, match="bucket"):
+            telemetry.merge_exports([p0, p1])
+
+
+# ------------------------------------------------------------- stepstats
+
+
+class TestStepstatsHost:
+    def test_timed_span_records_both(self):
+        telemetry.set_enabled(True)
+        t = tracing.install()
+        with stepstats.timed_span("step", "step.time_s"):
+            pass
+        assert telemetry.snapshot()["histograms"]["step.time_s"]["count"] == 1
+        assert t.events[0]["name"] == "step"
+
+    def test_instrumented_batches_passthrough(self):
+        telemetry.set_enabled(True)
+        out = list(stepstats.instrumented_batches(iter([1, 2, 3])))
+        assert out == [1, 2, 3]
+        h = telemetry.snapshot()["histograms"]["step.data_wait_s"]
+        assert h["count"] == 3
+
+    def test_zero_cost_when_all_off(self):
+        telemetry.set_enabled(False)
+        with stepstats.timed_span("step", "step.time_s"):
+            pass
+        assert len(telemetry.REGISTRY) == 0
+
+    def test_device_prefetch_excludes_terminal_fetch(self):
+        # the end-of-epoch StopIteration wait must not be a data-wait
+        # sample (it would add one outlier per epoch)
+        from tpu_syncbn.data import device_prefetch
+
+        telemetry.set_enabled(True)
+        batches = [np.ones((4,), np.float32)] * 3
+        out = list(device_prefetch(iter(batches)))
+        assert len(out) == 3
+        snap = telemetry.snapshot()
+        assert snap["histograms"]["loader.data_wait_s"]["count"] == 3
+        assert snap["histograms"]["loader.h2d_s"]["count"] == 3
+
+
+class _Net(nnx.Module):
+    def __init__(self, rngs):
+        self.fc = nnx.Linear(8, 8, rngs=rngs)
+        self.bn = tnn.BatchNorm1d(8)
+
+    def __call__(self, x):
+        return self.bn(self.fc(x))
+
+
+def _loss(m, b):
+    return (m(b) ** 2).mean()
+
+
+class TestOnDeviceMonitors:
+    """The StepOutput.monitors contract: health scalars computed inside
+    the compiled step (no extra host syncs — they are ordinary async
+    step outputs)."""
+
+    def _dp(self, **kw):
+        return parallel.DataParallel(
+            tnn.convert_sync_batchnorm(_Net(nnx.Rngs(0))),
+            optax.sgd(0.1), _loss, **kw,
+        )
+
+    def test_monitor_keys_and_values(self):
+        out = self._dp().train_step(jnp.ones((16, 8), jnp.float32))
+        mon = {k: float(v) for k, v in out.monitors.items()}
+        assert {"grad_norm", "grad_nonfinite", "state_nonfinite",
+                "bn_mean_max_abs", "bn_var_max", "bn_var_min",
+                "bn_layers"} <= set(mon)
+        assert mon["grad_norm"] >= 0 and np.isfinite(mon["grad_norm"])
+        assert mon["grad_nonfinite"] == 0
+        assert mon["state_nonfinite"] == 0
+        assert mon["bn_layers"] == 1
+        assert mon["bn_var_max"] >= mon["bn_var_min"] > 0
+
+    def test_full_mode_emits_per_layer_keys(self):
+        out = self._dp(monitors="full").train_step(
+            jnp.ones((16, 8), jnp.float32)
+        )
+        assert any(k.startswith("bn_var_min.") for k in out.monitors)
+
+    def test_monitors_off_is_empty(self):
+        out = self._dp(monitors=False).train_step(
+            jnp.ones((16, 8), jnp.float32)
+        )
+        assert out.monitors == {}
+
+    def test_zero_mode_grad_norm_matches_replicated(self):
+        x = jnp.linspace(-1, 1, 16 * 8).reshape(16, 8).astype(jnp.float32)
+        plain = self._dp().train_step(x)
+        zero = self._dp(zero=True).train_step(x)
+        np.testing.assert_allclose(
+            float(zero.monitors["grad_norm"]),
+            float(plain.monitors["grad_norm"]), rtol=1e-4,
+        )
+
+    def test_nonfinite_batch_is_counted(self):
+        dp = self._dp(divergence_guard="skip_step")
+        x = jnp.full((16, 8), jnp.nan, jnp.float32)
+        out = dp.train_step(x)
+        assert float(out.monitors["grad_nonfinite"]) > 0
+        assert float(out.metrics["nonfinite"]) == 1.0
+
+    def test_invalid_monitors_value_rejected(self):
+        with pytest.raises(ValueError, match="monitors"):
+            self._dp(monitors="everything")
+
+    def test_gan_trainer_rejects_bad_monitors_value(self):
+        # GANTrainer shares DataParallel's monitors contract — unknown
+        # values must raise, not silently coerce to bool
+        with pytest.raises(ValueError, match="monitors"):
+            parallel.GANTrainer(
+                _Net(nnx.Rngs(0)), _Net(nnx.Rngs(1)),
+                optax.sgd(0.1), optax.sgd(0.1), monitors="everything",
+            )
+
+
+class TestStateHealthUnit:
+    def test_classifies_running_stats_by_path(self):
+        state = {
+            "bn": {"running_mean": jnp.array([0.5, -2.0]),
+                   "running_var": jnp.array([0.1, 4.0]),
+                   "num_batches_tracked": jnp.array(3, jnp.int32)},
+            "other": jnp.array([jnp.inf]),
+        }
+        h = {k: float(v) for k, v in stepstats.state_health(state).items()}
+        assert h["bn_mean_max_abs"] == 2.0
+        assert h["bn_var_max"] == 4.0 and h["bn_var_min"] == pytest.approx(0.1)
+        assert h["bn_layers"] == 1
+        assert h["state_nonfinite"] == 1  # the inf in "other"
+
+    def test_no_bn_state_reports_vacuous_defaults(self):
+        h = {k: float(v)
+             for k, v in stepstats.state_health({"w": jnp.ones(3)}).items()}
+        assert h["bn_layers"] == 0
+        assert h["bn_var_max"] == 0 and h["bn_mean_max_abs"] == 0
+
+
+# ------------------------------------------------ correlation / wiring
+
+
+class TestSpanCorrelation:
+    def test_watchdog_stall_dump_carries_span_id(self, caplog):
+        from tpu_syncbn.runtime import resilience
+
+        telemetry.set_enabled(True)
+        t = tracing.install()
+        with t.span("step") as sid:
+            with resilience.Watchdog(0.05, name="corr-test",
+                                     poll_s=0.01) as wd:
+                deadline = time.monotonic() + 5
+                while wd.stall_count == 0 and time.monotonic() < deadline:
+                    time.sleep(0.01)
+        assert wd.stall_count >= 1
+        counters = telemetry.snapshot()["counters"]
+        assert counters["resilience.watchdog_stalls"] >= 1
+        marks = [e for e in t.events if e["name"] == "watchdog_stall"]
+        assert marks and marks[0]["args"]["span_id"] == sid
+
+    def test_resilient_loop_counters_share_export_path(self, tmp_path):
+        from tpu_syncbn.runtime import resilience
+
+        telemetry.set_enabled(True)
+        dp = parallel.DataParallel(
+            tnn.convert_sync_batchnorm(_Net(nnx.Rngs(0))),
+            optax.sgd(0.1), _loss,
+        )
+        loop = resilience.ResilientLoop(dp, str(tmp_path), ckpt_every=2)
+        batches = [jnp.ones((16, 8), jnp.float32)] * 4
+        summary = loop.run(batches)
+        assert summary["steps"] == 4 and summary["checkpoints"] == 2
+        snap = telemetry.snapshot()
+        # the loop's CounterGroup mirrored into the registry...
+        assert snap["counters"]["resilience.checkpoints"] == 2
+        # ...and its step loop fed the step/data-wait histograms
+        assert snap["histograms"]["step.time_s"]["count"] == 4
+        assert snap["histograms"]["checkpoint.save_s"]["count"] == 2
+
+    def test_checkpoint_timings_recorded(self, tmp_path):
+        from tpu_syncbn.utils import checkpoint as ckpt
+
+        telemetry.set_enabled(True)
+        t = tracing.install()
+        tree = {"w": np.arange(8, dtype=np.float32)}
+        ckpt.save_checkpoint(str(tmp_path), 1, tree)
+        ckpt.load_checkpoint(str(tmp_path), tree)
+        assert ckpt.verify_checkpoint(str(tmp_path), 1)
+        snap = telemetry.snapshot()
+        assert snap["counters"]["checkpoint.saves"] == 1
+        assert snap["counters"]["checkpoint.loads"] == 1
+        assert snap["histograms"]["checkpoint.save_s"]["count"] == 1
+        assert snap["histograms"]["checkpoint.load_s"]["count"] == 1
+        assert snap["histograms"]["checkpoint.verify_s"]["count"] == 1
+        names = {e["name"] for e in t.events}
+        assert {"checkpoint_save", "checkpoint_load",
+                "checkpoint_verify"} <= names
+
+    def test_collective_tallies_count_at_trace_time(self):
+        telemetry.set_enabled(True)
+        dp = parallel.DataParallel(
+            tnn.convert_sync_batchnorm(_Net(nnx.Rngs(0))),
+            optax.sgd(0.1), _loss,
+        )
+        dp.train_step(jnp.ones((16, 8), jnp.float32))
+        tallies = stepstats.collective_tallies()
+        assert tallies.get("collectives.pmean.calls", 0) >= 1
+        assert tallies.get("collectives.pmean.bytes", 0) > 0
+
+    def test_loader_telemetry(self):
+        from tpu_syncbn.data import DataLoader
+
+        telemetry.set_enabled(True)
+
+        class DS:
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return np.full((4,), i, np.float32)
+
+        loader = DataLoader(DS(), batch_size=4, num_workers=2)
+        batches = list(loader)
+        assert len(batches) == 4
+        snap = telemetry.snapshot()
+        assert snap["counters"]["loader.batches"] == 4
+        assert snap["histograms"]["loader.fetch_wait_s"]["count"] == 4
+        assert "loader.queue_depth" in snap["gauges"]

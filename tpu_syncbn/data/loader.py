@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterator, Sequence
 
 import jax
@@ -28,6 +29,8 @@ import numpy as np
 
 from tpu_syncbn.data.dataset import Dataset
 from tpu_syncbn.data.sampler import Sampler, SequentialSampler
+from tpu_syncbn.obs import stepstats as obs_stepstats
+from tpu_syncbn.obs import telemetry
 
 
 class WorkerError(RuntimeError):
@@ -111,14 +114,30 @@ def _bounded_put(q, item, stop: threading.Event) -> bool:
     return False
 
 
+def _queue_depth(out_queues) -> int:
+    """Total batches currently buffered across worker out-queues; -1
+    where the platform's mp.Queue cannot answer (macOS qsize)."""
+    try:
+        return sum(q.qsize() for q in out_queues)
+    except (NotImplementedError, OSError):
+        return -1
+
+
 def _consume_ordered(out_queues, dispatch_error, *, epoch=0, idle_check=None):
     """Yield batches in dispatch order from per-worker out queues (batch
     ``seq`` was dispatched to worker ``seq % n`` round-robin, so reading
     the queues round-robin restores global order). ``idle_check(wid)``
-    may return a final drained item or raise for a dead worker."""
+    may return a final drained item or raise for a dead worker.
+
+    Telemetry (when enabled): per-batch ``loader.fetch_wait_s`` (time the
+    consumer spent inside this generator waiting on workers — queue
+    starvation shows up here), a ``loader.queue_depth`` gauge sampled at
+    each yield (0 with a step-bound consumer means the loader is the
+    bottleneck), and a ``loader.batches`` counter."""
     n = len(out_queues)
     done = [False] * n
     seq = 0
+    t_resume = time.perf_counter()
     while not all(done):
         wid = seq % n
         if done[wid]:
@@ -147,7 +166,16 @@ def _consume_ordered(out_queues, dispatch_error, *, epoch=0, idle_check=None):
             if isinstance(payload, BaseException):
                 raise payload  # thread worker: original exception object
             raise WorkerError(f"error in worker {wid}:\n{payload}")
+        if telemetry.enabled():
+            telemetry.observe(
+                "loader.fetch_wait_s", time.perf_counter() - t_resume
+            )
+            telemetry.set_gauge(
+                "loader.queue_depth", _queue_depth(out_queues)
+            )
+            telemetry.count("loader.batches")
         yield payload
+        t_resume = time.perf_counter()
         seq += 1
 
 
@@ -606,16 +634,30 @@ def device_prefetch(
             lambda a: jax.device_put(a, sharding), batch
         )
 
+    def staged(it):
+        """Fetch + stage the next batch, instrumented (obs.stepstats):
+        ``data_wait`` is the blocking wait on the host iterator,
+        ``h2d`` the device_put *dispatch* (the DMA itself is async —
+        overlap is the point, so the span measures dispatch, not
+        transfer completion). The terminal StopIteration fetch is NOT a
+        wait sample (stepstats.timed_fetch) — recording it would add one
+        end-of-epoch outlier per epoch."""
+        batch = obs_stepstats.timed_fetch(
+            it, "data_wait", "loader.data_wait_s"
+        )
+        with obs_stepstats.timed_span("h2d", "loader.h2d_s"):
+            return put(batch)
+
     buf: list = []
     it = iter(iterator)
     try:
         while len(buf) < size:
-            buf.append(put(next(it)))
+            buf.append(staged(it))
     except StopIteration:
         pass
     while buf:
         yield buf.pop(0)
         try:
-            buf.append(put(next(it)))
+            buf.append(staged(it))
         except StopIteration:
             continue

@@ -1,0 +1,42 @@
+"""Observability subsystem: structured telemetry, step tracing, step stats.
+
+Three modules, one budget rule — near-zero cost when off:
+
+* :mod:`tpu_syncbn.obs.telemetry` — process-wide named counters, gauges,
+  and fixed-bucket histograms; env-gated (``TPU_SYNCBN_TELEMETRY``),
+  JSONL export per host, rank-0 merged summary.
+* :mod:`tpu_syncbn.obs.tracing` — nestable wall-clock spans emitted in
+  Chrome trace-event format (opens directly in Perfetto /
+  ``chrome://tracing``), with span ids for log correlation and an
+  optional ``jax.profiler`` bridge.
+* :mod:`tpu_syncbn.obs.stepstats` — per-step breakdown helpers: host-side
+  data-wait / transfer / step timing seams, and on-device scalar
+  monitors (grad norm, BN running-stat health, non-finite counts) that
+  ride the compiled step's outputs so no extra device syncs are added.
+
+See docs/OBSERVABILITY.md for knobs, schemas, and the Perfetto how-to.
+"""
+
+from tpu_syncbn.obs import stepstats, telemetry, tracing  # noqa: F401
+from tpu_syncbn.obs.telemetry import (  # noqa: F401
+    REGISTRY,
+    Counter,
+    CounterGroup,
+    Gauge,
+    Histogram,
+    Registry,
+)
+from tpu_syncbn.obs.tracing import Tracer  # noqa: F401
+
+__all__ = [
+    "telemetry",
+    "tracing",
+    "stepstats",
+    "REGISTRY",
+    "Registry",
+    "Counter",
+    "CounterGroup",
+    "Gauge",
+    "Histogram",
+    "Tracer",
+]

@@ -1,0 +1,519 @@
+"""Process-wide structured telemetry: counters, gauges, histograms.
+
+The reference recipe's entire observability story is rank-0 console
+printing (``README.md:9``); this module is the queryable replacement:
+every subsystem (trainer, loader, checkpoint store, resilience layer,
+rendezvous, collectives, backend probe) records into ONE process-wide
+:class:`Registry`, exported as JSONL per host and mergeable into a rank-0
+summary. ``bench.py`` embeds the registry snapshot as the ``telemetry``
+block of its JSON line, which is how step-time and sync-cost trends are
+tracked across rounds (DS-Sync, arxiv 2007.03298, and EQuARX, arxiv
+2506.17615, both make the case that per-step sync cost must be measured
+before it can be optimized).
+
+Cost contract: telemetry is **off by default** and gated by the
+``TPU_SYNCBN_TELEMETRY`` env var (truthy: ``1/true/on/yes``) or an
+explicit :func:`set_enabled`. The module-level helpers (:func:`count`,
+:func:`observe`, :func:`set_gauge`, :func:`timed`) check one cached bool
+and return immediately when disabled — no allocation, no lock, no
+instrument creation — so instrumentation can live on hot paths
+(``tests/test_obs.py`` guards this). Instrument objects obtained
+directly from a :class:`Registry` (and :class:`CounterGroup`, the
+resilience layer's counter surface) always record: a recovery event must
+leave a countable trace whether or not telemetry export is on.
+
+Everything here is stdlib-only (no jax import at module scope) so any
+layer can import it without ordering hazards.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from bisect import bisect_left
+from typing import Any, Iterable, Sequence
+
+_ENV_FLAG = "TPU_SYNCBN_TELEMETRY"
+_TRUTHY = ("1", "true", "on", "yes")
+
+#: Bump when the snapshot/JSONL schema changes incompatibly
+#: (tests/test_bench_tooling.py pins bench's block against this).
+SCHEMA_VERSION = 1
+
+#: Default histogram buckets for durations in seconds: a 1-2.5-5 log
+#: ladder from 100µs to 5min. Fixed buckets (not t-digests) keep
+#: ``observe`` O(log n) with no allocation and make cross-host merges a
+#: plain vector add.
+DEFAULT_TIME_BUCKETS_S = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+_enabled: bool | None = None
+
+
+def enabled() -> bool:
+    """Is telemetry recording on? Cached after the first env read — the
+    disabled path is one global load + one ``is None`` + one bool test."""
+    global _enabled
+    if _enabled is None:
+        _enabled = (
+            os.environ.get(_ENV_FLAG, "").strip().lower() in _TRUTHY
+        )
+    return _enabled
+
+
+def set_enabled(value: bool | None) -> None:
+    """Force telemetry on/off, or ``None`` to re-read the env gate on the
+    next :func:`enabled` call (tests; ``bench.py`` forces True so its
+    ``telemetry`` block is never empty)."""
+    global _enabled
+    _enabled = None if value is None else bool(value)
+
+
+# ---------------------------------------------------------------------------
+# instruments
+
+
+class Counter:
+    """Monotonic integer counter."""
+
+    kind = "counter"
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> int:
+        """Add ``n``; returns the new value."""
+        with self._lock:
+            self._value += int(n)
+            return self._value
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+    def snapshot(self) -> int:
+        return self.value
+
+
+class Gauge:
+    """Last-written float value (queue depth, probe latency, load)."""
+
+    kind = "gauge"
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def snapshot(self) -> float:
+        return self.value
+
+
+class Histogram:
+    """Fixed-bucket histogram. ``counts[i]`` is the number of observations
+    ``<= buckets[i]`` (and ``counts[-1]`` the overflow above the last
+    boundary), so ``len(counts) == len(buckets) + 1``. Also tracks
+    count/sum/min/max for cheap means and ranges."""
+
+    kind = "histogram"
+    __slots__ = ("name", "buckets", "_lock", "_counts", "_count", "_sum",
+                 "_min", "_max")
+
+    def __init__(self, name: str, buckets: Sequence[float] = DEFAULT_TIME_BUCKETS_S):
+        b = tuple(float(x) for x in buckets)
+        if not b or list(b) != sorted(set(b)):
+            raise ValueError(
+                f"histogram buckets must be strictly increasing, got {buckets!r}"
+            )
+        self.name = name
+        self.buckets = b
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(b) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._min: float | None = None
+        self._max: float | None = None
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        # bisect_left: v equal to a boundary belongs to that boundary's
+        # "<=" bucket, anything above the last boundary to the overflow
+        i = bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += v
+            self._min = v if self._min is None else min(self._min, v)
+            self._max = v if self._max is None else max(self._max, v)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "buckets": list(self.buckets),
+                "counts": list(self._counts),
+                "count": self._count,
+                "sum": self._sum,
+                "min": self._min,
+                "max": self._max,
+            }
+
+
+# ---------------------------------------------------------------------------
+# registry
+
+
+class Registry:
+    """Thread-safe name → instrument map. One process-wide instance
+    (:data:`REGISTRY`) backs the module helpers; tests build private
+    ones. A name is permanently bound to its first kind — a
+    counter/gauge/histogram clash raises instead of silently aliasing."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._instruments: dict[str, Any] = {}
+
+    def _get(self, name: str, factory, kind: str):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = self._instruments[name] = factory()
+            elif inst.kind != kind:
+                raise ValueError(
+                    f"telemetry name {name!r} is already a {inst.kind}, "
+                    f"not a {kind}"
+                )
+            return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, lambda: Counter(name), "counter")
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, lambda: Gauge(name), "gauge")
+
+    def histogram(
+        self, name: str, buckets: Sequence[float] = DEFAULT_TIME_BUCKETS_S
+    ) -> Histogram:
+        """Get/create a histogram. ``buckets`` applies only at creation;
+        later calls return the existing instrument unchanged."""
+        return self._get(name, lambda: Histogram(name, buckets), "histogram")
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._instruments)
+
+    def reset(self) -> None:
+        """Drop every instrument (tests; between bench phases)."""
+        with self._lock:
+            self._instruments.clear()
+
+    def snapshot(self) -> dict:
+        """JSON-ready state of every instrument, grouped by kind:
+        ``{"schema": 1, "counters": {...}, "gauges": {...},
+        "histograms": {...}}`` — the shape of bench's ``telemetry``
+        block (validated by :func:`validate_snapshot`)."""
+        with self._lock:
+            instruments = list(self._instruments.values())
+        out: dict = {
+            "schema": SCHEMA_VERSION,
+            "counters": {},
+            "gauges": {},
+            "histograms": {},
+        }
+        for inst in instruments:
+            out[inst.kind + "s"][inst.name] = inst.snapshot()
+        return out
+
+    def export_jsonl(self, path: str, *, host: int | None = None) -> str:
+        """Write one JSON line per instrument (plus a leading ``meta``
+        line) — the per-host export half of the rank-0 merge contract
+        (:func:`merge_exports`). ``host`` defaults to this process's
+        index when the distributed runtime is up, else 0."""
+        if host is None:
+            host = _host_index()
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        snap = self.snapshot()
+        with open(path, "w") as f:
+            f.write(json.dumps({
+                "kind": "meta", "schema": SCHEMA_VERSION, "host": host,
+                "wall_time": round(time.time(), 3),
+            }) + "\n")
+            for name, v in snap["counters"].items():
+                f.write(json.dumps({
+                    "kind": "counter", "name": name, "host": host, "value": v,
+                }) + "\n")
+            for name, v in snap["gauges"].items():
+                f.write(json.dumps({
+                    "kind": "gauge", "name": name, "host": host, "value": v,
+                }) + "\n")
+            for name, h in snap["histograms"].items():
+                f.write(json.dumps({
+                    "kind": "histogram", "name": name, "host": host, **h,
+                }) + "\n")
+        return path
+
+
+def _host_index() -> int:
+    """Process index if the jax runtime is importable and initialized
+    enough to answer; 0 otherwise. Never imports jax eagerly on failure
+    paths — telemetry must work before (or without) a backend."""
+    try:
+        # only ask jax if a backend is ALREADY live: process_index()
+        # would otherwise initialize one, and telemetry export must never
+        # touch a possibly-hung accelerator plugin
+        from jax._src import xla_bridge
+
+        if not xla_bridge.backends_are_initialized():
+            return 0
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+#: The process-wide registry every subsystem records into.
+REGISTRY = Registry()
+
+
+# ---------------------------------------------------------------------------
+# module helpers (the hot-path API: no-ops when disabled)
+
+
+def count(name: str, n: int = 1) -> None:
+    """Bump counter ``name`` in the process registry (no-op when
+    telemetry is disabled)."""
+    if not enabled():
+        return
+    REGISTRY.counter(name).inc(n)
+
+
+def set_gauge(name: str, value: float) -> None:
+    if not enabled():
+        return
+    REGISTRY.gauge(name).set(value)
+
+
+def observe(
+    name: str, value: float,
+    buckets: Sequence[float] = DEFAULT_TIME_BUCKETS_S,
+) -> None:
+    if not enabled():
+        return
+    REGISTRY.histogram(name, buckets).observe(value)
+
+
+@contextlib.contextmanager
+def timed(name: str, buckets: Sequence[float] = DEFAULT_TIME_BUCKETS_S):
+    """Time a block into histogram ``name`` (seconds). Disabled path:
+    zero instruments touched, one clock read avoided."""
+    if not enabled():
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        observe(name, time.perf_counter() - t0, buckets)
+
+
+def snapshot() -> dict:
+    """Snapshot of the process registry (see :meth:`Registry.snapshot`)."""
+    return REGISTRY.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# counter groups (the EventCounter surface)
+
+
+class CounterGroup:
+    """Instance-local monotonic named counters — the resilience layer's
+    event-count surface (``utils.EventCounter`` is a deprecated alias).
+    Thread-safe: signal handlers and watchdog threads bump concurrently
+    with the step loop.
+
+    ``prefix`` is the bridge into the shared export path: when set and
+    telemetry is enabled, every bump is mirrored into the process
+    :data:`REGISTRY` as ``{prefix}.{name}`` — so resilience events
+    (rollbacks, rendezvous retries, watchdog stalls) ride the same JSONL
+    export and bench ``telemetry`` block as everything else, while the
+    instance's own counts keep working unconditionally (ResilientLoop's
+    summary does not depend on the telemetry gate)."""
+
+    def __init__(self, prefix: str | None = None, *, registry: Registry | None = None):
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+        self.prefix = prefix
+        self._registry = registry
+
+    def bump(self, name: str, n: int = 1) -> int:
+        """Increment ``name`` by ``n``; returns the new count."""
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+            value = self._counts[name]
+        if self.prefix and enabled():
+            reg = self._registry if self._registry is not None else REGISTRY
+            reg.counter(f"{self.prefix}.{name}").inc(n)
+        return value
+
+    def count(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def summary(self) -> dict:
+        """Snapshot of every counter (plain dict, JSON-ready)."""
+        with self._lock:
+            return dict(self._counts)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.summary()!r})"
+
+
+# ---------------------------------------------------------------------------
+# merge / validation
+
+
+def read_jsonl(path: str) -> list[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def merge_exports(paths: Iterable[str]) -> dict:
+    """Rank-0 merge of per-host JSONL exports (:meth:`Registry.export_jsonl`)
+    into one summary dict shaped like :meth:`Registry.snapshot` plus a
+    ``hosts`` list.
+
+    Merge semantics: counters and histogram vectors **sum** across hosts
+    (bucket boundaries must agree — drift raises, it means the hosts ran
+    different code); histogram min/max take the elementwise extremes;
+    gauges are last-write-wins in ``paths`` order (they are point-in-time
+    readings, not accumulations) — per-host gauge values survive in the
+    per-host files."""
+    hosts: set[int] = set()
+    counters: dict[str, int] = {}
+    gauges: dict[str, float] = {}
+    hists: dict[str, dict] = {}
+    for path in paths:
+        for row in read_jsonl(path):
+            kind = row.get("kind")
+            if kind == "meta":
+                if row.get("schema") != SCHEMA_VERSION:
+                    raise ValueError(
+                        f"telemetry export {path!r} has schema "
+                        f"{row.get('schema')!r}, expected {SCHEMA_VERSION}"
+                    )
+                hosts.add(int(row.get("host", 0)))
+                continue
+            name = row["name"]
+            hosts.add(int(row.get("host", 0)))
+            if kind == "counter":
+                counters[name] = counters.get(name, 0) + int(row["value"])
+            elif kind == "gauge":
+                gauges[name] = float(row["value"])
+            elif kind == "histogram":
+                cur = hists.get(name)
+                if cur is None:
+                    hists[name] = {
+                        "buckets": list(row["buckets"]),
+                        "counts": list(row["counts"]),
+                        "count": int(row["count"]),
+                        "sum": float(row["sum"]),
+                        "min": row.get("min"),
+                        "max": row.get("max"),
+                    }
+                else:
+                    if cur["buckets"] != list(row["buckets"]):
+                        raise ValueError(
+                            f"histogram {name!r} bucket boundaries differ "
+                            "across hosts — refusing to merge mismatched "
+                            "schemas"
+                        )
+                    cur["counts"] = [
+                        a + b for a, b in zip(cur["counts"], row["counts"])
+                    ]
+                    cur["count"] += int(row["count"])
+                    cur["sum"] += float(row["sum"])
+                    for key, pick in (("min", min), ("max", max)):
+                        vals = [v for v in (cur[key], row.get(key))
+                                if v is not None]
+                        cur[key] = pick(vals) if vals else None
+    return {
+        "schema": SCHEMA_VERSION,
+        "hosts": sorted(hosts),
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": hists,
+    }
+
+
+def write_merged_summary(paths: Iterable[str], out_path: str) -> dict:
+    """Merge per-host exports and write the summary JSON (master-host
+    convenience; call it from rank 0 only)."""
+    summary = merge_exports(paths)
+    parent = os.path.dirname(os.path.abspath(out_path))
+    os.makedirs(parent, exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(summary, f, indent=1)
+    return summary
+
+
+def validate_snapshot(snap: Any) -> dict:
+    """Schema check for a snapshot / bench ``telemetry`` block; returns
+    it on success, raises ``ValueError`` on drift (what
+    tests/test_bench_tooling.py pins, so output drift fails tier-1)."""
+    if not isinstance(snap, dict):
+        raise ValueError(f"telemetry block must be a dict, got {type(snap)}")
+    if snap.get("schema") != SCHEMA_VERSION:
+        raise ValueError(
+            f"telemetry schema {snap.get('schema')!r} != {SCHEMA_VERSION}"
+        )
+    for section in ("counters", "gauges", "histograms"):
+        if not isinstance(snap.get(section), dict):
+            raise ValueError(f"telemetry block missing dict section {section!r}")
+    for name, v in snap["counters"].items():
+        if not isinstance(v, int) or isinstance(v, bool):
+            raise ValueError(f"counter {name!r} value {v!r} is not an int")
+    for name, v in snap["gauges"].items():
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            raise ValueError(f"gauge {name!r} value {v!r} is not numeric")
+    for name, h in snap["histograms"].items():
+        if not isinstance(h, dict):
+            raise ValueError(f"histogram {name!r} is not a dict")
+        buckets, counts = h.get("buckets"), h.get("counts")
+        if (not isinstance(buckets, list) or not isinstance(counts, list)
+                or len(counts) != len(buckets) + 1):
+            raise ValueError(
+                f"histogram {name!r} needs len(counts) == len(buckets)+1"
+            )
+        if h.get("count") != sum(counts):
+            raise ValueError(
+                f"histogram {name!r} count {h.get('count')!r} != sum of "
+                "bucket counts"
+            )
+        if not isinstance(h.get("sum"), (int, float)):
+            raise ValueError(f"histogram {name!r} sum is not numeric")
+    return snap

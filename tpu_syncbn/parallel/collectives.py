@@ -27,17 +27,47 @@ correct for uneven/empty shards.
 
 from __future__ import annotations
 
+import math
 import operator
 from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from tpu_syncbn.compat import axis_size as _compat_axis_size
+from tpu_syncbn.obs import telemetry
 from tpu_syncbn.runtime.distributed import DATA_AXIS
 
 Pytree = Any
+
+
+def _tally(op: str, tree: Pytree) -> None:
+    """Per-op call + estimated-byte counters (``collectives.<op>.calls``
+    / ``.bytes``) when telemetry is enabled.
+
+    These count at **trace time**: collectives in this module execute
+    while XLA traces the step program, once per compilation, not once
+    per step — so the tallies are the per-program collective inventory
+    (DS-Sync's "how much does this step synchronize", arxiv 2007.03298).
+    Per-execution traffic is this estimate times the step count; the
+    payload estimate is the mathematical per-replica input size
+    (shape × itemsize), which for an all-reduce equals what ring
+    algorithms move within a factor of 2(N-1)/N."""
+    if not telemetry.enabled():
+        return
+    nbytes = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        try:
+            shape = tuple(getattr(leaf, "shape", ()))
+            dtype = getattr(leaf, "dtype", None)
+            itemsize = np.dtype(dtype).itemsize if dtype is not None else 0
+            nbytes += int(math.prod(shape)) * itemsize
+        except (TypeError, ValueError):
+            continue  # abstract/dynamic leaf: skip, keep the call count
+    telemetry.count(f"collectives.{op}.calls")
+    telemetry.count(f"collectives.{op}.bytes", nbytes)
 
 
 def axis_size(axis_name: str = DATA_AXIS) -> int:
@@ -55,6 +85,7 @@ def axis_index(axis_name: str = DATA_AXIS) -> jax.Array:
 def psum(tree: Pytree, axis_name: str = DATA_AXIS) -> Pytree:
     """Sum every leaf across the axis: ``dist.all_reduce(SUM)``
     (as used by SyncBN backward, ``[torch] nn/modules/_functions.py:160-165``)."""
+    _tally("psum", tree)
     return lax.psum(tree, axis_name)
 
 
@@ -62,16 +93,19 @@ def pmean(tree: Pytree, axis_name: str = DATA_AXIS) -> Pytree:
     """Mean every leaf across the axis — all_reduce followed by the divide
     DDP's reducer applies to gradients (``[torch] nn/parallel/distributed.py``
     Reducer grad averaging)."""
+    _tally("pmean", tree)
     return lax.pmean(tree, axis_name)
 
 
 def pmax(tree: Pytree, axis_name: str = DATA_AXIS) -> Pytree:
     """Elementwise max across the axis (all_reduce(MAX))."""
+    _tally("pmax", tree)
     return lax.pmax(tree, axis_name)
 
 
 def pmin(tree: Pytree, axis_name: str = DATA_AXIS) -> Pytree:
     """Elementwise min across the axis (all_reduce(MIN))."""
+    _tally("pmin", tree)
     return lax.pmin(tree, axis_name)
 
 
@@ -85,6 +119,7 @@ def all_gather(
     """Gather every replica's leaf along a new (or tiled) leading axis:
     ``dist.all_gather_into_tensor`` (SyncBN forward stats exchange,
     ``[torch] nn/modules/_functions.py:74-77``)."""
+    _tally("all_gather", tree)
     return lax.all_gather(tree, axis_name, axis=axis, tiled=tiled)
 
 
@@ -96,6 +131,7 @@ def broadcast(tree: Pytree, src: int = 0, axis_name: str = DATA_AXIS) -> Pytree:
     SPMD formulation: gather all replicas' values and select ``src``'s.
     XLA folds the gather+index; for the init-time use the cost is a one-off.
     """
+    _tally("broadcast", tree)
     size = _compat_axis_size(axis_name)  # static at trace time
     if not -size <= src < size:
         raise ValueError(
@@ -137,6 +173,7 @@ def ppermute(
 ) -> Pytree:
     """Point-to-point ring/permutation sends (CollectivePermute over ICI).
     No reference analogue in the recipe; exposed for ring-style algorithms."""
+    _tally("ppermute", tree)
     return lax.ppermute(tree, axis_name, perm)
 
 
@@ -151,6 +188,7 @@ def all_to_all(
     """All-to-all resharding (sequence/expert-parallel building block).
     Not used by the reference recipe; exposed as the mesh-ready extension
     point SURVEY §2 calls for."""
+    _tally("all_to_all", tree)
     return lax.all_to_all(
         tree, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled
     )
@@ -162,6 +200,7 @@ def reduce_scatter(
     """Sum across the axis, then shard the result along ``scatter_dimension``
     (ReduceScatter HLO). The building block for ZeRO-style sharded optimizer
     states (out of reference scope, SURVEY §2, but mesh-ready)."""
+    _tally("reduce_scatter", x)
     return lax.psum_scatter(
         x, axis_name, scatter_dimension=scatter_dimension, tiled=True
     )

@@ -44,6 +44,9 @@ class GANStepOutput:
     d_loss: jax.Array
     g_loss: jax.Array
     metrics: dict[str, jax.Array]
+    #: on-device health scalars (obs.stepstats) riding the step outputs —
+    #: per-network grad norms / non-finite counts + BN stat health
+    monitors: dict[str, jax.Array] = dataclasses.field(default_factory=dict)
 
 
 class GANTrainer:
@@ -66,11 +69,22 @@ class GANTrainer:
         mesh: Mesh | None = None,
         axis_name: str = DATA_AXIS,
         donate: bool = True,
+        monitors: bool | str = True,
     ):
+        """``monitors`` (default True): compute per-network grad
+        norms/non-finite counts and BN running-stat health inside the
+        compiled step, returned via ``GANStepOutput.monitors`` — same
+        contract (including ``"full"`` per-layer keys and the
+        no-extra-host-sync guarantee) as ``DataParallel(monitors=...)``."""
         if loss not in LOSSES:
             raise ValueError(f"loss must be one of {sorted(LOSSES)}, got {loss!r}")
+        if monitors not in (True, False, "full"):
+            raise ValueError(
+                f"monitors must be True, False, or 'full', got {monitors!r}"
+            )
         self._generator = generator
         self._discriminator = discriminator
+        self.monitors = monitors
         self.loss_pair = LOSSES[loss]
         self.mesh = mesh if mesh is not None else dist.data_parallel_mesh()
         self.axis_name = axis_name
@@ -166,14 +180,31 @@ class GANTrainer:
             # replica-0 buffer broadcast (DDP forward_sync_buffers parity)
             gr = collectives.broadcast(gr, src=0, axis_name=axis)
             dr = collectives.broadcast(dr, src=0, axis_name=axis)
-            return gp, gr, dp_, dr, og, od, d_loss, g_loss, metrics
+            monitors = {}
+            if self.monitors:
+                from tpu_syncbn.obs import stepstats as obs_stepstats
+
+                # post-pmean grads are replicated; post-broadcast buffers
+                # too — pure arithmetic, no extra collectives
+                monitors.update({
+                    f"d_{k}": v for k, v in
+                    obs_stepstats.grad_monitors(d_grads).items()
+                })
+                monitors.update({
+                    f"g_{k}": v for k, v in
+                    obs_stepstats.grad_monitors(g_grads).items()
+                })
+                monitors.update(obs_stepstats.state_health(
+                    (gr, dr), per_layer=self.monitors == "full"
+                ))
+            return gp, gr, dp_, dr, og, od, d_loss, g_loss, metrics, monitors
 
         sharded = shard_map(
             step,
             mesh=self.mesh,
             in_specs=(P(), P(), P(), P(), P(), P(),
                       P(self.axis_name), P(self.axis_name), P(self.axis_name)),
-            out_specs=(P(),) * 6 + (P(), P(), P()),
+            out_specs=(P(),) * 6 + (P(), P(), P(), P()),
             check_vma=self._check_vma,
         )
         donate_argnums = tuple(range(6)) if donate else ()
@@ -183,11 +214,13 @@ class GANTrainer:
         (
             self.g_params, self.g_rest, self.d_params, self.d_rest,
             self.g_opt_state, self.d_opt_state, d_loss, g_loss, metrics,
+            monitors,
         ) = self._step(
             self.g_params, self.g_rest, self.d_params, self.d_rest,
             self.g_opt_state, self.d_opt_state, real, z_d, z_g,
         )
-        return GANStepOutput(d_loss=d_loss, g_loss=g_loss, metrics=metrics)
+        return GANStepOutput(d_loss=d_loss, g_loss=g_loss, metrics=metrics,
+                             monitors=monitors)
 
     def sync_to_models(self) -> tuple[nnx.Module, nnx.Module]:
         nnx.update(self._generator, self.g_params, self.g_rest)

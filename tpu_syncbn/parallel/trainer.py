@@ -50,6 +50,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_syncbn import compat
 from tpu_syncbn.compat import shard_map
+from tpu_syncbn.obs import stepstats as obs_stepstats
 from tpu_syncbn.parallel import collectives
 from tpu_syncbn.parallel.collectives import pcast_varying as _pcast_varying
 from tpu_syncbn.runtime import distributed as dist
@@ -151,10 +152,17 @@ def _stats_replicated_by_construction(model: nnx.Module) -> bool:
 
 @dataclasses.dataclass
 class StepOutput:
-    """What a compiled train step returns to the host."""
+    """What a compiled train step returns to the host.
+
+    ``monitors`` carries the on-device health scalars
+    (``obs.stepstats``: grad global-norm, non-finite counts, BN
+    running-stat health) computed inside the compiled step — they are
+    ordinary async step outputs, so reading the struct costs no extra
+    device sync until a value is actually fetched."""
 
     loss: jax.Array
     metrics: dict[str, jax.Array]
+    monitors: dict[str, jax.Array] = dataclasses.field(default_factory=dict)
 
 
 class DataParallel:
@@ -210,6 +218,7 @@ class DataParallel:
         grad_compression: str | None = None,
         zero: bool = False,
         divergence_guard: str | None = None,
+        monitors: bool | str = True,
     ):
         """``remat=True`` rematerializes the forward during backward
         (``jax.checkpoint``) — trades ~1/3 more FLOPs for activation
@@ -251,7 +260,17 @@ class DataParallel:
         the last verified checkpoint. The step's metrics gain
         ``nonfinite`` (1.0 on a skipped step) and ``lr_scale``; the
         occurrence count persists in the guard state (and therefore in
-        checkpoints)."""
+        checkpoints).
+
+        ``monitors`` (default ``True``) computes on-device health
+        scalars inside the compiled step and returns them through
+        ``StepOutput.monitors``: grad global-norm and non-finite count
+        (``obs.stepstats.grad_monitors``) plus BN running-stat health
+        (``state_health``). ``"full"`` adds per-layer BN buffer
+        monitors; ``False`` turns the block off (``monitors == {}``).
+        They ride the step's existing outputs — no extra per-step
+        host→device syncs (under ``zero`` the grad norm needs one
+        scalar device-side psum, since grads exist only as shards)."""
         if accum_steps < 1:
             raise ValueError("accum_steps must be >= 1")
         if divergence_guard not in (
@@ -270,6 +289,11 @@ class DataParallel:
                 "broadcast_buffers must be True, False, or 'auto', got "
                 f"{broadcast_buffers!r}"
             )
+        if monitors not in (True, False, "full"):
+            raise ValueError(
+                f"monitors must be True, False, or 'full', got {monitors!r}"
+            )
+        self.monitors = monitors
         self.remat = remat
         self.grad_compression = grad_compression
         self._model = model
@@ -443,7 +467,7 @@ class DataParallel:
             in_specs=(self._pspec, self._rest_spec, self._opt_spec,
                       P(self.axis_name)),
             out_specs=(self._pspec, self._rest_spec, self._opt_spec,
-                       P(), P()),
+                       P(), P(), P()),
             # VMA checker ON (unless pallas traces — see __init__):
             # validates that params/opt_state/loss really are replicated
             # after the step. Requires the explicit varying-cast of params
@@ -463,6 +487,7 @@ class DataParallel:
         axis = self.axis_name
 
         def step(pstore, rest, opt_state, batch):
+            monitors: dict = {}
             guard_in = None
             if self.divergence_guard is not None:
                 opt_state, guard_in = opt_state
@@ -565,6 +590,11 @@ class DataParallel:
                     return g / self.world
 
                 gshard = {dt: scatter(g) for dt, g in flat_g.items()}
+                if self.monitors:
+                    # shards only: one scalar device-side psum globalizes
+                    monitors.update(obs_stepstats.grad_monitors(
+                        gshard, axis, sharded=True
+                    ))
                 updates, opt_state = self.optimizer.update(
                     gshard, opt_state, pstore
                 )
@@ -588,6 +618,10 @@ class DataParallel:
                     )
                 else:
                     grads = collectives.pmean(grads, axis)
+                if self.monitors:
+                    # post-pmean grads are replicated: pure arithmetic,
+                    # no collective needed
+                    monitors.update(obs_stepstats.grad_monitors(grads))
                 updates, opt_state = self.optimizer.update(
                     grads, opt_state, params
                 )
@@ -636,13 +670,26 @@ class DataParallel:
                 # else: full-world SyncBN stats are replicated by
                 # construction (psum'd moments) — already unvarying, and
                 # an explicit broadcast would be a wasted all-reduce
+                if self.monitors:
+                    # post-broadcast (or by-construction-replicated)
+                    # buffers: pure arithmetic yields replicated monitors
+                    monitors.update(obs_stepstats.state_health(
+                        rest, per_layer=self.monitors == "full"
+                    ))
             else:
+                if self.monitors:
+                    # per-replica buffers: reduce to the worst replica so
+                    # the monitors stay legal replicated outputs
+                    monitors.update(obs_stepstats.state_health(
+                        rest, axis, reduce=True,
+                        per_layer=self.monitors == "full",
+                    ))
                 # re-stack for honest per-replica storage (P(axis) output:
                 # declare varying even when SyncBN stats are replicated)
                 if self._check_vma:
                     rest = _pcast_varying(rest, axis)
                 rest = jax.tree_util.tree_map(lambda x: x[None], rest)
-            return pstore, rest, opt_state, loss, metrics
+            return pstore, rest, opt_state, loss, metrics, monitors
 
         return step
 
@@ -666,13 +713,15 @@ class DataParallel:
         def many(pstore, rest, opt_state, batch):
             def body(carry, _):
                 p, r, o = carry
-                p, r, o, loss, metrics = step(p, r, o, batch)
-                return (p, r, o), (loss, metrics)
+                p, r, o, loss, metrics, monitors = step(p, r, o, batch)
+                return (p, r, o), (loss, metrics, monitors)
 
-            (pstore, rest, opt_state), (losses, metrics) = jax.lax.scan(
-                body, (pstore, rest, opt_state), None, length=n_steps
+            (pstore, rest, opt_state), (losses, metrics, monitors) = (
+                jax.lax.scan(
+                    body, (pstore, rest, opt_state), None, length=n_steps
+                )
             )
-            return pstore, rest, opt_state, losses, metrics
+            return pstore, rest, opt_state, losses, metrics, monitors
 
         sharded = shard_map(
             many,
@@ -680,7 +729,7 @@ class DataParallel:
             in_specs=(self._pspec, self._rest_spec, self._opt_spec,
                       P(self.axis_name)),
             out_specs=(self._pspec, self._rest_spec, self._opt_spec,
-                       P(), P()),
+                       P(), P(), P()),
             check_vma=self._check_vma,
         )
         # donate state but never the batch (reused by every iteration)
@@ -719,8 +768,9 @@ class DataParallel:
             self.opt_state,
             losses,
             metrics,
+            monitors,
         ) = fn(self._param_store, self.rest, self.opt_state, batch)
-        return StepOutput(loss=losses, metrics=metrics)
+        return StepOutput(loss=losses, metrics=metrics, monitors=monitors)
 
     def _build_eval_step(self):
         def step(pstore, rest, batch):
@@ -774,8 +824,9 @@ class DataParallel:
             self.opt_state,
             loss,
             metrics,
+            monitors,
         ) = self._train_step(self._param_store, self.rest, self.opt_state, batch)
-        return StepOutput(loss=loss, metrics=metrics)
+        return StepOutput(loss=loss, metrics=metrics, monitors=monitors)
 
     def eval_step(self, batch) -> StepOutput:
         loss, metrics = self._eval_step(self._param_store, self.rest, batch)

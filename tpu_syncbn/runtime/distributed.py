@@ -190,10 +190,17 @@ def _rendezvous_with_retry(
         if "initialization_timeout" in params:
             kwargs = {**kwargs, "initialization_timeout": int(timeout_s)}
 
+    from tpu_syncbn.obs import telemetry
+
     def attempt():
+        # attempt/failure counters ride telemetry so a flaky coordinator
+        # is countable from the bench/summary export, not only from the
+        # retry log lines (docs/OBSERVABILITY.md)
+        telemetry.count("rendezvous.attempts")
         try:
             jax.distributed.initialize(**kwargs)
         except Exception:
+            telemetry.count("rendezvous.failures")
             # a half-open coordination client would poison the next try
             with contextlib.suppress(Exception):
                 jax.distributed.shutdown()

@@ -23,6 +23,7 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import time
 from typing import NamedTuple, Optional
 
 
@@ -60,7 +61,17 @@ def probe_backend(timeout: Optional[float] = None) -> Optional[BackendInfo]:
     """
     if "result" in _probe_cache:
         return _probe_cache["result"]
+    t0 = time.perf_counter()
     result = _probe_uncached(timeout)
+    # probe latency + outcome ride telemetry so a CPU fallback is
+    # diagnosable from the bench JSON's telemetry block, not only from
+    # the stderr notice (docs/OBSERVABILITY.md)
+    from tpu_syncbn.obs import telemetry
+
+    telemetry.set_gauge("probe.latency_s", time.perf_counter() - t0)
+    telemetry.count("probe.ok" if result is not None else "probe.failed")
+    if result is not None:
+        telemetry.set_gauge("probe.device_count", result.device_count)
     _probe_cache["result"] = result
     return result
 
@@ -215,8 +226,11 @@ def ensure_backend(min_devices: int = 1) -> BackendInfo:
     touch in the process. Also enables the persistent compilation cache
     (see :func:`enable_persistent_compilation_cache`).
     """
+    from tpu_syncbn.obs import telemetry
+
     enable_persistent_compilation_cache()
     if os.environ.get("TPU_SYNCBN_FORCE_CPU") == "1":
+        telemetry.count("probe.forced_cpu")
         force_cpu(min_devices)
         return BackendInfo("cpu", min_devices)
     # Mirror _PROBE_CODE in-process: the sitecustomize's jax.config pin
@@ -236,9 +250,11 @@ def ensure_backend(min_devices: int = 1) -> BackendInfo:
             file=sys.stderr,
             flush=True,
         )
+        telemetry.count("probe.cpu_fallback")
         force_cpu(min_devices)
         return BackendInfo("cpu", min_devices)
     if info.device_count < min_devices:
+        telemetry.count("probe.cpu_fallback")
         print(
             f"[tpu_syncbn.probe] {info.platform} offers {info.device_count} "
             f"device(s) < required {min_devices}; forcing CPU platform with "

@@ -220,9 +220,22 @@ class Watchdog:
             if idle > self.deadline_s and self._stalled_since is None:
                 self._stalled_since = self._last
                 self.stall_count += 1
+                # tag the dump with the most recently opened trace span
+                # (the monitor thread has no span stack of its own) so a
+                # Perfetto trace and this event log join on span id
+                from tpu_syncbn.obs import telemetry, tracing
+
+                span_id = tracing.latest_open_span_id()
+                telemetry.count("resilience.watchdog_stalls")
+                tracing.instant(
+                    "watchdog_stall", watchdog=self.name,
+                    idle_s=round(idle, 2),
+                    **({"span_id": span_id} if span_id is not None else {}),
+                )
+                tag = f", trace_span={span_id}" if span_id is not None else ""
                 diag = dump_stacks(
                     f"WATCHDOG: {self.name!r} stalled for {idle:.1f}s "
-                    f"(deadline {self.deadline_s}s)"
+                    f"(deadline {self.deadline_s}s{tag})"
                 )
                 logger = dist.get_logger("tpu_syncbn.resilience")
                 logger.error("%s", diag)
@@ -295,8 +308,18 @@ def stall_guard(
             try:
                 tag, payload = q.get(timeout=deadline_s)
             except _queue.Empty:
+                from tpu_syncbn.obs import telemetry, tracing
+
+                span_id = tracing.latest_open_span_id()
+                telemetry.count("resilience.data_stalls")
+                tracing.instant(
+                    "data_stall", source=name,
+                    **({"span_id": span_id} if span_id is not None else {}),
+                )
+                tag = (f" (trace_span={span_id})"
+                       if span_id is not None else "")
                 diag = dump_stacks(
-                    f"WATCHDOG: {name!r} fetch exceeded {deadline_s}s"
+                    f"WATCHDOG: {name!r} fetch exceeded {deadline_s}s{tag}"
                 )
                 dist.get_logger("tpu_syncbn.resilience").error("%s", diag)
                 raise StallError(
@@ -383,9 +406,13 @@ def retry_with_backoff(
 
 
 def _default_counters():
-    from tpu_syncbn.utils.metrics import EventCounter
+    from tpu_syncbn.obs.telemetry import CounterGroup
 
-    return EventCounter()
+    # prefix="resilience": every bump mirrors into the process telemetry
+    # registry (as resilience.<event>) when telemetry is enabled, so
+    # recovery events ride the same export path as step/loader/checkpoint
+    # metrics — while the loop's own summary() works unconditionally
+    return CounterGroup("resilience")
 
 
 class ResilientLoop:
@@ -483,9 +510,20 @@ class ResilientLoop:
             return
         restored = resume_latest(self.trainer, self.ckpt_dir)
         self.counters.bump("divergence_restores")
+        # tag the rollback with the current trace span so the Perfetto
+        # timeline and this log line correlate (same id in both)
+        from tpu_syncbn.obs import tracing
+
+        span_id = tracing.latest_open_span_id()
+        tracing.instant(
+            "divergence_restore", step=self.step, restored_step=restored,
+            **({"span_id": span_id} if span_id is not None else {}),
+        )
         self._log.warning(
             "non-finite loss/grads at step %d: restored last good "
-            "checkpoint (step %d)", self.step, restored,
+            "checkpoint (step %d)%s",
+            self.step, restored,
+            f" (trace_span={span_id})" if span_id is not None else "",
         )
         self.step = restored
 
@@ -507,11 +545,19 @@ class ResilientLoop:
                     Watchdog(self.step_deadline_s, name="train-step",
                              start_armed=False)
                 )
+            from tpu_syncbn.obs import stepstats
+
             steps_run = 0
-            for batch in batches:
+            # explicit next() so the wait-for-data seam is measurable:
+            # each blocking fetch is a "data_wait" span + histogram
+            # sample, each step a "step" span — the same seams bench.py
+            # instruments, so any loop's trace reads the same way
+            for batch in stepstats.instrumented_batches(batches):
                 if max_steps is not None and steps_run >= max_steps:
                     break
-                out = self.trainer.train_step(batch)
+                with stepstats.timed_span("step", "step.time_s",
+                                          step=self.step + 1):
+                    out = self.trainer.train_step(batch)
                 self.step += 1
                 steps_run += 1
                 if watchdog is not None:

@@ -31,12 +31,14 @@ import json
 import os
 import re
 import tempfile
+import time
 import zlib
 from typing import Any
 
 import jax
 from flax import serialization
 
+from tpu_syncbn.obs import telemetry, tracing
 from tpu_syncbn.runtime import distributed as dist
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
@@ -195,7 +197,19 @@ def verify_checkpoint(directory: str, step: int) -> bool:
     """True iff ``step``'s payload exists AND its manifest certifies it
     (byte length and CRC32 both match). Legacy checkpoints without a
     manifest — and anything truncated, bit-flipped, or mid-write — report
-    False."""
+    False. Verification time and failures feed telemetry
+    (``checkpoint.verify_s`` / ``checkpoint.verify_failures``,
+    docs/OBSERVABILITY.md)."""
+    t0 = time.perf_counter()
+    with tracing.span("checkpoint_verify", step=int(step)):
+        ok = _verify_checkpoint_impl(directory, step)
+    telemetry.observe("checkpoint.verify_s", time.perf_counter() - t0)
+    if not ok:
+        telemetry.count("checkpoint.verify_failures")
+    return ok
+
+
+def _verify_checkpoint_impl(directory: str, step: int) -> bool:
     manifest = read_manifest(directory, step)
     if manifest is None:
         return False
@@ -223,9 +237,22 @@ def save_checkpoint(
     """Write ``tree`` as ``ckpt_{step}.msgpack`` plus its integrity
     manifest — master host only (other hosts return None immediately);
     both writes atomic via tmp+rename, payload before manifest; prunes to
-    the newest ``keep`` checkpoints."""
+    the newest ``keep`` checkpoints. Save latency rides telemetry
+    (``checkpoint.save_s`` histogram + ``checkpoint.saves`` counter) and
+    a ``checkpoint_save`` trace span."""
     if not dist.is_master():
         return None
+    t0 = time.perf_counter()
+    with tracing.span("checkpoint_save", step=int(step)):
+        path = _save_checkpoint_impl(directory, step, tree, keep=keep)
+    telemetry.observe("checkpoint.save_s", time.perf_counter() - t0)
+    telemetry.count("checkpoint.saves")
+    return path
+
+
+def _save_checkpoint_impl(
+    directory: str, step: int, tree: Any, *, keep: int
+) -> str:
     os.makedirs(directory, exist_ok=True)
     # nnx State → pure dicts, then one batched device→host fetch
     host_tree = jax.device_get(_purify(tree))
@@ -280,6 +307,7 @@ def _load_verified_local(directory: str, pure_target: Any, logger):
         if manifest is not None and not _payload_matches(manifest, data):
             tried.append(f"step {step}: payload fails manifest CRC/size "
                          "(truncated or corrupt)")
+            telemetry.count("checkpoint.verify_failures")
             logger.warning(
                 "checkpoint step %d in %s fails integrity verification; "
                 "falling back to an older checkpoint", step, directory,
@@ -304,7 +332,11 @@ def _load_verified_local(directory: str, pure_target: Any, logger):
 def load_checkpoint(directory: str, target: Any, *, step: int | None = None):
     """Restore the latest (or a specific) checkpoint into the structure of
     ``target`` (a pytree template, e.g. ``dp.state_dict()``). Returns
-    ``(tree, step)``. Raises FileNotFoundError when nothing exists, and
+    ``(tree, step)``. Load latency rides telemetry
+    (``checkpoint.load_s`` histogram + ``checkpoint.loads`` counter) and
+    a ``checkpoint_load`` trace span; skipped-corrupt candidates count
+    into ``checkpoint.verify_failures``.
+    Raises FileNotFoundError when nothing exists, and
     :class:`CheckpointCorruptError` when an explicitly requested step (or
     every candidate) fails integrity verification.
 
@@ -324,6 +356,16 @@ def load_checkpoint(directory: str, target: Any, *, step: int | None = None):
     file that is already readable. Followers re-verify the payload against
     the (retry-read) manifest, so every host restores byte-identical state.
     """
+    t0 = time.perf_counter()
+    with tracing.span("checkpoint_load",
+                      step=-1 if step is None else int(step)):
+        result = _load_checkpoint_impl(directory, target, step=step)
+    telemetry.observe("checkpoint.load_s", time.perf_counter() - t0)
+    telemetry.count("checkpoint.loads")
+    return result
+
+
+def _load_checkpoint_impl(directory: str, target: Any, *, step: int | None):
     logger = dist.get_logger("tpu_syncbn.checkpoint")
     multi_host = dist.process_count() > 1
     pure_target = _purify(target)

@@ -1,9 +1,14 @@
-"""Training observability: meters, step timing, profiler hooks.
+"""Training meters, step timing, profiler hooks.
 
 The reference's entire observability story is rank-0 console printing
 (``README.md:9``); these utilities keep that contract (all emit via the
 master-gated logger) and add the cheap idiomatic extras SURVEY §5.1 notes:
 ``jax.profiler`` traces and per-step throughput timing.
+
+The structured observability layer lives in :mod:`tpu_syncbn.obs`
+(docs/OBSERVABILITY.md): process-wide telemetry, Chrome-trace spans, and
+per-step stats. :class:`EventCounter` here is a deprecated alias for
+``obs.telemetry.CounterGroup``.
 """
 
 from __future__ import annotations
@@ -15,6 +20,8 @@ import os
 import time
 
 import jax
+
+from tpu_syncbn.obs.telemetry import CounterGroup
 
 
 class AverageMeter:
@@ -65,34 +72,20 @@ class ThroughputMeter:
         return n / dt if dt > 0 else 0.0
 
 
-class EventCounter:
-    """Monotonic named counters for fault/recovery events (non-finite
-    steps skipped, divergence restores, preemption checkpoints, rendezvous
-    retries) — the observability half of the resilience layer
-    (docs/RESILIENCE.md): recovery should leave a countable trace, not
-    just log lines. Thread-safe (signal handlers and watchdog threads
-    bump concurrently with the step loop)."""
+class EventCounter(CounterGroup):
+    """Deprecated alias for :class:`tpu_syncbn.obs.telemetry.CounterGroup`
+    — the PR-1 name for monotonic fault/recovery event counters, kept so
+    existing call sites (and checkpointed configs) don't break. New code
+    should construct ``obs.telemetry.CounterGroup(prefix)`` directly.
+
+    The instance-local bump/count/summary surface is identical; as a
+    CounterGroup with ``prefix="events"``, bumps additionally mirror into
+    the process telemetry registry (as ``events.<name>``) when telemetry
+    is enabled, so legacy counters share the new export path
+    (docs/OBSERVABILITY.md)."""
 
     def __init__(self):
-        import threading
-
-        self._lock = threading.Lock()
-        self._counts: dict[str, int] = {}
-
-    def bump(self, name: str, n: int = 1) -> int:
-        """Increment ``name`` by ``n``; returns the new count."""
-        with self._lock:
-            self._counts[name] = self._counts.get(name, 0) + n
-            return self._counts[name]
-
-    def count(self, name: str) -> int:
-        with self._lock:
-            return self._counts.get(name, 0)
-
-    def summary(self) -> dict:
-        """Snapshot of every counter (plain dict, JSON-ready)."""
-        with self._lock:
-            return dict(self._counts)
+        super().__init__(prefix="events")
 
     def __repr__(self):
         return f"EventCounter({self.summary()!r})"
