@@ -40,6 +40,19 @@ import os
 import sys
 import time
 
+# Route XLA's C++ log spew (e.g. the CPU backend's "host machine
+# features ... SIGILL" advisory, BENCH_r05 tail) off the result stream:
+# TSL latches this env at its first log call, so it must be set before
+# anything imports jax. Errors still surface; INFO/WARNING chatter is
+# dropped so the JSON result line is always the last stdout line
+# (drivers parse the stdout tail). setdefault — an operator's explicit
+# verbosity choice wins.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+# …and the package loggers likewise (runtime.distributed.get_logger):
+# a checkpoint-fallback warning mid-run must not interleave with the
+# parsed result channel
+os.environ.setdefault("TPU_SYNCBN_LOG_STREAM", "stderr")
+
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "benchmarks"))
 from _common import fetch_sync
@@ -377,6 +390,18 @@ def measure_recovery(dp, *, repeats: int = 3) -> dict:
             ckpt.save_checkpoint(d, 1, state, keep=0)
             ckpt.load_checkpoint(d, template)
 
+        # async path: what the step loop actually pays per save (the
+        # copy-before-donate snapshot + enqueue; serialization, manifest,
+        # and atomic write run in the background thread) — the
+        # "steady-state step time stays flat across saves" number
+        async_dir = os.path.join(d, "async")
+        ac = ckpt.AsyncCheckpointer(keep=0, max_pending=repeats + 1)
+        async_step = [0]
+
+        def async_enqueue():
+            async_step[0] += 1
+            ac.save(async_dir, async_step[0], state)
+
         # seed path: payload only, no manifest, no verification
         seed_file = os.path.join(d, "seed.msgpack")
 
@@ -390,6 +415,14 @@ def measure_recovery(dp, *, repeats: int = 3) -> dict:
         shipped_s = timed(shipped)
         seed_s = timed(seed)
         ckpt_bytes = os.path.getsize(ckpt._path(d, 1))
+
+        async_enqueue_s = timed(async_enqueue)
+        t0 = time.perf_counter()
+        ac.flush()
+        async_flush_s = time.perf_counter() - t0
+        # async writes must certify exactly like synchronous ones
+        async_verified = ckpt.verify_checkpoint(async_dir, async_step[0])
+        ac.close()
 
         # the verification machinery, timed component-wise on the real
         # payload: checksum at save + checksum at load (+ CRC32 when the
@@ -429,6 +462,13 @@ def measure_recovery(dp, *, repeats: int = 3) -> dict:
             "manifest_overhead_s": round(overhead_s, 4),
             "manifest_overhead_frac": round(overhead_s / seed_s, 4)
             if seed_s > 0 else None,
+            # async checkpointing (docs/PERFORMANCE.md): the loop-visible
+            # cost of a save (snapshot + enqueue) vs the full synchronous
+            # round-trip above, plus proof the background write still
+            # certifies
+            "ckpt_async_enqueue_s": round(async_enqueue_s, 4),
+            "ckpt_async_flush_s": round(async_flush_s, 4),
+            "async_manifest_verified": bool(async_verified),
             "resume_after_kill_s": round(resume_s, 4),
             "resumed_step_after_kill": resumed_step,
             "ckpt_bytes": ckpt_bytes,
@@ -437,12 +477,23 @@ def measure_recovery(dp, *, repeats: int = 3) -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
-def main(trace_path: str | None = None):
+def main(trace_path: str | None = None, scan: int = 1):
     """``trace_path`` (the ``--trace`` flag) writes a Chrome trace-event
     JSON of the run — data-wait/step/checkpoint spans — that loads
     directly in Perfetto (docs/OBSERVABILITY.md). Telemetry is force-
     enabled for the run regardless of TPU_SYNCBN_TELEMETRY, so the
-    printed line always carries a populated ``telemetry`` block."""
+    printed line always carries a populated ``telemetry`` block.
+
+    ``scan`` (the ``--scan K`` flag) additionally times the fused
+    K-step path (``DataParallel.train_steps_batches`` over K-stacked
+    batches — one host dispatch per K steps, docs/PERFORMANCE.md) and
+    reports the **host-dispatch-gap fraction** under the schema-pinned
+    ``scan`` block: the fraction of the timed loop's wall-clock the host
+    spent BETWEEN compiled-program dispatches (1 − Σ per-dispatch
+    stepstats histogram / wall) — the per-step host overhead a fused
+    chunk divides by K. The per-step loop's fraction is always reported
+    as ``host_gap_frac_scan1``, so one ``--scan K`` line carries its own
+    baseline and the win is a tracked number."""
     from tpu_syncbn.obs import stepstats, telemetry, tracing
 
     telemetry.set_enabled(True)
@@ -514,6 +565,74 @@ def main(trace_path: str | None = None):
     img_per_sec_per_chip = img_per_sec / n_chips
     log(f"{img_per_sec:.1f} img/s total, {img_per_sec_per_chip:.1f} img/s/chip")
 
+    # host-dispatch-gap of the per-step loop: the fraction of the timed
+    # loop's wall-clock the host spent BETWEEN dispatch calls — python
+    # loop iteration, instrumentation, iterator handoff — i.e.
+    # 1 - Σ(in-dispatch time)/wall, with the in-dispatch Σ read from the
+    # step.time_s histogram the loop just filled. This is the host work
+    # a fused K-step program divides by K (one gap per chunk instead of
+    # one per step). dispatch_frac (the complement) is reported too: on
+    # a backend whose dispatch blocks (CPU with donated buffers —
+    # measured on this container) it reads ~1 and the gap is the whole
+    # host-overhead story; with fully async dispatch the gap reading
+    # saturates and dispatch_frac is the number to watch.
+    def _gap(hist_name, wall):
+        h = telemetry.snapshot()["histograms"].get(hist_name)
+        if not h or wall <= 0:
+            return None, None
+        frac = h["sum"] / wall
+        return round(max(0.0, 1.0 - frac), 6), round(frac, 6)
+
+    gap1, dispatch1 = _gap("step.time_s", dt)
+    scan_k = max(1, int(scan))
+    scan_info = {
+        "k": scan_k,
+        "host_gap_frac_scan1": gap1,
+        "dispatch_frac_scan1": dispatch1,
+        "chunks": steps,
+        "host_gap_frac": gap1,
+        "dispatch_frac": dispatch1,
+        "img_per_sec_per_chip": round(img_per_sec_per_chip, 2),
+    }
+    if scan_k > 1:
+        import numpy as np
+
+        # same workload, fused: K-stacked copies of the same batch, one
+        # compiled lax.scan program per chunk (parallel.scan_driver)
+        sbatch = jax.device_put(
+            jax.tree_util.tree_map(
+                lambda a: np.broadcast_to(
+                    np.asarray(a), (scan_k,) + a.shape
+                ).copy(),
+                batch,
+            ),
+            dp.scan_batch_sharding,
+        )
+        log(f"compiling fused {scan_k}-step program...")
+        t_c = time.perf_counter()
+        out2 = dp.train_steps_batches(sbatch)
+        fetch_sync(out2.loss)
+        log(f"fused compile+warmup took {time.perf_counter() - t_c:.1f}s")
+        chunks = max(1, steps // scan_k)
+        t0 = time.perf_counter()
+        for _ in range(chunks):
+            with stepstats.timed_span("scan_chunk", "scan.chunk_dispatch_s"):
+                out2 = dp.train_steps_batches(sbatch)
+        fetch_sync(out2.loss)
+        dt_scan = time.perf_counter() - t0
+        gap_k, dispatch_k = _gap("scan.chunk_dispatch_s", dt_scan)
+        scan_info.update({
+            "chunks": chunks,
+            "host_gap_frac": gap_k,
+            "dispatch_frac": dispatch_k,
+            "img_per_sec_per_chip": round(
+                global_batch * chunks * scan_k / dt_scan / n_chips, 2
+            ),
+        })
+        log(f"scan={scan_k}: host-dispatch-gap {gap_k} "
+            f"(per-step loop {gap1}), "
+            f"{scan_info['img_per_sec_per_chip']:.1f} img/s/chip fused")
+
     backend = jax.default_backend()
     flops_source = (f"live-hlo-cost-analysis({backend})"
                     if flops_per_step else None)
@@ -572,6 +691,11 @@ def main(trace_path: str | None = None):
         # the steady-state img/s value above (which measures the fault-
         # free step loop)
         "recovery": recovery,
+        # docs/PERFORMANCE.md: fused multi-step execution — the
+        # host-dispatch-gap fraction for the per-step loop
+        # (host_gap_frac_scan1) and, with --scan K, the fused loop
+        # (host_gap_frac); schema pinned by tests/test_bench_tooling.py
+        "scan": scan_info,
         # a fallback line is a liveness smoke signal, not a measurement
         # of anything the project tracks — cross-round diffs of it are
         # meaningless and tagged as such
@@ -615,4 +739,15 @@ if __name__ == "__main__":
             if i + 1 >= len(argv):
                 raise SystemExit("--trace requires a path argument")
             trace = argv[i + 1]
-        main(trace_path=trace)
+        scan = 1
+        if "--scan" in argv:
+            i = argv.index("--scan")
+            if i + 1 >= len(argv):
+                raise SystemExit("--scan requires an integer chunk size")
+            try:
+                scan = int(argv[i + 1])
+            except ValueError:
+                raise SystemExit("--scan requires an integer chunk size")
+            if scan < 1:
+                raise SystemExit("--scan chunk size must be >= 1")
+        main(trace_path=trace, scan=scan)

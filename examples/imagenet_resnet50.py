@@ -79,6 +79,13 @@ def parse_args():
                    choices=["skip_step", "halve_lr", "restore_last_good"],
                    help="on-device non-finite loss/grad policy "
                         "(docs/RESILIENCE.md)")
+    p.add_argument("--scan-steps", type=int, default=1,
+                   help="fuse K optimizer steps into one compiled program "
+                        "fed by K-stacked staging chunks (1 = per-step "
+                        "loop; docs/PERFORMANCE.md)")
+    p.add_argument("--async-ckpt", action="store_true",
+                   help="checkpoint via the background AsyncCheckpointer "
+                        "(the loop pays only the state snapshot)")
     p.add_argument("--data-deadline", type=float, default=None,
                    help="seconds before a hung batch fetch raises "
                         "StallError instead of hanging the job")
@@ -176,13 +183,28 @@ def main():
         return meter.avg
 
     def train_batches():
-        it = tdata.device_prefetch(iter(loader), sharding=dp.batch_sharding)
+        it = tdata.device_prefetch(iter(loader), sharding=dp.batch_sharding,
+                                   scan_steps=args.scan_steps)
         if args.data_deadline:
             # a wedged data worker becomes a catchable StallError at the
             # deadline instead of an indefinite hang
             it = runtime.stall_guard(it, args.data_deadline,
                                      name="train-batch")
         return it
+
+    # checkpoint write path: synchronous rank-0 writes, or the
+    # background AsyncCheckpointer (the loop pays only the snapshot;
+    # flushed before every exit — docs/PERFORMANCE.md)
+    async_ckpt = (utils.AsyncCheckpointer()
+                  if args.async_ckpt and args.ckpt_dir else None)
+
+    def save_ckpt(tag: int) -> None:
+        if not args.ckpt_dir:
+            return
+        if async_ckpt is not None:
+            async_ckpt.save(args.ckpt_dir, tag, dp.state_dict())
+        else:
+            utils.save_checkpoint(args.ckpt_dir, tag, dp.state_dict())
 
     tput = utils.ThroughputMeter()
     # resume restarts from a checkpointed epoch: keep the logged step
@@ -192,6 +214,21 @@ def main():
     step = start_epoch * len(loader)
     last_eval = None
     with contextlib.ExitStack() as stack:
+        if async_ckpt is not None:
+            # every exit path — including a StallError or eval failure
+            # propagating out of this block — flushes pending writes
+            # before the (daemon) writer thread dies with the process.
+            # Guarded: a write failure surfacing here during exception
+            # unwind must not REPLACE the primary failure's type (the
+            # ResilientLoop.run exceptional-flush contract), and a
+            # wedged writer must not hang the exit — so bounded + logged
+            def _close_async_ckpt():
+                try:
+                    async_ckpt.close(timeout=60)
+                except Exception:
+                    log.exception("async checkpoint close failed at exit")
+
+            stack.callback(_close_async_ckpt)
         scalars = stack.enter_context(
             utils.ScalarLogger(args.metrics_log)
         ) if args.metrics_log else None
@@ -210,33 +247,42 @@ def main():
         for epoch in range(start_epoch, args.epochs):
             sampler.set_epoch(epoch)
             for batch in train_batches():
-                out = dp.train_step(batch)
-                step += 1
-                out.loss.block_until_ready()
-                tput.tick(args.batch_size)
-                if step % 10 == 0:
+                if args.scan_steps > 1:
+                    # K-stacked staging chunk → one fused compiled
+                    # program; stacked outputs, one dispatch per K steps
+                    out = dp.train_steps_batches(batch)
+                    k = int(out.loss.shape[0])
+                    loss, top1 = out.loss[-1], out.metrics["top1"][-1]
+                else:
+                    out = dp.train_step(batch)
+                    k, loss, top1 = 1, out.loss, out.metrics["top1"]
+                step += k
+                loss.block_until_ready()
+                tput.tick(args.batch_size * k)
+                if step % 10 < k:
                     runtime.master_print(
-                        f"e{epoch} s{step}: loss {float(out.loss):.4f} "
-                        f"top1 {float(out.metrics['top1']):.3f} "
+                        f"e{epoch} s{step}: loss {float(loss):.4f} "
+                        f"top1 {float(top1):.3f} "
                         f"{tput.samples_per_sec:.0f} img/s"
                     )
                     if scalars:
-                        scalars.log(step, epoch=epoch, loss=out.loss,
-                                    top1=out.metrics["top1"],
+                        scalars.log(step, epoch=epoch, loss=loss,
+                                    top1=top1,
                                     img_per_sec=tput.samples_per_sec)
                 if guard.preempted:
                     break
             if guard.preempted:
-                if args.ckpt_dir:
-                    # step-boundary snapshot tagged with the CURRENT epoch:
-                    # resume replays this epoch from its deterministic
-                    # sampler order rather than trusting a mid-epoch cursor
-                    utils.save_checkpoint(args.ckpt_dir, epoch, dp.state_dict())
+                # step-boundary snapshot tagged with the CURRENT epoch:
+                # resume replays this epoch from its deterministic
+                # sampler order rather than trusting a mid-epoch cursor
+                save_ckpt(epoch)
+                if async_ckpt is not None:
+                    # durable inside the grace window, before exit
+                    async_ckpt.flush()
                 log.warning("preempted: checkpointed at epoch %d boundary; "
                             "exiting cleanly", epoch)
                 break
-            if args.ckpt_dir:
-                utils.save_checkpoint(args.ckpt_dir, epoch + 1, dp.state_dict())
+            save_ckpt(epoch + 1)
             if args.eval_every and (epoch + 1) % args.eval_every == 0:
                 last_eval = run_eval()
                 runtime.master_print(f"epoch {epoch}: val top1 {last_eval:.4f}")
@@ -246,6 +292,8 @@ def main():
                 last_eval = None  # model changed since the last eval
 
         prof.close()  # end the profile before the final eval pass
+        if async_ckpt is not None:
+            async_ckpt.close()  # flush pending writes before we finish
         final_top1 = last_eval if last_eval is not None else run_eval()
         if scalars:
             scalars.log(step, final_val_top1=final_top1)

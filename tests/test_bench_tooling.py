@@ -497,12 +497,79 @@ class TestTelemetryBlock:
         # checkpoint + probe activity of the run is visible in the block
         assert tel["counters"]["checkpoint.saves"] >= 1
         assert tel["counters"]["probe.forced_cpu"] >= 1
+        # the async-writer activity of the recovery block rides the
+        # same registry
+        assert tel["counters"]["checkpoint.async_saves"] >= 1
+        # the scan block is always present (k=1 default: the per-step
+        # loop IS the measurement) with the pinned field set
+        self._validate_scan_block(line["scan"], k=1)
         # the --trace file is valid Chrome trace JSON with the three
         # span families a step loop produces
         events = tracing.validate_trace(tracing.load_trace(trace))
         names = {e["name"] for e in events}
         assert {"data_wait", "step"} <= names
         assert any(n.startswith("checkpoint") for n in names)
+
+    @staticmethod
+    def _validate_scan_block(block, *, k):
+        """The schema-pinned `scan` block (ISSUE 4 satellite): drift
+        here breaks the host-dispatch-gap trajectory across rounds."""
+        assert set(block) == {
+            "k", "chunks", "host_gap_frac", "host_gap_frac_scan1",
+            "dispatch_frac", "dispatch_frac_scan1",
+            "img_per_sec_per_chip",
+        }
+        assert block["k"] == k
+        assert isinstance(block["chunks"], int) and block["chunks"] >= 1
+        for key in ("host_gap_frac", "host_gap_frac_scan1",
+                    "dispatch_frac", "dispatch_frac_scan1"):
+            assert block[key] is None or 0.0 <= block[key] <= 1.5, key
+        assert block["img_per_sec_per_chip"] > 0
+
+    def test_scan_flag_emits_fused_block(self, tmp_path, monkeypatch, capsys):
+        """--scan K: the fused K-step loop runs and the scan block
+        carries both gap fractions (its own scan-1 baseline rides the
+        same line, so the win is a tracked number)."""
+        from tpu_syncbn.obs import telemetry, tracing
+
+        bench = _load_bench()
+        monkeypatch.setenv("TPU_SYNCBN_FORCE_CPU", "1")
+        monkeypatch.setenv("BENCH_STEPS", "4")
+        monkeypatch.setattr(bench, "build_program", self._tiny_build())
+        telemetry.REGISTRY.reset()
+        try:
+            bench.main(scan=2)
+        finally:
+            telemetry.set_enabled(None)
+            telemetry.REGISTRY.reset()
+            tracing.uninstall()
+        line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        self._validate_scan_block(line["scan"], k=2)
+        assert line["scan"]["chunks"] == 2  # 4 steps / K=2
+        # the fused dispatch histogram landed in the telemetry block
+        tel = telemetry.validate_snapshot(line["telemetry"])
+        assert tel["histograms"]["scan.chunk_dispatch_s"]["count"] == 2
+
+    def test_xla_spew_filter_is_armed_before_jax(self):
+        """ISSUE 4 satellite: the XLA C++ "host machine features ...
+        SIGILL" advisory must be routed off the result stream so the
+        JSON line is always the last stdout line. bench.py arms
+        TF_CPP_MIN_LOG_LEVEL at import, before anything pulls in jax
+        (TSL latches it at first log)."""
+        import re
+
+        with open(os.path.join(ROOT, "bench.py")) as f:
+            src = f.read()
+        setdefault = src.index('os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL"')
+        log_stream = src.index(
+            'os.environ.setdefault("TPU_SYNCBN_LOG_STREAM"')
+        first_jax = re.search(r"^\s*(import jax|from jax)", src,
+                              re.MULTILINE)
+        first_local = src.index("from _common import")
+        assert setdefault < first_local and log_stream < first_local
+        assert first_jax is None or setdefault < first_jax.start()
+        _load_bench()
+        assert os.environ.get("TF_CPP_MIN_LOG_LEVEL") is not None
 
     def test_trace_flag_requires_path(self):
         proc = subprocess.run(
@@ -544,9 +611,15 @@ class TestRecoveryBlock:
         assert set(rec) == {
             "ckpt_roundtrip_s", "ckpt_roundtrip_seed_s",
             "manifest_overhead_s", "manifest_overhead_frac",
+            "ckpt_async_enqueue_s", "ckpt_async_flush_s",
+            "async_manifest_verified",
             "resume_after_kill_s", "resumed_step_after_kill", "ckpt_bytes",
         }
         assert rec["manifest_overhead_s"] >= 0
+        # async checkpointing: the loop-visible enqueue cost exists, and
+        # the background write still produced a certified manifest
+        assert rec["ckpt_async_enqueue_s"] >= 0
+        assert rec["async_manifest_verified"] is True
         # the injected kill truncated step 2: resume must land on the
         # older verified step, and quickly
         assert rec["resumed_step_after_kill"] == 1

@@ -200,6 +200,124 @@ def test_device_prefetch_sharded():
     assert out[0].sharding.is_equivalent_to(sharding, 2)
 
 
+def test_device_prefetch_scan_steps_stacks_chunks():
+    """scan_steps=K stages K-stacked chunks with the leading scan axis
+    unsharded and the per-step batch axis on the mesh — the layout
+    DataParallel.train_steps_batches scans over (docs/PERFORMANCE.md)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_syncbn import runtime
+
+    mesh = runtime.data_parallel_mesh()
+    sharding = NamedSharding(mesh, P("data"))
+    ds = tdata.ArrayDataset(np.arange(64, dtype=np.float32).reshape(32, 2))
+    dl = tdata.DataLoader(ds, batch_size=8)  # 4 batches
+    out = list(tdata.device_prefetch(iter(dl), sharding=sharding,
+                                     scan_steps=2))
+    assert len(out) == 2
+    assert all(b.shape == (2, 8, 2) for b in out)
+    expect = NamedSharding(mesh, P(None, "data"))
+    assert out[0].sharding.is_equivalent_to(expect, 3)
+    np.testing.assert_array_equal(
+        np.asarray(out[0]).reshape(16, 2), np.arange(32).reshape(16, 2)
+    )
+
+
+def test_device_prefetch_terminal_partial_chunk():
+    """Terminal StopIteration with a non-full staging queue: the final
+    chunk carries the remainder (leading axis < K) instead of dropping
+    it or hanging."""
+    ds = tdata.ArrayDataset(np.arange(20, dtype=np.float32).reshape(5, 4))
+    dl = tdata.DataLoader(ds, batch_size=1)  # 5 batches, K=2 -> 2+2+1
+    out = list(tdata.device_prefetch(iter(dl), scan_steps=2))
+    assert [b.shape[0] for b in out] == [2, 2, 1]
+    np.testing.assert_array_equal(np.asarray(out[2][0, 0]), [16, 17, 18, 19])
+    # empty source: plain StopIteration, no empty chunk
+    assert list(tdata.device_prefetch(iter([]), scan_steps=2)) == []
+
+
+def test_device_prefetch_scan_rejects_non_named_sharding():
+    """scan_steps>1 derives the K-stacked layout from the sharding's
+    mesh+spec — only a NamedSharding has them, so anything else must
+    fail loudly up front, not AttributeError mid-stream."""
+    import jax
+    from jax.sharding import SingleDeviceSharding
+
+    sh = SingleDeviceSharding(jax.devices()[0])
+    with pytest.raises(TypeError, match="NamedSharding"):
+        list(tdata.device_prefetch(iter([np.zeros(4, np.float32)]),
+                                   sharding=sh, scan_steps=2))
+
+
+def test_device_prefetch_scan_rejects_dtype_drift():
+    """A later batch whose leaves change dtype must error, not be
+    silently cast into the first batch's slots (the scan_steps=1 path
+    preserves per-batch dtypes — parity demands loudness here)."""
+    batches = [np.zeros(4, np.float32), np.zeros(4, np.float64)]
+    with pytest.raises(ValueError, match="dtypes"):
+        list(tdata.device_prefetch(iter(batches), scan_steps=2))
+
+
+def test_device_prefetch_staging_copies_host_buffers():
+    """Donation-safe ownership, host half: the staging stack must COPY —
+    a source iterator recycling one buffer in place (the native staging
+    ring's pattern) must not retroactively mutate a staged chunk."""
+    buf = np.zeros(4, np.float32)
+
+    def recycling():
+        for i in range(4):
+            buf[:] = i  # reuse the same backing storage every batch
+            yield buf
+
+    out = list(tdata.device_prefetch(recycling(), scan_steps=2, size=1))
+    np.testing.assert_array_equal(np.asarray(out[0])[:, 0], [0, 1])
+    np.testing.assert_array_equal(np.asarray(out[1])[:, 0], [2, 3])
+
+
+def test_device_prefetch_staged_chunk_survives_donated_steps():
+    """Donation-safe ownership, device half: a staged chunk fed to a
+    donate=True trainer must not alias live training state — the
+    trainer never donates batches, so the SAME chunk must be re-usable
+    and produce the same first-step loss from the same starting state."""
+    import jax
+    import optax
+    from flax import nnx
+
+    from tpu_syncbn import nn as tnn, parallel
+
+    class Net(nnx.Module):
+        def __init__(self, rngs):
+            self.fc = nnx.Linear(2, 2, rngs=rngs)
+            self.bn = tnn.BatchNorm1d(2)
+
+        def __call__(self, x):
+            return self.bn(self.fc(x))
+
+    def build():
+        return parallel.DataParallel(
+            tnn.convert_sync_batchnorm(Net(nnx.Rngs(0))),
+            optax.sgd(0.1), lambda m, b: (m(b) ** 2).mean(), donate=True,
+        )
+
+    rng = np.random.RandomState(0)
+    batches = [rng.randn(16, 2).astype(np.float32) for _ in range(2)]
+    dp = build()
+    chunks = list(tdata.device_prefetch(
+        iter(batches), sharding=dp.batch_sharding, scan_steps=2
+    ))
+    first = dp.train_steps_batches(chunks[0])
+    loss_a = np.asarray(first.loss)
+    # chunk buffer still alive after donated state transitions…
+    np.testing.assert_array_equal(
+        np.asarray(chunks[0]).reshape(32, 2), np.stack(batches).reshape(32, 2)
+    )
+    # …and a fresh trainer over the SAME chunk reproduces the run — a
+    # donated-then-reused staging buffer aliasing state would diverge
+    loss_b = np.asarray(build().train_steps_batches(chunks[0]).loss)
+    np.testing.assert_allclose(loss_a, loss_b, rtol=1e-6)
+
+
 def test_distributed_end_to_end_cover():
     """2-replica loaders with the distributed sampler cover the dataset
     exactly (drop_last both levels) — the recipe's step-5 wiring

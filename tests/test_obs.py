@@ -158,7 +158,8 @@ class TestDisabledPath:
 class TestCounterGroup:
     def test_eventcounter_is_countergroup_alias(self):
         assert issubclass(utils.EventCounter, telemetry.CounterGroup)
-        c = utils.EventCounter()
+        with pytest.warns(DeprecationWarning, match="CounterGroup"):
+            c = utils.EventCounter()
         assert c.bump("x") == 1 and c.bump("x", 2) == 3
         assert c.count("y") == 0
         assert c.summary() == {"x": 3}

@@ -249,8 +249,13 @@ class TestWatchdog:
 
 
 class TestEventCounter:
+    def test_construction_warns_deprecated(self):
+        with pytest.warns(DeprecationWarning, match="CounterGroup"):
+            EventCounter()
+
     def test_bump_count_summary(self):
-        c = EventCounter()
+        with pytest.warns(DeprecationWarning):
+            c = EventCounter()
         assert c.count("x") == 0
         assert c.bump("x") == 1
         assert c.bump("x", 2) == 3
@@ -258,7 +263,8 @@ class TestEventCounter:
         assert c.summary() == {"x": 3, "y": 1}
 
     def test_thread_safety(self):
-        c = EventCounter()
+        with pytest.warns(DeprecationWarning):
+            c = EventCounter()
 
         def work():
             for _ in range(1000):

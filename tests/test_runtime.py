@@ -54,3 +54,17 @@ def test_barrier_completes():
 def test_logger_master_level():
     logger = runtime.get_logger()
     assert logger.level in (10, 20)  # INFO on master
+
+
+def test_logger_stream_env_knob(monkeypatch):
+    """TPU_SYNCBN_LOG_STREAM=stderr reroutes a freshly created package
+    logger off stdout — bench.py sets it so its JSON result line owns
+    stdout (docs/PERFORMANCE.md satellite)."""
+    import sys
+
+    monkeypatch.setenv("TPU_SYNCBN_LOG_STREAM", "stderr")
+    lg = runtime.get_logger("tpu_syncbn.test_stream_knob")
+    assert lg.handlers[0].stream is sys.stderr
+    monkeypatch.delenv("TPU_SYNCBN_LOG_STREAM")
+    lg2 = runtime.get_logger("tpu_syncbn.test_stream_knob_default")
+    assert lg2.handlers[0].stream is sys.stdout

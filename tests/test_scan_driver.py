@@ -1,0 +1,433 @@
+"""Fused multi-step execution (parallel.scan_driver) and async
+checkpointing: the perf-layer contracts of docs/PERFORMANCE.md.
+
+The load-bearing claim is *equivalence*: K-step scanned execution must be
+exactly K sequential ``train_step`` calls — params, optimizer state, BN
+buffers, per-step metrics AND monitors — for DataParallel, ZeRO mode, and
+GANTrainer, including with the divergence guard armed and with a SIGTERM
+landing mid-chunk (PR 1 semantics at chunk boundaries). Async checkpoint
+writes must be byte-certified like synchronous ones and durable before
+any exit path returns.
+"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import nnx
+
+from tpu_syncbn import nn as tnn, parallel
+from tpu_syncbn.data import device_prefetch
+from tpu_syncbn.obs import telemetry, tracing
+from tpu_syncbn.parallel import scan_driver
+from tpu_syncbn.runtime.resilience import ResilientLoop
+from tpu_syncbn.testing import faults
+from tpu_syncbn.utils import checkpoint as ckpt
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    telemetry.set_enabled(None)
+    telemetry.REGISTRY.reset()
+    tracing.uninstall()
+    yield
+    telemetry.set_enabled(None)
+    telemetry.REGISTRY.reset()
+    tracing.uninstall()
+
+
+class Net(nnx.Module):
+    def __init__(self, rngs):
+        self.fc = nnx.Linear(8, 8, rngs=rngs)
+        self.bn = tnn.BatchNorm1d(8)
+
+    def __call__(self, x):
+        return self.bn(self.fc(x))
+
+
+def mse_loss(m, b):
+    return (m(b) ** 2).mean()
+
+
+def build_dp(**kw):
+    kw.setdefault("donate", True)
+    return parallel.DataParallel(
+        tnn.convert_sync_batchnorm(Net(nnx.Rngs(0))),
+        optax.sgd(0.1, momentum=0.9), mse_loss, **kw,
+    )
+
+
+def make_batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randn(16, 8).astype(np.float32) for _ in range(n)]
+
+
+def stage(batches, dp):
+    """K-stacked device chunk the way device_prefetch(scan_steps=K)
+    lays it out."""
+    return jax.device_put(np.stack(batches), dp.scan_batch_sharding)
+
+
+def assert_trees_close(a, b, *, rtol=1e-5, atol=1e-6, msg=""):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=rtol, atol=atol, err_msg=msg
+        ),
+        a, b,
+    )
+
+
+def assert_state_matches(dp_a, dp_b, *, rtol=1e-5, atol=1e-6):
+    for name, a, b in (
+        ("params", dp_a.params, dp_b.params),
+        ("rest", dp_a.rest, dp_b.rest),
+        ("opt", dp_a.opt_state, dp_b.opt_state),
+    ):
+        assert_trees_close(a, b, rtol=rtol, atol=atol, msg=name)
+
+
+# --------------------------------------------------------- stacked parity
+
+
+class TestStackedParity:
+    """train_steps_batches(chunk) == K sequential train_step calls on
+    the chunk's K slices — full state, stacked metrics, AND monitors."""
+
+    @pytest.mark.parametrize("donate", [False, True])
+    def test_matches_sequential_steps(self, donate):
+        batches = make_batches(3, seed=1)
+        dp_seq = build_dp(donate=donate)
+        seq = [dp_seq.train_step(b) for b in batches]
+        dp_scan = build_dp(donate=donate)
+        out = dp_scan.train_steps_batches(stage(batches, dp_scan))
+        assert out.loss.shape == (3,)
+        np.testing.assert_allclose(
+            np.asarray(out.loss), [float(s.loss) for s in seq], rtol=1e-5
+        )
+        assert_state_matches(dp_scan, dp_seq)
+        # monitors stacked on-device: slice k equals step k's monitors
+        assert set(out.monitors) == set(seq[0].monitors)
+        for key, stacked in out.monitors.items():
+            np.testing.assert_allclose(
+                np.asarray(stacked),
+                [float(s.monitors[key]) for s in seq],
+                rtol=1e-4, atol=1e-6, err_msg=key,
+            )
+
+    def test_zero_mode_parity(self):
+        batches = make_batches(2, seed=2)
+        dp_seq = build_dp(zero=True, donate=False)
+        seq = [float(dp_seq.train_step(b).loss) for b in batches]
+        dp_scan = build_dp(zero=True, donate=False)
+        out = dp_scan.train_steps_batches(stage(batches, dp_scan))
+        np.testing.assert_allclose(np.asarray(out.loss), seq, rtol=1e-5)
+        assert_state_matches(dp_scan, dp_seq)
+
+    def test_divergence_guard_parity_nan_mid_chunk(self):
+        """A NaN batch INSIDE the chunk: the on-device guard must skip
+        that step exactly as in the step-by-step loop — stacked
+        ``nonfinite`` flags the right slot, the guard's persistent count
+        survives in opt_state, and the final state matches."""
+        batches = make_batches(3, seed=3)
+        batches[1] = np.full_like(batches[1], np.nan)
+        dp_seq = build_dp(divergence_guard="halve_lr", donate=False)
+        seq_nonf = [float(dp_seq.train_step(b).metrics["nonfinite"])
+                    for b in batches]
+        dp_scan = build_dp(divergence_guard="halve_lr", donate=False)
+        out = dp_scan.train_steps_batches(stage(batches, dp_scan))
+        np.testing.assert_array_equal(
+            np.asarray(out.metrics["nonfinite"]), seq_nonf
+        )
+        assert seq_nonf == [0.0, 1.0, 0.0]
+        assert_state_matches(dp_scan, dp_seq)
+        # the guard state rides in opt_state: one non-finite step counted
+        guard = dp_scan.opt_state[1]
+        assert int(np.asarray(guard["nonfinite_count"])) == 1
+        np.testing.assert_allclose(float(np.asarray(guard["lr_scale"])), 0.5)
+
+    def test_partial_terminal_chunk_compiles_its_own_program(self):
+        dp = build_dp()
+        batches = make_batches(3, seed=4)
+        dp.train_steps_batches(stage(batches[:2], dp))
+        dp.train_steps_batches(stage(batches[2:], dp))  # K=1 chunk
+        assert (2, True) in dp._train_steps_cache
+        assert (1, True) in dp._train_steps_cache
+
+    def test_chunk_is_never_donated(self):
+        """Donation-safe staging: with donate=True the state is donated
+        but the chunk must survive the call (the staging queue may still
+        own it) — re-running the same chunk object must work."""
+        dp = build_dp(donate=True)
+        chunk = stage(make_batches(2, seed=5), dp)
+        dp.train_steps_batches(chunk)
+        out = dp.train_steps_batches(chunk)  # chunk buffer still live
+        assert np.isfinite(np.asarray(out.loss)).all()
+
+
+class TestGANScannedParity:
+    def _build(self):
+        from tpu_syncbn.models import gan
+        from tpu_syncbn.parallel.gan_trainer import GANTrainer
+
+        g = gan.DCGANGenerator(latent_dim=8, width=16, rngs=nnx.Rngs(0))
+        d = gan.DCGANDiscriminator(width=8, rngs=nnx.Rngs(1))
+        return GANTrainer(
+            tnn.convert_sync_batchnorm(g), tnn.convert_sync_batchnorm(d),
+            optax.sgd(0.05), optax.sgd(0.05),
+        )
+
+    def test_matches_sequential_steps(self):
+        rng = np.random.RandomState(0)
+        reals = [rng.randn(8, 32, 32, 3).astype(np.float32) for _ in range(2)]
+        zds = [rng.randn(8, 8).astype(np.float32) for _ in range(2)]
+        zgs = [rng.randn(8, 8).astype(np.float32) for _ in range(2)]
+        t_seq = self._build()
+        seq = [t_seq.train_step(r, a, b)
+               for r, a, b in zip(reals, zds, zgs)]
+        t_scan = self._build()
+        from jax.sharding import NamedSharding
+
+        sh = NamedSharding(
+            t_scan.mesh,
+            scan_driver.stack_batch_spec(
+                jax.sharding.PartitionSpec(t_scan.axis_name)
+            ),
+        )
+        put = lambda ls: jax.device_put(np.stack(ls), sh)
+        out = t_scan.train_steps(put(reals), put(zds), put(zgs))
+        assert out.d_loss.shape == (2,)
+        np.testing.assert_allclose(
+            np.asarray(out.d_loss), [float(s.d_loss) for s in seq],
+            rtol=1e-5, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out.g_loss), [float(s.g_loss) for s in seq],
+            rtol=1e-5, atol=1e-6,
+        )
+        # conv nets under a different XLA fusion order: accumulation
+        # noise up to ~1e-5 absolute on 1e-3-scale params is expected
+        for name, a, b in (
+            ("g_params", t_scan.g_params, t_seq.g_params),
+            ("d_params", t_scan.d_params, t_seq.d_params),
+            ("g_rest", t_scan.g_rest, t_seq.g_rest),
+            ("d_rest", t_scan.d_rest, t_seq.d_rest),
+            ("g_opt", t_scan.g_opt_state, t_seq.g_opt_state),
+            ("d_opt", t_scan.d_opt_state, t_seq.d_opt_state),
+        ):
+            assert_trees_close(a, b, rtol=2e-4, atol=1e-5, msg=name)
+        assert set(out.monitors) == set(seq[0].monitors)
+        # composes with the single-step path afterwards
+        t_scan.train_step(reals[0], zds[0], zgs[0])
+        assert 2 in t_scan._train_steps_cache
+
+
+# --------------------------------------------------- resilient chunk loop
+
+
+class TestResilientLoopScan:
+    def test_chunked_loop_matches_step_loop(self, tmp_path):
+        batches = make_batches(4, seed=6)
+        dp_ref = build_dp()
+        for b in batches:
+            dp_ref.train_step(b)
+
+        dp = build_dp()
+        loop = ResilientLoop(dp, str(tmp_path / "ck"), ckpt_every=2,
+                             keep=5, scan_steps=2)
+        chunks = device_prefetch(
+            iter(batches), sharding=dp.batch_sharding, scan_steps=2
+        )
+        summary = loop.run(chunks)
+        assert summary["steps"] == 4 and summary["step"] == 4
+        assert_state_matches(dp, dp_ref)
+        # ckpt_every=2 crossed at steps 2 and 4 — one save per crossing
+        assert ckpt.verified_steps(str(tmp_path / "ck")) == [2, 4]
+
+    def test_sigterm_mid_chunk_checkpoints_at_boundary(self, tmp_path):
+        """PR 1 fault marker inside a chunk: the in-flight chunk's K
+        steps complete (they are one compiled program), then the loop
+        checkpoints at the chunk boundary and exits preempted — with
+        async checkpointing, the write is durable before run() returns."""
+        batches = make_batches(4, seed=7)
+        dp_ref = build_dp()
+        for b in batches:
+            dp_ref.train_step(b)
+
+        dp = build_dp()
+        ckdir = str(tmp_path / "ck")
+        loop = ResilientLoop(dp, ckdir, ckpt_every=100, scan_steps=2,
+                             async_checkpoint=True)
+        chunks = device_prefetch(
+            iter(batches), sharding=dp.batch_sharding, scan_steps=2
+        )
+        # SIGTERM delivered as chunk 1 is fetched: it lands while chunk
+        # semantics are mid-flight, and must be honored AFTER the chunk
+        summary = loop.run(faults.signal_at(chunks, at_step=1))
+        assert summary["preempted"] is True
+        assert summary["step"] == 4  # the signalled chunk still ran
+        # boundary checkpoint durable the moment run() returned (the
+        # async writer was flushed on the preemption exit path)
+        assert ckpt.verified_steps(ckdir) == [4]
+        state, step = ckpt.load_checkpoint(ckdir, dp.state_dict())
+        assert step == 4
+        assert_trees_close(state["params"], dp_ref.params, msg="params")
+
+    def test_close_stops_async_worker(self, tmp_path):
+        """A loop built per restart attempt must not leak its async
+        writer thread: close() (or the context manager) stops it, and
+        pending writes are flushed first."""
+        dp = build_dp()
+        ckdir = str(tmp_path / "ck")
+        with ResilientLoop(dp, ckdir, ckpt_every=1,
+                           async_checkpoint=True) as loop:
+            loop.run(device_prefetch(iter(make_batches(1, seed=12)),
+                                     sharding=dp.batch_sharding))
+        assert loop._async._closed
+        assert not loop._async._thread.is_alive()
+        assert ckpt.verified_steps(ckdir) == [1]
+        loop.close()  # idempotent
+
+    def test_flush_error_does_not_mask_primary_failure(self, tmp_path):
+        """A background write failure surfacing in run()'s cleanup must
+        not REPLACE the loop's own failure — a caller handling
+        FloatingPointError/StallError has to see that type. The flush
+        error is logged instead (and consumed: the loop is exiting on
+        the primary failure anyway)."""
+        dp = build_dp()
+        blocked = tmp_path / "ck"
+        blocked.write_text("a file where the directory should go")
+
+        class Boom(RuntimeError):
+            pass
+
+        def batches():
+            yield from make_batches(1, seed=13)
+            raise Boom("primary training failure")
+
+        with ResilientLoop(dp, str(blocked), ckpt_every=1,
+                           async_checkpoint=True) as loop:
+            with pytest.raises(Boom):
+                loop.run(device_prefetch(batches(),
+                                         sharding=dp.batch_sharding))
+            # the write error was consumed (logged) by the exceptional
+            # path — cleanup afterwards is clean, no late re-raise
+            assert loop.flush_checkpoints(timeout=30)
+
+    def test_restore_last_good_at_chunk_boundary(self, tmp_path):
+        batches = make_batches(6, seed=8)
+        batches[3] = np.full_like(batches[3], np.nan)  # inside chunk 1
+        dp = build_dp(divergence_guard="restore_last_good")
+        ckdir = str(tmp_path / "ck")
+        loop = ResilientLoop(dp, ckdir, ckpt_every=2, keep=5, scan_steps=2)
+        chunks = device_prefetch(
+            iter(batches), sharding=dp.batch_sharding, scan_steps=2
+        )
+        summary = loop.run(chunks)
+        # chunk 1 contained the NaN step: host policy restored the last
+        # verified checkpoint (step 2) at the chunk boundary
+        assert summary["nonfinite_steps"] == 1
+        assert summary["divergence_restores"] == 1
+        assert summary["step"] >= 2
+
+
+# ------------------------------------------------------ async checkpoints
+
+
+class TestAsyncCheckpointer:
+    def _state(self, seed=0):
+        rng = np.random.RandomState(seed)
+        return {
+            "w": jnp.asarray(rng.randn(32, 8).astype(np.float32)),
+            "n": jnp.asarray(3, jnp.int32),
+        }
+
+    def test_write_certifies_and_loads(self, tmp_path):
+        d = str(tmp_path)
+        state = self._state()
+        with ckpt.AsyncCheckpointer(keep=3) as ac:
+            ac.save(d, 1, state)
+            assert ac.flush(timeout=30)
+        assert ckpt.verify_checkpoint(d, 1)
+        loaded, step = ckpt.load_checkpoint(d, self._state())
+        assert step == 1
+        assert_trees_close(loaded, state)
+
+    def test_snapshot_is_copy_before_donate(self, tmp_path):
+        """The snapshot must be immune to the donor's next step: run
+        donated train steps immediately after save() and the flushed
+        checkpoint must hold the state AT save time, not the mutated
+        (or recycled) buffers."""
+        d = str(tmp_path)
+        dp = build_dp(donate=True)
+        batches = make_batches(3, seed=9)
+        dp.train_step(batches[0])
+        expect = jax.tree_util.tree_map(
+            lambda x: np.array(x, copy=True), jax.device_get(dp.params)
+        )
+        with ckpt.AsyncCheckpointer(keep=3) as ac:
+            ac.save(d, 1, dp.state_dict())
+            # donated steps recycle the live buffers while the writer runs
+            dp.train_step(batches[1])
+            dp.train_step(batches[2])
+            assert ac.flush(timeout=60)
+        loaded, _ = ckpt.load_checkpoint(d, dp.state_dict())
+        assert_trees_close(loaded["params"], expect, msg="snapshot drifted")
+
+    def test_ordering_newest_step_wins(self, tmp_path):
+        d = str(tmp_path)
+        with ckpt.AsyncCheckpointer(keep=2, max_pending=4) as ac:
+            for step in (1, 2, 3):
+                ac.save(d, step, self._state(step))
+            assert ac.flush(timeout=60)
+        # writes landed in submission order: prune kept the newest 2
+        assert ckpt.verified_steps(d) == [2, 3]
+        _, step = ckpt.load_checkpoint(d, self._state())
+        assert step == 3
+
+    def test_background_error_surfaces_at_flush(self, tmp_path):
+        target = tmp_path / "blocked"
+        target.write_text("a file where the directory should go")
+        ac = ckpt.AsyncCheckpointer()
+        ac.save(str(target), 1, self._state())
+        with pytest.raises(RuntimeError, match="async checkpoint write"):
+            ac.flush(timeout=30)
+        ac.close()
+
+    def test_validates_max_pending(self):
+        with pytest.raises(ValueError, match="max_pending"):
+            ckpt.AsyncCheckpointer(max_pending=0)
+
+
+# ------------------------------------------------------- perf guard
+
+
+@pytest.mark.perf
+def test_scan_chunk_host_overhead_budget():
+    """Tier-1 overhead guard (the PR 2 disabled-telemetry-guard
+    pattern): dispatching one warmed fused chunk must stay cheap on the
+    host — the whole point of the scan driver is ~1/K of the per-step
+    host cost, so a per-chunk host overhead creeping toward a full
+    step's worth is a regression. The budget is an order of magnitude
+    above the observed cost so only a real regression (per-step host
+    sync sneaking into the chunk path, cache miss per call) trips it."""
+    dp = build_dp(donate=True)
+    chunk = stage(make_batches(4, seed=10), dp)
+    out = dp.train_steps_batches(chunk)  # compile + warm
+    jax.block_until_ready(out.loss)
+    n = 25
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = dp.train_steps_batches(chunk)
+    dispatch_s = time.perf_counter() - t0
+    jax.block_until_ready(out.loss)
+    per_chunk = dispatch_s / n
+    assert per_chunk < 0.05, (
+        f"fused-chunk dispatch took {per_chunk * 1e3:.1f} ms/chunk "
+        "(budget 50 ms) — host work crept into the scan driver's hot path"
+    )
+    # exactly one cached program: no per-call rebuilds
+    assert list(dp._train_steps_cache) == [(4, True)]

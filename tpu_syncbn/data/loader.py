@@ -600,6 +600,7 @@ def device_prefetch(
     size: int = 2,
     sharding=None,
     to_device: bool = True,
+    scan_steps: int = 1,
 ):
     """Wrap a host-batch iterator with device staging — the pinned-memory +
     async-H2D role of the reference's ``pin_memory=True`` loader thread
@@ -611,10 +612,43 @@ def device_prefetch(
     ``sharding`` (a ``NamedSharding`` over the data axis) the put lands
     each shard directly on its chip — the global-batch feed for the
     data-parallel trainer.
+
+    ``scan_steps=K > 1`` turns the stream into a K-deep device staging
+    queue for the fused multi-step driver (docs/PERFORMANCE.md): each
+    yielded item stacks K consecutive batches along a new leading axis —
+    the layout ``DataParallel.train_steps_batches`` scans over — staged
+    with the leading axis unsharded and the per-step batch axis on the
+    mesh, while ``size`` chunks stay in flight so the next chunk's h2d
+    overlaps the current chunk's K steps. Ownership is donation-safe by
+    construction: the host-side stack copies (the source iterator may
+    recycle its buffers immediately) and the device chunk is a fresh
+    array the trainers never donate. A terminal ``StopIteration`` with a
+    non-full staging queue yields one final *partial* chunk (leading
+    axis < K — its own compile; feed step counts divisible by K, e.g.
+    ``drop_last`` at the chunk level, to avoid it).
     """
     if size < 1:
         raise ValueError("size must be >= 1")
+    if scan_steps < 1:
+        raise ValueError("scan_steps must be >= 1")
     multi_host = jax.process_count() > 1
+    if scan_steps > 1 and sharding is not None:
+        from jax.sharding import NamedSharding
+
+        if not isinstance(sharding, NamedSharding):
+            raise TypeError(
+                "device_prefetch(scan_steps>1) needs a NamedSharding to "
+                "derive the K-stacked chunk layout (leading scan axis "
+                f"unsharded), got {type(sharding).__name__} — pass the "
+                "trainer's batch_sharding"
+            )
+        # ONE definition of the K-stacked layout rule, shared with
+        # DataParallel.scan_batch_sharding — drift here would stage
+        # chunks train_steps_batches can't consume without a reshard
+        from tpu_syncbn.parallel.scan_driver import stack_batch_spec
+
+        sharding = NamedSharding(sharding.mesh,
+                                 stack_batch_spec(sharding.spec))
 
     def put(batch):
         if not to_device:
@@ -635,18 +669,65 @@ def device_prefetch(
         )
 
     def staged(it):
-        """Fetch + stage the next batch, instrumented (obs.stepstats):
-        ``data_wait`` is the blocking wait on the host iterator,
-        ``h2d`` the device_put *dispatch* (the DMA itself is async —
-        overlap is the point, so the span measures dispatch, not
-        transfer completion). The terminal StopIteration fetch is NOT a
-        wait sample (stepstats.timed_fetch) — recording it would add one
-        end-of-epoch outlier per epoch."""
-        batch = obs_stepstats.timed_fetch(
-            it, "data_wait", "loader.data_wait_s"
-        )
+        """Fetch + stage the next batch (or K-chunk), instrumented
+        (obs.stepstats): ``data_wait`` is the blocking wait on the host
+        iterator, ``h2d`` the stack + device_put *dispatch* (the DMA
+        itself is async — overlap is the point, so the span measures
+        dispatch, not transfer completion). The terminal StopIteration
+        fetch is NOT a wait sample (stepstats.timed_fetch) — recording
+        it would add one end-of-epoch outlier per epoch."""
+        if scan_steps == 1:
+            batch = obs_stepstats.timed_fetch(
+                it, "data_wait", "loader.data_wait_s"
+            )
+            with obs_stepstats.timed_span("h2d", "loader.h2d_s"):
+                return put(batch)
+        # K-slot staging buffer, filled incrementally: each batch is
+        # copied into its slot AT FETCH TIME, so the chunk owns its
+        # bytes from the moment a batch arrives — a source that recycles
+        # one backing buffer across batches (the native staging ring's
+        # pattern) cannot retroactively mutate staged slots, and the
+        # whole chunk costs one host copy, not two
+        slots: list | None = None
+        treedef = None
+        count = 0
+        while count < scan_steps:
+            try:
+                b = obs_stepstats.timed_fetch(
+                    it, "data_wait", "loader.data_wait_s"
+                )
+            except StopIteration:
+                if count == 0:
+                    raise  # queue empty: the stream really is over
+                break  # partial terminal chunk (leading axis < K)
+            leaves, treedef = jax.tree_util.tree_flatten(b)
+            if slots is None:
+                slots = [
+                    np.empty((scan_steps,) + np.shape(l),
+                             np.asarray(l).dtype)
+                    for l in leaves
+                ]
+            for s, l in zip(slots, leaves):
+                if (np.shape(l) != s.shape[1:]
+                        or np.asarray(l).dtype != s.dtype):
+                    raise ValueError(
+                        f"scan_steps={scan_steps} staging needs static "
+                        "batch shapes and dtypes, got "
+                        f"{np.shape(l)}/{np.asarray(l).dtype} after "
+                        f"{s.shape[1:]}/{s.dtype} — use drop_last=True "
+                        "(ragged batches would retrigger XLA compilation "
+                        "anyway; a dtype drift would be silently cast)"
+                    )
+                s[count] = l
+            count += 1
         with obs_stepstats.timed_span("h2d", "loader.h2d_s"):
-            return put(batch)
+            if telemetry.enabled():
+                telemetry.set_gauge("loader.stage_depth", count)
+            stacked = jax.tree_util.tree_unflatten(
+                treedef,
+                [s if count == scan_steps else s[:count] for s in slots],
+            )
+            return put(stacked)
 
     buf: list = []
     it = iter(iterator)

@@ -113,9 +113,18 @@ class GANTrainer:
         self.g_opt_state = put(self.g_opt_state)
         self.d_opt_state = put(self.d_opt_state)
 
+        self._donate = bool(donate)
         self._step = self._build_step(donate)
+        self._train_steps_cache: dict = {}  # n_steps -> scanned jit
 
-    def _build_step(self, donate: bool):
+    def _make_step_fn(self):
+        """The pure per-device step body
+        ``(gp, gr, dp, dr, og, od, real, z_d, z_g) -> (state..., d_loss,
+        g_loss, metrics, monitors)`` — shared by the single-step jit and
+        the scanned multi-step jit (``train_steps``). Its state in/out
+        trees keep a stable VMA type (params/opt replicated in and out,
+        buffers broadcast from replica 0), which is what makes it a
+        legal ``lax.scan`` carry (``parallel.scan_driver``)."""
         axis = self.axis_name
         g_def, d_def = self.g_def, self.d_def
         loss_pair = self.loss_pair
@@ -199,8 +208,11 @@ class GANTrainer:
                 ))
             return gp, gr, dp_, dr, og, od, d_loss, g_loss, metrics, monitors
 
+        return step
+
+    def _build_step(self, donate: bool):
         sharded = shard_map(
-            step,
+            self._make_step_fn(),
             mesh=self.mesh,
             in_specs=(P(), P(), P(), P(), P(), P(),
                       P(self.axis_name), P(self.axis_name), P(self.axis_name)),
@@ -209,6 +221,48 @@ class GANTrainer:
         )
         donate_argnums = tuple(range(6)) if donate else ()
         return jax.jit(sharded, donate_argnums=donate_argnums)
+
+    def train_steps(self, real, z_d, z_g) -> GANStepOutput:
+        """K fused iterations (one D update + one G update each) in ONE
+        compiled program: every input carries a leading K axis — one
+        slice per iteration (``real`` a staged chunk from
+        ``data.device_prefetch(scan_steps=K)``, the latents stacked the
+        same way). Returns stacked per-iteration
+        ``d_loss``/``g_loss``/``metrics``/``monitors`` of leading
+        dimension K. One host dispatch per K iterations; exactly K
+        sequential ``train_step`` calls in params, buffers, optimizer
+        state, and monitors (tests/test_scan_driver.py).
+
+        Each distinct K compiles (and caches) its own XLA program —
+        feed a FIXED chunk size (``parallel.scan_driver`` bounds the
+        retained programs FIFO)."""
+        from tpu_syncbn.parallel import scan_driver
+
+        k = scan_driver.scan_length(real)
+        fn = scan_driver.cached_program(
+            self._train_steps_cache, k,
+            lambda: scan_driver.build_scan_steps(
+                self._make_step_fn(),
+                mesh=self.mesh,
+                state_specs=(P(),) * 6,
+                batch_specs=(P(self.axis_name),) * 3,
+                out_specs=(P(), P(), P(), P()),
+                n_steps=k,
+                stacked=True,
+                check_vma=self._check_vma,
+                donate=self._donate,
+            ),
+        )
+        (
+            self.g_params, self.g_rest, self.d_params, self.d_rest,
+            self.g_opt_state, self.d_opt_state, d_loss, g_loss, metrics,
+            monitors,
+        ) = fn(
+            self.g_params, self.g_rest, self.d_params, self.d_rest,
+            self.g_opt_state, self.d_opt_state, real, z_d, z_g,
+        )
+        return GANStepOutput(d_loss=d_loss, g_loss=g_loss, metrics=metrics,
+                             monitors=monitors)
 
     def train_step(self, real, z_d, z_g) -> GANStepOutput:
         (

@@ -1,0 +1,155 @@
+"""Shared fused multi-step driver: K optimizer steps in ONE compiled
+program (``lax.scan`` over the step body), for any trainer whose step is
+a pure per-device function with a stable carry.
+
+Why this exists (docs/PERFORMANCE.md): the recipe's throughput comes from
+keeping every replica busy while comms and host work hide behind compute
+(DDP's overlapped all-reduce; "Automatic Cross-Replica Sharding of Weight
+Update in Data-Parallel Training", arxiv 2004.13336, and "Efficient
+Pipeline Planning for Expedited Distributed DNN Training", arxiv
+2204.10562, both locate the residual step time in host/update/comm work
+that is NOT overlapped). A host-driven step loop pays one Python dispatch
+per step; scanning K steps on-device pays one per K, and the per-step
+monitors/losses come back stacked — no host sync inside the chunk.
+
+``DataParallel`` and ``GANTrainer`` both build their fused entry points
+through :func:`build_scan_steps`; the per-chunk host-overhead budget is
+guarded by a tier-1 ``perf`` test (tests/test_scan_driver.py).
+
+Contract notes:
+
+* The step body must keep a **stable carry**: its state inputs and
+  outputs must agree in tree structure, shapes, dtypes AND (under the VMA
+  checker) varying-ness — the same property that makes it a legal
+  ``lax.scan`` carry. Both trainers' step bodies are written to this
+  contract (see ``DataParallel._make_step_fn``).
+* State is donated (when the trainer donates); **batches never are** —
+  in ``stacked=False`` mode every iteration re-reads the same batch, and
+  in ``stacked=True`` mode the staging queue may still own the buffer
+  (docs/PERFORMANCE.md "donation-safe staging").
+* PR 1 semantics survive by construction: the divergence guard rides
+  *inside* the carry (guard state lives in opt_state), so every scanned
+  step applies the same on-device rollback as the step-by-step loop;
+  host-side policies (preemption, restore_last_good) are honored at
+  chunk boundaries by ``runtime.resilience.ResilientLoop``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from tpu_syncbn.compat import shard_map
+
+#: Compiled fused programs retained per trainer cache (FIFO beyond this):
+#: each distinct (n_steps, stacked) pair is its own XLA program.
+MAX_CACHED_PROGRAMS = 4
+
+
+def stack_batch_spec(spec: P) -> P:
+    """The shard_map/``device_put`` spec for a K-stacked batch: the
+    leading scan axis is unsharded, the original spec shifts right —
+    ``P('data')`` → ``P(None, 'data')``."""
+    return P(None, *spec)
+
+
+def stack_batches(batches: Sequence[Any]):
+    """Stack identically-shaped host batch pytrees along a new leading
+    axis — the ``xs`` layout :func:`build_scan_steps` scans over. Copies
+    (``np.stack`` allocates), so callers may recycle the source buffers
+    immediately; device placement is the caller's business
+    (``data.device_prefetch(scan_steps=K)`` does both)."""
+    import numpy as np
+
+    if not batches:
+        raise ValueError("stack_batches needs at least one batch")
+    return jax.tree_util.tree_map(lambda *ls: np.stack(ls), *batches)
+
+
+def scan_length(batch) -> int:
+    """The leading-axis length of a stacked batch pytree (the K of a
+    staged chunk)."""
+    leaves = jax.tree_util.tree_leaves(batch)
+    if not leaves:
+        raise ValueError("batch pytree has no array leaves")
+    return int(leaves[0].shape[0])
+
+
+def build_scan_steps(
+    step_fn: Callable,
+    *,
+    mesh,
+    state_specs: Sequence[Any],
+    batch_specs: Sequence[Any],
+    out_specs: Sequence[Any],
+    n_steps: int,
+    stacked: bool,
+    check_vma: bool,
+    donate: bool = True,
+):
+    """Compile ``n_steps`` applications of ``step_fn`` into one jitted
+    ``lax.scan`` program.
+
+    ``step_fn`` is the pure per-device body
+    ``(*state, *batch) -> (*state, *outs)`` with ``len(state_specs)``
+    state arguments and ``len(batch_specs)`` batch arguments; outputs
+    beyond the carried state are stacked along a leading ``n_steps``
+    axis (losses, metrics, monitors — read them on the host *after* the
+    chunk, one fetch for K steps).
+
+    ``stacked=True``: each batch argument carries a leading ``n_steps``
+    axis (one slice per step — the staging queue's layout); its
+    shard_map spec is the caller's per-step spec shifted right
+    (:func:`stack_batch_spec`). ``stacked=False``: the same batch feeds
+    every iteration (dispatch-free inner loops on one batch; honest
+    device-throughput measurement).
+
+    State is donated when ``donate`` (the chunk's input state is dead
+    the moment the chunk runs — exactly the single-step contract);
+    batches are never donated.
+    """
+    if n_steps < 1:
+        raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+    n_state = len(state_specs)
+
+    def many(*args):
+        state, batches = args[:n_state], args[n_state:]
+
+        def body(carry, xs):
+            res = step_fn(*carry, *(batches if xs is None else xs))
+            return tuple(res[:n_state]), tuple(res[n_state:])
+
+        state, outs = jax.lax.scan(
+            body, tuple(state), batches if stacked else None,
+            length=n_steps,
+        )
+        return (*state, *outs)
+
+    in_batch_specs = (
+        tuple(stack_batch_spec(s) for s in batch_specs) if stacked
+        else tuple(batch_specs)
+    )
+    sharded = shard_map(
+        many,
+        mesh=mesh,
+        in_specs=(*state_specs, *in_batch_specs),
+        out_specs=(*state_specs, *out_specs),
+        check_vma=check_vma,
+    )
+    donate_argnums = tuple(range(n_state)) if donate else ()
+    return jax.jit(sharded, donate_argnums=donate_argnums)
+
+
+def cached_program(cache: dict, key, build: Callable[[], Any]):
+    """FIFO-bounded compiled-program retention shared by the trainers'
+    fused-step caches: at most :data:`MAX_CACHED_PROGRAMS` distinct
+    programs stay live; beyond that the oldest is evicted (a varying K
+    pays a fresh compile every call — call with a FIXED chunk size)."""
+    fn = cache.get(key)
+    if fn is None:
+        while len(cache) >= MAX_CACHED_PROGRAMS:
+            cache.pop(next(iter(cache)))
+        fn = cache[key] = build()
+    return fn

@@ -693,9 +693,10 @@ class DataParallel:
 
         return step
 
-    def _build_train_steps(self, n_steps: int):
+    def _build_train_steps(self, n_steps: int, *, stacked: bool = False):
         """``n_steps`` optimizer steps in ONE compiled program:
-        ``lax.scan`` of the step body with the same batch each iteration.
+        ``lax.scan`` of the step body (``parallel.scan_driver`` is the
+        shared builder — GANTrainer compiles through the same one).
 
         The idiomatic TPU training-loop shape (the step loop lives
         on-device; the chip never waits on the host between steps).
@@ -708,60 +709,31 @@ class DataParallel:
         steps, slow hosts, multi-process contention). The step body's
         stable VMA-typed in/out trees (see ``_make_step_fn``) are what
         make it a legal scan carry."""
-        step = self._make_step_fn()
+        from tpu_syncbn.parallel import scan_driver
 
-        def many(pstore, rest, opt_state, batch):
-            def body(carry, _):
-                p, r, o = carry
-                p, r, o, loss, metrics, monitors = step(p, r, o, batch)
-                return (p, r, o), (loss, metrics, monitors)
-
-            (pstore, rest, opt_state), (losses, metrics, monitors) = (
-                jax.lax.scan(
-                    body, (pstore, rest, opt_state), None, length=n_steps
-                )
-            )
-            return pstore, rest, opt_state, losses, metrics, monitors
-
-        sharded = shard_map(
-            many,
+        return scan_driver.build_scan_steps(
+            self._make_step_fn(),
             mesh=self.mesh,
-            in_specs=(self._pspec, self._rest_spec, self._opt_spec,
-                      P(self.axis_name)),
-            out_specs=(self._pspec, self._rest_spec, self._opt_spec,
-                       P(), P(), P()),
+            state_specs=(self._pspec, self._rest_spec, self._opt_spec),
+            batch_specs=(P(self.axis_name),),
+            out_specs=(P(), P(), P()),
+            n_steps=n_steps,
+            stacked=stacked,
             check_vma=self._check_vma,
+            donate=self._donate,
         )
-        # donate state but never the batch (reused by every iteration)
-        return jax.jit(sharded, donate_argnums=(0, 1, 2)
-                       if self._donate else ())
 
-    def train_steps(self, batch, n_steps: int) -> StepOutput:
-        """Run ``n_steps`` optimizer steps on the SAME global batch in
-        one compiled program (on-device ``lax.scan`` — no per-step host
-        dispatch). Returns per-step stacked ``loss``/``metrics`` of
-        leading dimension ``n_steps``.
+    def _run_scanned(self, key, batch) -> StepOutput:
+        from tpu_syncbn.parallel import scan_driver
 
-        For distinct data per step use the ordinary ``train_step`` host
-        loop (its dispatch overlaps with device work off the tunnel);
-        this entry point is for dispatch-free inner loops and honest
-        device-throughput measurement.
-
-        Each distinct ``n_steps`` compiles (and caches) its own XLA
-        program — call it with a FIXED n; the cache holds the most
-        recent few and evicts beyond that, so a varying n pays a fresh
-        compile every call."""
-        if n_steps < 1:
-            raise ValueError(f"n_steps must be >= 1, got {n_steps}")
-        fn = self._train_steps_cache.get(n_steps)
-        if fn is None:
-            while len(self._train_steps_cache) >= 4:  # bound compiled-
-                # program retention; FIFO is fine at this size
-                self._train_steps_cache.pop(
-                    next(iter(self._train_steps_cache)))
-            fn = self._train_steps_cache[n_steps] = self._build_train_steps(
-                n_steps
-            )
+        n_steps, stacked = key
+        fn = scan_driver.cached_program(
+            self._train_steps_cache,
+            # repeat-mode keys stay plain ints (the historical cache
+            # shape); stacked programs key on the pair
+            n_steps if not stacked else key,
+            lambda: self._build_train_steps(n_steps, stacked=stacked),
+        )
         (
             self._param_store,
             self.rest,
@@ -771,6 +743,60 @@ class DataParallel:
             monitors,
         ) = fn(self._param_store, self.rest, self.opt_state, batch)
         return StepOutput(loss=losses, metrics=metrics, monitors=monitors)
+
+    def train_steps(self, batch, n_steps: int) -> StepOutput:
+        """Run ``n_steps`` optimizer steps on the SAME global batch in
+        one compiled program (on-device ``lax.scan`` — no per-step host
+        dispatch). Returns per-step stacked ``loss``/``metrics`` of
+        leading dimension ``n_steps``.
+
+        For distinct data per step use :meth:`train_steps_batches` with
+        a staged chunk (``data.device_prefetch(scan_steps=K)``), or the
+        ordinary ``train_step`` host loop; this entry point is for
+        dispatch-free inner loops on one batch and honest
+        device-throughput measurement.
+
+        Each distinct ``n_steps`` compiles (and caches) its own XLA
+        program — call it with a FIXED n; the cache holds the most
+        recent few and evicts beyond that, so a varying n pays a fresh
+        compile every call."""
+        if n_steps < 1:
+            raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+        return self._run_scanned((n_steps, False), batch)
+
+    @property
+    def scan_batch_sharding(self):
+        """Sharding for a K-stacked batch (leading scan axis unsharded,
+        per-step batch axis over the mesh) — what
+        :meth:`train_steps_batches` expects and
+        ``data.device_prefetch(scan_steps=K, sharding=dp.batch_sharding)``
+        produces."""
+        from tpu_syncbn.parallel import scan_driver
+
+        return NamedSharding(
+            self.mesh, scan_driver.stack_batch_spec(P(self.axis_name))
+        )
+
+    def train_steps_batches(self, batches) -> StepOutput:
+        """Run one optimizer step per leading-axis slice of ``batches``
+        — a pytree stacked to ``(K, global_batch, ...)``, e.g. one
+        staged chunk from ``data.device_prefetch(scan_steps=K)`` — in
+        ONE compiled program (``lax.scan``; one host dispatch per K
+        steps, docs/PERFORMANCE.md). Returns stacked per-step
+        ``loss``/``metrics``/``monitors`` of leading dimension K.
+
+        Exactly K sequential ``train_step`` calls on the K slices:
+        params, optimizer state, BN buffers, the divergence guard's
+        rollbacks, and the monitors all match the step-by-step loop
+        (tests/test_scan_driver.py pins this across DataParallel, ZeRO
+        mode, and GANTrainer). The chunk itself is never donated — the
+        staging queue may still own its buffer."""
+        from tpu_syncbn.parallel import scan_driver
+
+        k = scan_driver.scan_length(batches)
+        if k < 1:
+            raise ValueError(f"stacked batch needs a leading axis >= 1, got {k}")
+        return self._run_scanned((k, True), batches)
 
     def _build_eval_step(self):
         def step(pstore, rest, batch):

@@ -299,7 +299,16 @@ def get_logger(name: str = "tpu_syncbn") -> logging.Logger:
     if name not in _loggers:
         logger = logging.getLogger(name)
         if not logger.handlers:
-            handler = logging.StreamHandler(sys.stdout)
+            # default stream is stdout (the reference's master-print
+            # console convention); TPU_SYNCBN_LOG_STREAM=stderr reroutes
+            # for callers whose stdout is a parsed result channel
+            # (bench.py sets it so its JSON line owns stdout)
+            stream = (
+                sys.stderr
+                if os.environ.get("TPU_SYNCBN_LOG_STREAM", "").lower()
+                == "stderr" else sys.stdout
+            )
+            handler = logging.StreamHandler(stream)
             handler.setFormatter(
                 logging.Formatter(
                     "%(asctime)s [%(levelname)s %(name)s] %(message)s",

@@ -448,6 +448,13 @@ class ResilientLoop:
       checkpoint-and-exit via the normal exception path).
     """
 
+    #: Bounded wait for async checkpoint writes while a training failure
+    #: is already propagating: long enough for any healthy write (the
+    #: 204MB bench payload serializes in ~1s), short enough that a
+    #: wedged writer (stuck filesystem) can't turn a StallError into an
+    #: indefinite hang with the watchdog already disarmed.
+    _EXC_FLUSH_TIMEOUT_S = 60.0
+
     def __init__(
         self,
         trainer,
@@ -458,26 +465,81 @@ class ResilientLoop:
         max_restores: int = 3,
         step_deadline_s: float | None = None,
         counters=None,
+        scan_steps: int = 1,
+        async_checkpoint: bool = False,
     ):
+        """``scan_steps=K > 1`` drives the fused multi-step path
+        (docs/PERFORMANCE.md): ``batches`` must then yield K-stacked
+        chunks (``data.device_prefetch(scan_steps=K)``) and the loop
+        calls ``trainer.train_steps_batches`` once per chunk — one host
+        dispatch per K steps, with preemption, checkpoint cadence, and
+        divergence policies honored at chunk boundaries (the on-device
+        guard still rolls back each bad step *inside* the chunk; the
+        host sees the chunk's stacked ``nonfinite`` metrics afterward).
+        ``step_deadline_s`` stays a per-STEP deadline: the loop arms its
+        watchdog at ``step_deadline_s * scan_steps`` since it can only
+        pat once per chunk.
+
+        ``async_checkpoint=True`` routes saves through
+        ``utils.checkpoint.AsyncCheckpointer``: the loop pays only the
+        state snapshot; serialization + manifest + atomic write happen
+        in a background thread, and the loop **flushes pending writes on
+        every exit path** — the PreemptionGuard boundary checkpoint is
+        durable before the process yields to SIGKILL."""
         if ckpt_every < 1:
             raise ValueError(f"ckpt_every must be >= 1, got {ckpt_every}")
+        if scan_steps < 1:
+            raise ValueError(f"scan_steps must be >= 1, got {scan_steps}")
         self.trainer = trainer
         self.ckpt_dir = ckpt_dir
         self.ckpt_every = ckpt_every
         self.keep = keep
         self.max_restores = max_restores
         self.step_deadline_s = step_deadline_s
+        self.scan_steps = scan_steps
         self.counters = counters if counters is not None else _default_counters()
         self.step = 0
+        self._async = None
+        if async_checkpoint:
+            from tpu_syncbn.utils.checkpoint import AsyncCheckpointer
+
+            self._async = AsyncCheckpointer(keep=keep)
         self._log = dist.get_logger("tpu_syncbn.resilience")
 
     # -- checkpoint plumbing ----------------------------------------------
+
+    def flush_checkpoints(self, timeout: float | None = None) -> bool:
+        """Block until async checkpoint writes (if any) are durable —
+        called on every ``run()`` exit path, and before any read of the
+        checkpoint directory (resume/restore), so a pending write can
+        neither be lost to an exit nor raced by a load. Returns False
+        when ``timeout`` expired with writes still in flight (the
+        directory must then NOT be trusted as current)."""
+        if self._async is not None:
+            return self._async.flush(timeout)
+        return True
+
+    def close(self) -> None:
+        """Flush and stop the async checkpoint worker (no-op without
+        ``async_checkpoint=True``). Idempotent; a loop the caller keeps
+        re-running can stay open, but one built per restart attempt
+        should be closed (or used as a context manager) so worker
+        threads don't accumulate."""
+        if self._async is not None:
+            self._async.close()
+
+    def __enter__(self) -> "ResilientLoop":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def resume(self) -> int:
         """Restore the newest verified checkpoint (if any); returns the
         step training should continue from."""
         from tpu_syncbn.parallel.trainer import resume_latest
 
+        self.flush_checkpoints()
         self.step = resume_latest(self.trainer, self.ckpt_dir)
         if self.step:
             self.counters.bump("resumes")
@@ -486,16 +548,23 @@ class ResilientLoop:
     def save(self) -> None:
         from tpu_syncbn.utils import checkpoint as ckpt
 
-        ckpt.save_checkpoint(
-            self.ckpt_dir, self.step, self.trainer.state_dict(),
-            keep=self.keep,
-        )
+        if self._async is not None:
+            self._async.save(
+                self.ckpt_dir, self.step, self.trainer.state_dict(),
+                keep=self.keep,
+            )
+        else:
+            ckpt.save_checkpoint(
+                self.ckpt_dir, self.step, self.trainer.state_dict(),
+                keep=self.keep,
+            )
         self.counters.bump("checkpoints")
 
     def _restore_last_good(self) -> None:
         from tpu_syncbn.parallel.trainer import resume_latest
         from tpu_syncbn.utils import checkpoint as ckpt
 
+        self.flush_checkpoints()
         if not ckpt.available_steps(self.ckpt_dir):
             # nothing durable yet (divergence before the first save):
             # there is no state to restore — but the on-device guard
@@ -530,73 +599,132 @@ class ResilientLoop:
     # -- the loop ---------------------------------------------------------
 
     def run(self, batches: Iterable, *, max_steps: int | None = None) -> dict:
-        """Drive ``trainer.train_step`` over ``batches`` with preemption,
-        divergence, and liveness handling. Returns a summary dict
-        (``steps``, ``preempted``, plus the counter snapshot)."""
-        policy = getattr(self.trainer, "divergence_guard", None)
-        preempted = False
-        with contextlib.ExitStack() as stack:
-            guard = stack.enter_context(PreemptionGuard())
-            watchdog = None
-            if self.step_deadline_s is not None:
-                # armed at the first pat: the first step's XLA compile
-                # legitimately dwarfs the steady-state deadline
-                watchdog = stack.enter_context(
-                    Watchdog(self.step_deadline_s, name="train-step",
-                             start_armed=False)
-                )
-            from tpu_syncbn.obs import stepstats
+        """Drive ``trainer.train_step`` over ``batches`` (or
+        ``trainer.train_steps_batches`` over K-stacked chunks when
+        ``scan_steps=K > 1``) with preemption, divergence, and liveness
+        handling. Returns a summary dict (``steps``, ``preempted``, plus
+        the counter snapshot).
 
-            steps_run = 0
-            # explicit next() so the wait-for-data seam is measurable:
-            # each blocking fetch is a "data_wait" span + histogram
-            # sample, each step a "step" span — the same seams bench.py
-            # instruments, so any loop's trace reads the same way
-            for batch in stepstats.instrumented_batches(batches):
-                if max_steps is not None and steps_run >= max_steps:
-                    break
-                with stepstats.timed_span("step", "step.time_s",
-                                          step=self.step + 1):
-                    out = self.trainer.train_step(batch)
-                self.step += 1
-                steps_run += 1
-                if watchdog is not None:
-                    watchdog.pat()
-                if policy is not None:
-                    nonfinite = float(out.metrics.get("nonfinite", 0.0))
-                    if nonfinite > 0:
-                        self.counters.bump("nonfinite_steps")
-                        if policy == "restore_last_good":
-                            if (self.counters.count("divergence_restores")
-                                    >= self.max_restores):
-                                raise FloatingPointError(
-                                    "divergence persisted through "
-                                    f"{self.max_restores} restore_last_good "
-                                    "recoveries — refusing to thrash"
-                                )
-                            self._restore_last_good()
-                            if guard.preempted:
-                                # the restored state IS the last durable
-                                # checkpoint — exit now rather than burn
-                                # grace-window time on another step
-                                preempted = True
-                                self._log.warning(
-                                    "preempted during divergence recovery "
-                                    "at step %d; state already durable; "
-                                    "exiting cleanly", self.step,
-                                )
-                                break
-                            continue
-                if guard.preempted:
-                    self.save()
-                    preempted = True
-                    self._log.warning(
-                        "preemption checkpoint written at step %d; exiting "
-                        "cleanly", self.step,
+        Chunked mode semantics (docs/PERFORMANCE.md): host policies fire
+        at chunk boundaries — a SIGTERM landing mid-chunk lets the
+        in-flight chunk finish (its K steps are one compiled program),
+        then checkpoints and exits; ``ckpt_every`` saves whenever the
+        step counter crosses a multiple; ``max_steps`` is checked before
+        each chunk, so a run may overshoot it by at most K-1 steps. Any
+        async checkpoint writes are flushed on every exit path."""
+        import numpy as _np
+
+        policy = getattr(self.trainer, "divergence_guard", None)
+        scanned = self.scan_steps > 1
+        preempted = False
+        try:
+            with contextlib.ExitStack() as stack:
+                guard = stack.enter_context(PreemptionGuard())
+                watchdog = None
+                if self.step_deadline_s is not None:
+                    # armed at the first pat: the first step's XLA compile
+                    # legitimately dwarfs the steady-state deadline.
+                    # Chunked mode pats once per K-step chunk, so the
+                    # per-STEP deadline the caller configured scales by K
+                    # — a healthy chunk must not read as a stall.
+                    watchdog = stack.enter_context(
+                        Watchdog(self.step_deadline_s * self.scan_steps,
+                                 name="train-step", start_armed=False)
                     )
-                    break
-                if self.step % self.ckpt_every == 0:
-                    self.save()
+                from tpu_syncbn.obs import stepstats
+
+                steps_run = 0
+                # explicit next() so the wait-for-data seam is measurable:
+                # each blocking fetch is a "data_wait" span + histogram
+                # sample, each step (or fused chunk) a span — the same
+                # seams bench.py instruments, so any loop's trace reads
+                # the same way
+                for batch in stepstats.instrumented_batches(batches):
+                    if max_steps is not None and steps_run >= max_steps:
+                        break
+                    if scanned:
+                        with stepstats.timed_span(
+                            "scan_chunk", "step.chunk_time_s",
+                            step=self.step + 1,
+                        ):
+                            out = self.trainer.train_steps_batches(batch)
+                        k = int(out.loss.shape[0])
+                    else:
+                        with stepstats.timed_span("step", "step.time_s",
+                                                  step=self.step + 1):
+                            out = self.trainer.train_step(batch)
+                        k = 1
+                    self.step += k
+                    steps_run += k
+                    if watchdog is not None:
+                        watchdog.pat()
+                    if policy is not None:
+                        # scalar for a single step, (K,)-stacked for a
+                        # chunk: the sum is the count of skipped steps
+                        nonfinite = int(_np.sum(_np.asarray(
+                            out.metrics.get("nonfinite", 0.0)
+                        )))
+                        if nonfinite > 0:
+                            self.counters.bump("nonfinite_steps", nonfinite)
+                            if policy == "restore_last_good":
+                                if (self.counters.count("divergence_restores")
+                                        >= self.max_restores):
+                                    raise FloatingPointError(
+                                        "divergence persisted through "
+                                        f"{self.max_restores} "
+                                        "restore_last_good recoveries — "
+                                        "refusing to thrash"
+                                    )
+                                self._restore_last_good()
+                                if guard.preempted:
+                                    # the restored state IS the last durable
+                                    # checkpoint — exit now rather than burn
+                                    # grace-window time on another step
+                                    preempted = True
+                                    self._log.warning(
+                                        "preempted during divergence "
+                                        "recovery at step %d; state already "
+                                        "durable; exiting cleanly", self.step,
+                                    )
+                                    break
+                                continue
+                    if guard.preempted:
+                        self.save()
+                        preempted = True
+                        self._log.warning(
+                            "preemption checkpoint written at step %d; "
+                            "exiting cleanly", self.step,
+                        )
+                        break
+                    if (self.step // self.ckpt_every
+                            != (self.step - k) // self.ckpt_every):
+                        self.save()
+        except BaseException:
+            # async writes still get their durability chance, but a
+            # flush failure must NOT replace the loop's primary failure
+            # (a FloatingPointError/StallError caller handler has to see
+            # its exception type), and a wedged writer must not convert
+            # it into an indefinite hang — bounded wait, log, propagate
+            try:
+                if not self.flush_checkpoints(
+                        timeout=self._EXC_FLUSH_TIMEOUT_S):
+                    self._log.error(
+                        "async checkpoint flush still pending after %.0fs "
+                        "while a training failure was propagating; "
+                        "abandoning the write (checkpoint directory may "
+                        "be stale)", self._EXC_FLUSH_TIMEOUT_S,
+                    )
+            except Exception:
+                self._log.exception(
+                    "async checkpoint flush failed while a training "
+                    "failure was already propagating"
+                )
+            raise
+        # async writes become durable before control leaves the loop — on
+        # the preemption path this runs inside the grace window, and a
+        # flush error DOES raise here: returning {'preempted': True}
+        # over a failed boundary write would claim durability it lacks
+        self.flush_checkpoints()
         return {
             "steps": steps_run,
             "step": self.step,

@@ -1,6 +1,7 @@
 """Utilities: checkpoint/resume (rank-0 writes), meters, profiler hooks."""
 
 from tpu_syncbn.utils.checkpoint import (
+    AsyncCheckpointer,
     CheckpointCorruptError,
     save_checkpoint,
     load_checkpoint,
@@ -8,6 +9,7 @@ from tpu_syncbn.utils.checkpoint import (
     verified_steps,
     verify_checkpoint,
     read_manifest,
+    snapshot_to_host,
 )
 from tpu_syncbn.utils.metrics import (
     AverageMeter,
@@ -24,7 +26,9 @@ __all__ = [
     "evaluate_detections",
     "frechet_distance",
     "gaussian_stats",
+    "AsyncCheckpointer",
     "CheckpointCorruptError",
+    "snapshot_to_host",
     "save_checkpoint",
     "load_checkpoint",
     "available_steps",

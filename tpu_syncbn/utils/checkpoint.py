@@ -253,9 +253,18 @@ def save_checkpoint(
 def _save_checkpoint_impl(
     directory: str, step: int, tree: Any, *, keep: int
 ) -> str:
-    os.makedirs(directory, exist_ok=True)
     # nnx State → pure dicts, then one batched device→host fetch
     host_tree = jax.device_get(_purify(tree))
+    return _write_host_tree(directory, step, host_tree, keep=keep)
+
+
+def _write_host_tree(
+    directory: str, step: int, host_tree: Any, *, keep: int
+) -> str:
+    """Serialize + certify an already-host-resident pure tree — the
+    write half shared by the synchronous path and the
+    :class:`AsyncCheckpointer` background thread."""
+    os.makedirs(directory, exist_ok=True)
     data = serialization.to_bytes(host_tree)
     _atomic_write(directory, _path(directory, step), data)
     manifest = {
@@ -458,6 +467,183 @@ def _read_with_retry(path: str, attempts: int = 5, delay: float = 0.2) -> bytes:
                 raise
             time.sleep(delay * (2**i))
     raise AssertionError("unreachable")
+
+
+def snapshot_to_host(tree: Any) -> Any:
+    """Copy-before-donate snapshot: fetch ``tree`` to host memory as
+    pure dicts with every leaf an *owned* numpy copy.
+
+    The owning copy matters twice over: (1) the caller's next donated
+    train step invalidates the device buffers the snapshot came from;
+    (2) on the CPU backend ``jax.device_get`` can return **zero-copy
+    views** whose storage a donated step recycles in place — a snapshot
+    that merely referenced them would be silently overwritten while the
+    background writer serializes it (the corruption
+    :class:`AsyncCheckpointer` exists to avoid paying for
+    synchronously). Leaves ``device_get`` already materialized as
+    numpy-owned arrays (the TPU/GPU case) are kept as-is — no second
+    full-state copy on the hot path."""
+    import numpy as np
+
+    def own(x):
+        if (isinstance(x, np.ndarray) and x.base is None
+                and x.flags["OWNDATA"]):
+            return x  # numpy allocated this buffer: nothing can recycle it
+        return np.array(x, copy=True) if hasattr(x, "__array__") else x
+
+    return jax.tree_util.tree_map(own, jax.device_get(_purify(tree)))
+
+
+class AsyncCheckpointer:
+    """Checkpoint writes off the training hot path
+    (docs/PERFORMANCE.md).
+
+    ``save()`` runs the *snapshot* synchronously — one batched
+    device→host fetch into owned copies (:func:`snapshot_to_host`, the
+    copy-before-donate contract) — then hands serialization, the
+    integrity manifest (PR 1: sum64/CRC32/tree hash, byte-identical to
+    the synchronous path's), the atomic writes, and pruning to ONE
+    background thread. The step loop pays the fetch and nothing else:
+    steady-state step time stays flat across saves (bench.py's
+    ``recovery`` block tracks ``ckpt_async_enqueue_s`` vs the full
+    synchronous round-trip).
+
+    Ordering and durability:
+
+    * writes are processed strictly in ``save()`` order by a single
+      worker — manifests certify in submission order, so the
+      newest-VERIFIED resume walk (``load_checkpoint``) never sees an
+      out-of-order certification;
+    * ``max_pending`` bounds host memory (each pending write holds one
+      full state snapshot); a ``save()`` past the bound *blocks* until
+      the writer drains — backpressure, never silent dropping;
+    * ``flush()`` blocks until everything submitted is durable —
+      ``runtime.resilience.ResilientLoop`` flushes on EVERY exit path
+      (preemption included), so a SIGTERM landing between submit and
+      write cannot lose the boundary checkpoint;
+    * a background write failure is re-raised at the next ``save()`` or
+      ``flush()`` — an async fault must not be a silent one.
+
+    Master-host-only like :func:`save_checkpoint` (other hosts' saves
+    are cheap no-ops). Telemetry: ``checkpoint.async_saves`` counter,
+    ``checkpoint.async_snapshot_s`` (what the loop actually pays) and
+    the shared ``checkpoint.save_s`` (background write latency).
+    """
+
+    def __init__(self, *, keep: int = 3, max_pending: int = 2):
+        import queue
+        import threading
+
+        if max_pending < 1:
+            raise ValueError(f"max_pending must be >= 1, got {max_pending}")
+        self.keep = keep
+        self._queue: Any = queue.Queue(maxsize=max_pending)
+        self._errors: list[BaseException] = []
+        self._cond = threading.Condition()
+        self._pending = 0  # incremented BEFORE enqueue: a flush() that
+        # follows a save() can never miss the write in a handoff window
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="async-checkpointer", daemon=True
+        )
+        self._thread.start()
+
+    # -- worker ------------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            directory, step, host_tree, keep = item
+            t0 = time.perf_counter()
+            try:
+                with tracing.span("checkpoint_save", step=int(step),
+                                  mode="async"):
+                    _write_host_tree(directory, step, host_tree, keep=keep)
+                telemetry.observe(
+                    "checkpoint.save_s", time.perf_counter() - t0
+                )
+                telemetry.count("checkpoint.saves")
+            except BaseException as e:  # surface at next save()/flush()
+                with self._cond:
+                    self._errors.append(e)
+            finally:
+                with self._cond:
+                    self._pending -= 1
+                    self._cond.notify_all()
+
+    def _raise_pending_error(self) -> None:
+        with self._cond:
+            err = self._errors.pop(0) if self._errors else None
+        if err is not None:
+            raise RuntimeError(
+                "async checkpoint write failed in the background"
+            ) from err
+
+    # -- public API --------------------------------------------------------
+
+    @property
+    def pending(self) -> int:
+        """Writes submitted but not yet durable."""
+        with self._cond:
+            return self._pending
+
+    def save(self, directory: str, step: int, tree: Any,
+             *, keep: int | None = None) -> None:
+        """Snapshot ``tree`` now (copy-before-donate) and schedule the
+        serialized + certified write. Blocks only for the snapshot —
+        and for backpressure when ``max_pending`` writes are already
+        queued. Raises any error a previous background write hit."""
+        self._raise_pending_error()
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        if not dist.is_master():
+            return
+        t0 = time.perf_counter()
+        host_tree = snapshot_to_host(tree)
+        telemetry.observe(
+            "checkpoint.async_snapshot_s", time.perf_counter() - t0
+        )
+        telemetry.count("checkpoint.async_saves")
+        with self._cond:
+            self._pending += 1
+        # enqueue OUTSIDE the condition: a bounded-queue put may block on
+        # backpressure, and the worker needs the condition to drain
+        self._queue.put((directory, int(step), host_tree,
+                         self.keep if keep is None else keep))
+
+    def flush(self, timeout: float | None = None) -> bool:
+        """Block until every submitted write is durable (or ``timeout``
+        seconds pass — returns False on timeout). Re-raises background
+        write errors."""
+        with self._cond:
+            done = self._cond.wait_for(lambda: self._pending == 0, timeout)
+        self._raise_pending_error()
+        return done
+
+    def close(self, timeout: float | None = None) -> None:
+        """Flush, then stop the worker thread. Idempotent. If the flush
+        times out (worker wedged on a hung write) the sentinel is
+        offered without blocking — honoring the caller's bound — and
+        the daemon worker is left to die with the process."""
+        import queue
+
+        if self._closed:
+            return
+        self.flush(timeout)
+        self._closed = True
+        try:
+            self._queue.put_nowait(None)
+        except queue.Full:
+            return  # wedged mid-write with a full queue: see docstring
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def _read_manifest_with_retry(

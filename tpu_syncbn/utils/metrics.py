@@ -76,7 +76,9 @@ class EventCounter(CounterGroup):
     """Deprecated alias for :class:`tpu_syncbn.obs.telemetry.CounterGroup`
     — the PR-1 name for monotonic fault/recovery event counters, kept so
     existing call sites (and checkpointed configs) don't break. New code
-    should construct ``obs.telemetry.CounterGroup(prefix)`` directly.
+    should construct ``obs.telemetry.CounterGroup(prefix)`` directly;
+    constructing this alias emits a ``DeprecationWarning`` (no in-repo
+    code constructs it anymore — only its own tests do).
 
     The instance-local bump/count/summary surface is identical; as a
     CounterGroup with ``prefix="events"``, bumps additionally mirror into
@@ -85,6 +87,14 @@ class EventCounter(CounterGroup):
     (docs/OBSERVABILITY.md)."""
 
     def __init__(self):
+        import warnings
+
+        warnings.warn(
+            "tpu_syncbn.utils.EventCounter is deprecated; use "
+            "tpu_syncbn.obs.telemetry.CounterGroup instead",
+            DeprecationWarning,
+            stacklevel=2,
+        )
         super().__init__(prefix="events")
 
     def __repr__(self):
