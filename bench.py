@@ -82,10 +82,34 @@ def _host_load() -> float | None:
         return None
 
 
-def _vs_baseline(backend: str) -> float | None:
-    """The TPU measurement defines the baseline (ratio 1.0); any fallback
-    backend reports null so a CPU line can never read as a baseline ratio
-    for the tracked hardware metric (BASELINE.json img/s/chip)."""
+_BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BASELINE.json"
+)
+
+
+def _vs_baseline(
+    backend: str, metric: str | None = None, value: float | None = None,
+    baseline_path: str = _BASELINE_PATH,
+) -> float | None:
+    """Ratio of this run's ``value`` to the published baseline for
+    ``metric`` in BASELINE.json's ``published`` map (entries are either
+    a bare number or ``{"value": N, ...}``). With no published entry for
+    the metric key, fall back to the historical convention: the TPU
+    measurement defines the baseline (ratio 1.0); any fallback backend
+    reports null so a CPU line can never read as a baseline ratio for
+    the tracked hardware metric."""
+    if metric is not None and value is not None:
+        try:
+            with open(baseline_path) as f:
+                published = json.load(f).get("published", {})
+            base = published.get(metric)
+            if isinstance(base, dict):
+                base = base.get("value")
+            if isinstance(base, (int, float)) and not isinstance(base, bool) \
+                    and base > 0:
+                return round(float(value) / float(base), 4)
+        except (OSError, json.JSONDecodeError, TypeError, ValueError) as e:
+            log(f"BASELINE.json unusable for vs_baseline: {e}")
     return 1.0 if backend == "tpu" else None
 
 
@@ -477,7 +501,127 @@ def measure_recovery(dp, *, repeats: int = 3) -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
-def main(trace_path: str | None = None, scan: int = 1):
+def measure_serve(dp, batch, *, n_chips: int) -> dict:
+    """The ``serve`` block of the bench line: a closed-loop offered-load
+    sweep against the dynamic-batching inference engine
+    (``tpu_syncbn.serve``), on the SAME trained state the throughput
+    number used.
+
+    Each level runs ``clients`` closed-loop client threads (every client
+    submits a single-example request, blocks on its future, repeats), so
+    offered load is set by the client count, not a timer. Two levels:
+
+    * ``clients=1`` — the latency floor: every batch is one item, the
+      p50 is pure engine time + admission wait;
+    * ``clients = 2 * max_batch`` — saturating load: the queue stays
+      deeper than a full batch, so the dispatch-when-full admission path
+      dominates and the batch-fill ratio must approach 1.0 (the ≥0.9
+      acceptance bound).
+
+    The engine is warmed (all buckets AOT-compiled) before the timed
+    sweep — compile time is reported separately (``warm_compile_s``),
+    never inside a latency percentile. Headline fields are the
+    saturating level's; the per-level breakdown rides in ``levels``.
+    Schema pinned by tests/test_bench_tooling.py."""
+    import threading
+
+    import numpy as np
+
+    from tpu_syncbn import serve as serve_lib
+
+    x = np.asarray(batch[0] if isinstance(batch, (tuple, list)) else batch)
+    gb = x.shape[0]
+    # serve-side batch: capped at 16 so the client thread count (2x) and
+    # request totals stay sane on any backend; bucket floor is one item
+    # per chip (buckets must shard evenly over the data axis)
+    max_batch = max(n_chips, min(gb, 16))
+    buckets = tuple(sorted({max(n_chips, max_batch // 2), max_batch}))
+    engine = serve_lib.InferenceEngine.from_trainer(dp, buckets=buckets)
+    max_batch = engine.max_bucket  # post-normalization (world multiples)
+    max_wait_ms = 50.0
+
+    t0 = time.perf_counter()
+    engine.warm(x[:1])
+    warm_s = time.perf_counter() - t0
+
+    levels_out = []
+    rejected_total = 0
+    bat = None
+    for clients in (1, 2 * max_batch):
+        # fresh batcher per level: its CounterGroup is the level's
+        # fill-ratio measurement
+        bat = serve_lib.DynamicBatcher(
+            engine, max_batch=max_batch, max_wait_ms=max_wait_ms,
+            max_queue=4 * max_batch,
+        )
+        # saturating level gets enough traffic that start/tail partial
+        # batches can't drag aggregate fill below the bound
+        per_client = 8 if clients > 1 else 2 * max_batch
+        latencies: list[float] = []
+        lat_lock = threading.Lock()
+
+        def client(cid, batcher=bat, per_client=per_client):
+            rng = np.random.RandomState(cid)
+            local = []
+            for _ in range(per_client):
+                i = int(rng.randint(0, gb))
+                t_req = time.perf_counter()
+                try:
+                    batcher.submit(x[i:i + 1]).result(timeout=600)
+                except serve_lib.RejectedError:
+                    continue  # shed — counted by the batcher
+                local.append(time.perf_counter() - t_req)
+            with lat_lock:
+                latencies.extend(local)
+
+        threads = [threading.Thread(target=client, args=(c,), daemon=True)
+                   for c in range(clients)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        bat.close(drain=True)
+        fill = bat.fill_ratio
+        rejected_total += bat.counters.count("rejected")
+        levels_out.append({
+            "clients": clients,
+            "requests": len(latencies),
+            "throughput_rps": round(len(latencies) / wall, 2) if wall else None,
+            "latency_p50_ms": round(
+                float(np.percentile(latencies, 50)) * 1e3, 3),
+            "latency_p99_ms": round(
+                float(np.percentile(latencies, 99)) * 1e3, 3),
+            "fill_ratio": round(fill, 4) if fill is not None else None,
+        })
+        log(f"serve clients={clients}: "
+            f"{levels_out[-1]['throughput_rps']} req/s, "
+            f"p50 {levels_out[-1]['latency_p50_ms']} ms, "
+            f"p99 {levels_out[-1]['latency_p99_ms']} ms, "
+            f"fill {levels_out[-1]['fill_ratio']}")
+    sat = levels_out[-1]
+    stats = engine.stats()
+    return {
+        "buckets": stats["buckets"],
+        "max_batch": max_batch,
+        "max_wait_ms": max_wait_ms,
+        "warm_compile_s": round(warm_s, 2),
+        "levels": levels_out,
+        # headline = the saturating level
+        "clients": sat["clients"],
+        "requests": sat["requests"],
+        "rejected": rejected_total,
+        "throughput_rps": sat["throughput_rps"],
+        "latency_p50_ms": sat["latency_p50_ms"],
+        "latency_p99_ms": sat["latency_p99_ms"],
+        "fill_ratio": sat["fill_ratio"],
+        "buckets_compiled": stats["programs_compiled"],
+        "drained": bat.drained,
+    }
+
+
+def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
     """``trace_path`` (the ``--trace`` flag) writes a Chrome trace-event
     JSON of the run — data-wait/step/checkpoint spans — that loads
     directly in Perfetto (docs/OBSERVABILITY.md). Telemetry is force-
@@ -493,7 +637,11 @@ def main(trace_path: str | None = None, scan: int = 1):
     stepstats histogram / wall) — the per-step host overhead a fused
     chunk divides by K. The per-step loop's fraction is always reported
     as ``host_gap_frac_scan1``, so one ``--scan K`` line carries its own
-    baseline and the win is a tracked number."""
+    baseline and the win is a tracked number.
+
+    ``serve`` (the ``--serve`` flag) additionally runs the
+    dynamic-batching inference sweep (:func:`measure_serve`) on the
+    trained state and attaches the schema-pinned ``serve`` block."""
     from tpu_syncbn.obs import stepstats, telemetry, tracing
 
     telemetry.set_enabled(True)
@@ -655,6 +803,18 @@ def main(trace_path: str | None = None, scan: int = 1):
         log(f"recovery measurement failed: {type(e).__name__}: {e}")
         recovery = None
 
+    # dynamic-batching inference sweep (docs/PERFORMANCE.md "Serving"),
+    # on the same trained state — opt-in (--serve): it compiles its own
+    # eval programs, which a pure training benchmark shouldn't pay for
+    serve_info = None
+    if serve:
+        try:
+            with stepstats.timed_span("serve_bench", "bench.serve_s"):
+                serve_info = measure_serve(dp, batch, n_chips=n_chips)
+        except Exception as e:  # the primary throughput line still ships
+            log(f"serve measurement failed: {type(e).__name__}: {e}")
+            serve_info = None
+
     mfu = None
     peak, peak_source = (_peak_flops(jax.devices()[0], backend)
                          if on_accel else (None, None))
@@ -667,7 +827,10 @@ def main(trace_path: str | None = None, scan: int = 1):
         "metric": "resnet50_syncbn_dp_train_throughput",
         "value": round(img_per_sec_per_chip, 2),
         "unit": "img/s/chip",
-        "vs_baseline": _vs_baseline(backend),
+        "vs_baseline": _vs_baseline(
+            backend, "resnet50_syncbn_dp_train_throughput",
+            img_per_sec_per_chip,
+        ),
         "backend": backend,
         "bn_backend": bn_backend,
         "chips": n_chips,
@@ -696,6 +859,11 @@ def main(trace_path: str | None = None, scan: int = 1):
         # (host_gap_frac_scan1) and, with --scan K, the fused loop
         # (host_gap_frac); schema pinned by tests/test_bench_tooling.py
         "scan": scan_info,
+        # docs/PERFORMANCE.md "Serving": the --serve closed-loop
+        # offered-load sweep (throughput, p50/p99 latency, batch-fill
+        # ratio, compiled-bucket count); null without --serve; schema
+        # pinned by tests/test_bench_tooling.py
+        "serve": serve_info,
         # a fallback line is a liveness smoke signal, not a measurement
         # of anything the project tracks — cross-round diffs of it are
         # meaningless and tagged as such
@@ -750,4 +918,4 @@ if __name__ == "__main__":
                 raise SystemExit("--scan requires an integer chunk size")
             if scan < 1:
                 raise SystemExit("--scan chunk size must be >= 1")
-        main(trace_path=trace, scan=scan)
+        main(trace_path=trace, scan=scan, serve="--serve" in argv)

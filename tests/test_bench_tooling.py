@@ -166,6 +166,52 @@ class TestBenchSemantics:
         assert mod._vs_baseline("cpu") is None
         assert mod._vs_baseline("METAL") is None
 
+    def test_vs_baseline_reads_published_entry(self, tmp_path):
+        """ISSUE 5 satellite: with a published baseline for the metric
+        key in BASELINE.json, vs_baseline is the measured/published
+        ratio — on any backend (a published number is a real anchor,
+        unlike the TPU-defines-itself convention)."""
+        mod = _load_bench()
+        p = str(tmp_path / "BASELINE.json")
+        with open(p, "w") as f:
+            json.dump({"published": {
+                "m_bare": 200.0,
+                "m_dict": {"value": 50.0, "source": "paper table 3"},
+            }}, f)
+        assert mod._vs_baseline("tpu", "m_bare", 100.0,
+                                baseline_path=p) == 0.5
+        assert mod._vs_baseline("cpu", "m_dict", 100.0,
+                                baseline_path=p) == 2.0
+        # a measured 0.0 against a published anchor is a real ratio
+        # (flags the regression) — not a fall-through to the historical
+        # tpu-defines-itself convention
+        assert mod._vs_baseline("tpu", "m_bare", 0.0,
+                                baseline_path=p) == 0.0
+
+    def test_vs_baseline_falls_back_without_matching_entry(self, tmp_path):
+        mod = _load_bench()
+        p = str(tmp_path / "BASELINE.json")
+        with open(p, "w") as f:
+            json.dump({"published": {"other_metric": 1.0}}, f)
+        # no matching key / unusable values -> historical convention
+        assert mod._vs_baseline("tpu", "m", 100.0, baseline_path=p) == 1.0
+        assert mod._vs_baseline("cpu", "m", 100.0, baseline_path=p) is None
+        with open(p, "w") as f:
+            json.dump({"published": {"m": 0.0}}, f)  # degenerate baseline
+        assert mod._vs_baseline("cpu", "m", 100.0, baseline_path=p) is None
+        with open(p, "w") as f:
+            f.write('{"trunc')  # corrupt file is loud-logged, never fatal
+        assert mod._vs_baseline("tpu", "m", 100.0, baseline_path=p) == 1.0
+
+    def test_repo_baseline_has_no_usable_entry_yet(self):
+        """The in-repo BASELINE.json publishes no numbers (the reference
+        publishes none) — the shipped line's ratio must keep the
+        historical semantics until a published entry lands."""
+        mod = _load_bench()
+        assert mod._vs_baseline(
+            "cpu", "resnet50_syncbn_dp_train_throughput", 123.0
+        ) is None
+
 
 class TestBenchCompilePrewarm:
     """The bench_compile stage exists so the first TPU window lands the
@@ -503,6 +549,8 @@ class TestTelemetryBlock:
         # the scan block is always present (k=1 default: the per-step
         # loop IS the measurement) with the pinned field set
         self._validate_scan_block(line["scan"], k=1)
+        # the serve block is null unless --serve ran the sweep
+        assert line["serve"] is None
         # the --trace file is valid Chrome trace JSON with the three
         # span families a step loop produces
         events = tracing.validate_trace(tracing.load_trace(trace))
@@ -579,6 +627,71 @@ class TestTelemetryBlock:
         assert proc.returncode != 0
         assert "--trace requires a path" in proc.stderr
 
+
+@pytest.mark.serve
+class TestServeBlock:
+    """bench's `serve` block (ISSUE 5): the schema the serving
+    trajectory is read through, plus a CPU smoke of the full
+    `--serve` closed-loop sweep on a stand-in program."""
+
+    _tiny_build = TestTelemetryBlock._tiny_build
+
+    @staticmethod
+    def _validate_serve_block(block):
+        """The schema-pinned `serve` block: drift here breaks the
+        throughput/latency trajectory across rounds."""
+        assert set(block) == {
+            "buckets", "max_batch", "max_wait_ms", "warm_compile_s",
+            "levels", "clients", "requests", "rejected",
+            "throughput_rps", "latency_p50_ms", "latency_p99_ms",
+            "fill_ratio", "buckets_compiled", "drained",
+        }
+        assert isinstance(block["buckets"], list) and block["buckets"]
+        assert all(isinstance(b, int) and b >= 1 for b in block["buckets"])
+        assert isinstance(block["levels"], list) and len(block["levels"]) >= 2
+        for lvl in block["levels"]:
+            assert set(lvl) == {
+                "clients", "requests", "throughput_rps",
+                "latency_p50_ms", "latency_p99_ms", "fill_ratio",
+            }
+            assert lvl["requests"] >= 1
+            assert lvl["throughput_rps"] > 0
+            assert 0 < lvl["latency_p50_ms"] <= lvl["latency_p99_ms"]
+        # acceptance bounds: nonzero throughput, p50/p99 samples,
+        # saturating fill >= 0.9, bounded compiled-program count
+        assert block["throughput_rps"] > 0
+        assert block["latency_p50_ms"] > 0
+        assert block["latency_p99_ms"] >= block["latency_p50_ms"]
+        assert block["fill_ratio"] >= 0.9
+        assert 1 <= block["buckets_compiled"] <= 4
+        assert block["rejected"] >= 0
+        assert block["drained"] is True
+
+    def test_serve_flag_emits_block_and_line_stays_last(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        from tpu_syncbn.obs import telemetry, tracing
+
+        bench = _load_bench()
+        monkeypatch.setenv("TPU_SYNCBN_FORCE_CPU", "1")
+        monkeypatch.setenv("BENCH_STEPS", "3")
+        monkeypatch.setattr(bench, "build_program", self._tiny_build())
+        telemetry.REGISTRY.reset()
+        try:
+            bench.main(serve=True)
+        finally:
+            telemetry.set_enabled(None)
+            telemetry.REGISTRY.reset()
+            tracing.uninstall()
+        out_lines = capsys.readouterr().out.strip().splitlines()
+        # the JSON result line remains the last stdout line (drivers
+        # parse the tail); the sweep's own chatter goes to stderr
+        line = json.loads(out_lines[-1])
+        self._validate_serve_block(line["serve"])
+        # serve activity rides the same telemetry block as everything
+        tel = telemetry.validate_snapshot(line["telemetry"])
+        assert tel["histograms"]["serve.latency_s"]["count"] >= 1
+        assert tel["counters"]["serve.compiles"] >= 1
 
 class TestRecoveryBlock:
     """bench's `recovery` block: the robustness-cost measurement that

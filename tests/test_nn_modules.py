@@ -103,6 +103,48 @@ def test_convert_sync_batchnorm_tree_rewrite():
     assert m.bn.axis_name == "data"
 
 
+def test_converted_model_propagates_eval_mode(rng_x=None):
+    """Regression (serving contract, ISSUE 5 satellite): on a
+    convert_sync_batchnorm-produced tree, nnx's ``model.eval()`` /
+    ``model.train()`` must reach every *converted* submodule — attr,
+    list, dict, and tuple containers alike — flipping
+    ``use_running_average`` so eval normalizes with running stats
+    (collective-free) and train goes back to batch stats. A converted
+    module that missed the flip would silently serve batch-statistics
+    normalization."""
+    import collections
+
+    Pair = collections.namedtuple("Pair", ["one", "two"])
+
+    class Mixed(nnx.Module):
+        def __init__(self):
+            self.tower = _Tower()  # attr + list + dict containers
+            self.pair = Pair(tnn.BatchNorm1d(C),
+                             nnx.Linear(C, C, rngs=nnx.Rngs(1)))
+
+    m = tnn.convert_sync_batchnorm(Mixed())
+    bns = [m.tower.bn, *m.tower.blocks, m.tower.named["head"], m.pair.one]
+    assert all(isinstance(b, tnn.SyncBatchNorm) for b in bns)
+    assert all(not b.use_running_average for b in bns)
+
+    # accumulate one batch of stats, then flip to eval
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 5, 5, C).astype(np.float32))
+    m.tower(x)
+    m.eval()
+    assert all(b.use_running_average for b in bns)
+    nbt = int(m.tower.bn.num_batches_tracked[...])
+    y1 = m.tower(x)
+    y2 = m.tower(x)
+    # eval forward is deterministic and mutates nothing
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+    assert int(m.tower.bn.num_batches_tracked[...]) == nbt
+
+    m.train()
+    assert all(not b.use_running_average for b in bns)
+    m.tower(x)  # train mode tracks again
+    assert int(m.tower.bn.num_batches_tracked[...]) == nbt + 1
+
+
 def test_convert_root_batchnorm():
     bn = tnn.BatchNorm2d(C, momentum=0.3, eps=1e-4)
     out = tnn.convert_sync_batchnorm(bn, axis_name="replica")

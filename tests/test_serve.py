@@ -1,0 +1,433 @@
+"""The serving subsystem (tpu_syncbn.serve): bucketed AOT inference
+engine semantics (padding parity, bucket normalization, FIFO program
+retention, ZeRO unshard restore) and dynamic-batcher semantics
+(coalescing admission, max_wait dispatch, backpressure rejection,
+graceful drain wired to PreemptionGuard, close modes), plus the serve
+telemetry wiring.
+
+Reference parity note: the torch recipe is training-only (a 104-line
+README) — serving is entirely OUR capability surface (ROADMAP north
+star: "serves heavy traffic"), so its contracts are pinned directly.
+
+Engine tests run on the 8-virtual-device CPU mesh (conftest), so the
+batch really shards over the data axis; pure queueing-logic tests drive
+the batcher with a duck-typed stub engine, keeping them fast and
+deterministic.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import nnx
+
+from tpu_syncbn import nn as tnn, parallel, serve
+from tpu_syncbn.obs import telemetry, tracing
+from tpu_syncbn.parallel import scan_driver
+from tpu_syncbn.runtime import resilience
+
+pytestmark = pytest.mark.serve
+
+WORLD = 8  # conftest's virtual device count
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """The established obs reset pattern (tests/test_obs.py): every
+    serve test starts and ends with telemetry at its env default, an
+    empty process registry, and no installed tracer."""
+    telemetry.set_enabled(None)
+    telemetry.REGISTRY.reset()
+    tracing.uninstall()
+    yield
+    telemetry.set_enabled(None)
+    telemetry.REGISTRY.reset()
+    tracing.uninstall()
+
+
+class Net(nnx.Module):
+    def __init__(self, rngs):
+        self.fc = nnx.Linear(4, 6, rngs=rngs)
+        self.bn = tnn.BatchNorm1d(6)
+
+    def __call__(self, x):
+        return self.bn(self.fc(x))
+
+
+def _sq_loss(m, b):
+    return (m(b) ** 2).mean()
+
+
+def _trained_dp(*, zero=False, steps=3, opt=None):
+    model = tnn.convert_sync_batchnorm(Net(nnx.Rngs(0)))
+    dp = parallel.DataParallel(
+        model, opt if opt is not None else optax.sgd(0.05), _sq_loss,
+        zero=zero,
+    )
+    for s in range(steps):
+        dp.train_step(jnp.asarray(
+            np.random.RandomState(s).randn(16, 4).astype(np.float32)
+        ))
+    return dp
+
+
+def _x(n, seed=9):
+    return np.random.RandomState(seed).randn(n, 4).astype(np.float32)
+
+
+# ------------------------------------------------------------------ engine
+
+
+class TestInferenceEngine:
+    def test_predict_matches_local_eval_through_padding(self):
+        """Pad-to-bucket + shard over the data axis + slice must be
+        invisible: the output equals the plain local eval forward on
+        the SAME running stats, for sizes below/at/between buckets."""
+        dp = _trained_dp()
+        eng = serve.InferenceEngine.from_trainer(dp, buckets=(8, 16))
+        m = dp.sync_to_model()
+        m.eval()
+        for n in (1, 5, 8, 11, 16):
+            x = _x(n, seed=n)
+            out = eng.predict(x)
+            ref = np.asarray(m(jnp.asarray(x)))
+            assert out.shape == ref.shape
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_engine_is_eval_mode_and_never_mutates_stats(self):
+        dp = _trained_dp()
+        eng = serve.InferenceEngine.from_trainer(dp, buckets=(8,))
+        m = dp.sync_to_model()
+        assert m.bn.use_running_average  # engine flipped the model
+        before = np.asarray(m.bn.running_mean[...])
+        nbt = int(m.bn.num_batches_tracked[...])
+        out1 = eng.predict(_x(8))
+        out2 = eng.predict(_x(8))
+        np.testing.assert_array_equal(out1, out2)
+        np.testing.assert_array_equal(
+            np.asarray(m.bn.running_mean[...]), before
+        )
+        assert int(m.bn.num_batches_tracked[...]) == nbt
+
+    def test_bucket_sizes_normalize_to_world_multiples(self):
+        dp = _trained_dp()
+        eng = serve.InferenceEngine.from_trainer(dp, buckets=(3, 8, 8, 13))
+        assert eng.buckets == (8, 16)  # rounded up, deduped, sorted
+        assert eng.bucket_for(1) == 8
+        assert eng.bucket_for(9) == 16
+        with pytest.raises(ValueError, match="exceeds the largest bucket"):
+            eng.bucket_for(17)
+        with pytest.raises(ValueError, match="bucket"):
+            serve.InferenceEngine.from_trainer(dp, buckets=())
+
+    def test_oversize_batch_chunks_through_max_bucket(self):
+        dp = _trained_dp()
+        eng = serve.InferenceEngine.from_trainer(dp, buckets=(8,))
+        m = dp.sync_to_model()
+        m.eval()
+        x = _x(21)  # 8 + 8 + 5
+        np.testing.assert_allclose(
+            eng.predict(x), np.asarray(m(jnp.asarray(x))),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_program_retention_is_fifo_bounded(self):
+        """Pathological shape traffic cannot grow the compiled-program
+        set beyond scan_driver.MAX_CACHED_PROGRAMS (the training caches'
+        bound, reused)."""
+        dp = _trained_dp()
+        buckets = tuple(8 * (i + 1) for i in range(6))
+        eng = serve.InferenceEngine.from_trainer(dp, buckets=buckets)
+        for b in buckets:
+            eng.predict(_x(b))
+        stats = eng.stats()
+        assert stats["programs_compiled"] == 6
+        assert stats["programs_live"] <= scan_driver.MAX_CACHED_PROGRAMS
+        # evicted bucket recompiles (FIFO, not an error) and still works
+        out = eng.predict(_x(8))
+        assert eng.stats()["programs_compiled"] == 7
+        assert out.shape == (8, 6)
+
+    def test_warm_compiles_all_buckets_ahead_of_traffic(self):
+        dp = _trained_dp()
+        eng = serve.InferenceEngine.from_trainer(dp, buckets=(8, 16))
+        eng.warm(_x(1))
+        assert eng.stats()["programs_compiled"] == 2
+        eng.predict(_x(5))
+        eng.predict(_x(12))
+        assert eng.stats()["programs_compiled"] == 2  # traffic = cache hits
+
+    def test_from_zero_trainer_unshards_params(self):
+        """The restore path out of the ZeRO training layout
+        (parallel.zero.unshard_params): an engine built from a
+        zero=True trainer serves bit-identically to one built from the
+        replicated trainer with the same training history."""
+        outs = {}
+        for zero in (False, True):
+            dp = _trained_dp(zero=zero, opt=optax.adam(1e-2))
+            eng = serve.InferenceEngine.from_trainer(dp, buckets=(8,))
+            outs[zero] = eng.predict(_x(6))
+        np.testing.assert_array_equal(outs[False], outs[True])
+
+    def test_mismatched_leading_axes_rejected(self):
+        dp = _trained_dp()
+        eng = serve.InferenceEngine.from_trainer(dp, buckets=(8,))
+        with pytest.raises(ValueError, match="leading"):
+            eng.predict({"a": _x(4), "b": _x(5)})
+
+
+# ----------------------------------------------------------------- batcher
+
+
+class StubEngine:
+    """Duck-typed engine for pure queueing-logic tests: bucket = fixed
+    size, predict doubles the payload after an optional delay."""
+
+    def __init__(self, bucket=4, delay=0.0):
+        self.max_bucket = bucket
+        self._delay = delay
+        self.calls: list[int] = []
+
+    def bucket_for(self, n):
+        if n > self.max_bucket:
+            raise ValueError(f"batch of {n} exceeds bucket {self.max_bucket}")
+        return self.max_bucket
+
+    def predict(self, b):
+        self.calls.append(int(np.shape(b)[0]))
+        if self._delay:
+            time.sleep(self._delay)
+        return np.asarray(b) * 2.0
+
+
+def _item(v, n=1):
+    return np.full((n, 1), v, np.float32)
+
+
+class TestDynamicBatcher:
+    def test_requests_coalesce_and_each_gets_its_slice(self):
+        eng = StubEngine(bucket=4)
+        with serve.DynamicBatcher(eng, max_batch=4, max_wait_ms=100,
+                                  max_queue=32) as bat:
+            futs = [bat.submit(_item(i)) for i in range(8)]
+            res = [f.result(timeout=10) for f in futs]
+        for i, r in enumerate(res):
+            assert float(r[0, 0]) == 2.0 * i
+        assert bat.counters.count("requests") == 8
+        assert bat.counters.count("items") == 8
+        # coalesced: far fewer engine calls than requests
+        assert bat.counters.count("batches") <= 4
+
+    def test_max_wait_dispatches_a_lonely_request(self):
+        eng = StubEngine(bucket=8)
+        with serve.DynamicBatcher(eng, max_batch=8, max_wait_ms=10,
+                                  max_queue=8) as bat:
+            t0 = time.perf_counter()
+            out = bat.submit(_item(3.0)).result(timeout=10)
+            dt = time.perf_counter() - t0
+        assert float(out[0, 0]) == 6.0
+        assert dt < 5.0  # dispatched by the wait timer, not starved
+        assert bat.fill_ratio == pytest.approx(1 / 8)
+
+    def test_multi_item_requests_and_batch_boundary_carry(self):
+        """A request that would overflow the building batch opens the
+        next one — order preserved, no splitting a request across
+        programs."""
+        eng = StubEngine(bucket=4)
+        with serve.DynamicBatcher(eng, max_batch=4, max_wait_ms=50,
+                                  max_queue=32) as bat:
+            futs = [bat.submit(_item(float(i), n=3)) for i in range(4)]
+            res = [f.result(timeout=10) for f in futs]
+        for i, r in enumerate(res):
+            assert r.shape == (3, 1)
+            np.testing.assert_array_equal(r, np.full((3, 1), 2.0 * i))
+        assert all(c <= 4 for c in eng.calls)
+
+    def test_queue_full_rejects_with_backpressure(self):
+        eng = StubEngine(bucket=4, delay=0.2)
+        bat = serve.DynamicBatcher(eng, max_batch=4, max_wait_ms=1,
+                                   max_queue=2)
+        try:
+            futs = [bat.submit(_item(0))]
+            rejected = 0
+            for _ in range(30):
+                try:
+                    futs.append(bat.submit(_item(1)))
+                except serve.RejectedError:
+                    rejected += 1
+            assert rejected > 0
+            assert bat.counters.count("rejected") == rejected
+            for f in futs:  # everything admitted is still answered
+                f.result(timeout=30)
+        finally:
+            bat.close()
+
+    def test_oversize_request_rejected_up_front(self):
+        bat = serve.DynamicBatcher(StubEngine(bucket=4), max_batch=4,
+                                   max_queue=4)
+        try:
+            with pytest.raises(serve.RejectedError, match="max_batch"):
+                bat.submit(_item(0, n=5))
+        finally:
+            bat.close()
+
+    def test_max_batch_cannot_exceed_engine_bucket(self):
+        with pytest.raises(ValueError, match="largest"):
+            serve.DynamicBatcher(StubEngine(bucket=4), max_batch=8)
+
+    def test_coalesce_error_fails_the_batch_not_the_batcher(self):
+        """Regression: a failure BEFORE the engine call (requests whose
+        trailing shapes disagree reach np.concatenate) must fail the
+        coalesced batch's futures, not kill the collector thread."""
+        eng = StubEngine(bucket=4, delay=0.1)
+        with serve.DynamicBatcher(eng, max_batch=2, max_wait_ms=200,
+                                  max_queue=8) as bat:
+            blocker = bat.submit(_item(0, n=2))  # holds the worker busy
+            fa = bat.submit(np.zeros((1, 2), np.float32))
+            fb = bat.submit(np.zeros((1, 3), np.float32))  # ragged pair
+            blocker.result(timeout=10)
+            with pytest.raises(ValueError):
+                fa.result(timeout=10)
+            with pytest.raises(ValueError):
+                fb.result(timeout=10)
+            assert bat.counters.count("errors") == 1
+            # the batcher keeps serving after the failed coalesce
+            f = bat.submit(_item(3))
+            assert float(f.result(timeout=10)[0, 0]) == 6.0
+
+    def test_cancelled_request_is_skipped_not_fatal(self):
+        """Regression: a client cancelling its Future while queued must
+        not crash the worker at result time — the cancelled request is
+        dropped, its batchmates are answered."""
+        eng = StubEngine(bucket=2, delay=0.1)
+        with serve.DynamicBatcher(eng, max_batch=2, max_wait_ms=200,
+                                  max_queue=8) as bat:
+            blocker = bat.submit(_item(0, n=2))
+            f1 = bat.submit(_item(1))
+            f2 = bat.submit(_item(2))
+            assert f1.cancel()  # still queued behind the blocker
+            blocker.result(timeout=10)
+            assert float(f2.result(timeout=10)[0, 0]) == 4.0
+        assert bat.drained
+
+    def test_submit_rejects_cross_leaf_leading_axis_mismatch(self):
+        """Admission reuses the engine's leading-axis validation: a
+        pytree whose leaves disagree on the batch axis is rejected at
+        submit, not deep inside a coalesced program call."""
+        bat = serve.DynamicBatcher(StubEngine(bucket=4), max_batch=4,
+                                   max_queue=4)
+        try:
+            with pytest.raises(ValueError, match="disagree"):
+                bat.submit({"a": _item(0, n=2), "b": _item(0, n=3)})
+        finally:
+            bat.close()
+
+    def test_engine_error_fails_the_batch_not_the_batcher(self):
+        class Exploding(StubEngine):
+            def predict(self, b):
+                raise RuntimeError("boom")
+
+        eng = Exploding(bucket=4)
+        with serve.DynamicBatcher(eng, max_batch=4, max_wait_ms=5,
+                                  max_queue=8) as bat:
+            f = bat.submit(_item(1))
+            with pytest.raises(RuntimeError, match="boom"):
+                f.result(timeout=10)
+            assert bat.counters.count("errors") == 1
+            # the batcher keeps serving after a failed batch
+            f2 = bat.submit(_item(2))
+            with pytest.raises(RuntimeError, match="boom"):
+                f2.result(timeout=10)
+
+    def test_close_drain_answers_everything(self):
+        eng = StubEngine(bucket=2, delay=0.02)
+        bat = serve.DynamicBatcher(eng, max_batch=2, max_wait_ms=500,
+                                   max_queue=32)
+        futs = [bat.submit(_item(i)) for i in range(10)]
+        bat.close(drain=True)
+        for i, f in enumerate(futs):
+            assert float(f.result(timeout=1)[0, 0]) == 2.0 * i
+        assert bat.drained
+
+    def test_close_without_drain_fails_pending(self):
+        eng = StubEngine(bucket=1, delay=0.2)
+        bat = serve.DynamicBatcher(eng, max_batch=1, max_wait_ms=1,
+                                   max_queue=32)
+        futs = [bat.submit(_item(i)) for i in range(5)]
+        time.sleep(0.05)  # let the first batch enter the engine
+        bat.close(drain=False)
+        outcomes = []
+        for f in futs:
+            try:
+                f.result(timeout=5)
+                outcomes.append("answered")
+            except serve.RejectedError:
+                outcomes.append("rejected")
+        assert "rejected" in outcomes  # pending work was failed fast
+        with pytest.raises(serve.RejectedError):
+            bat.submit(_item(0))
+
+    def test_preemption_guard_triggers_graceful_drain(self):
+        """PR 1 wiring: SIGTERM-shaped preemption (SIGUSR1 here, the
+        fault-suite convention) flips the batcher into drain mode —
+        admitted requests are all answered, new ones rejected, worker
+        exits."""
+        eng = StubEngine(bucket=4, delay=0.02)
+        with resilience.PreemptionGuard(signals=(signal.SIGUSR1,)) as g:
+            bat = serve.DynamicBatcher(eng, max_batch=4, max_wait_ms=200,
+                                       max_queue=32, guard=g)
+            futs = [bat.submit(_item(i)) for i in range(6)]
+            os.kill(os.getpid(), signal.SIGUSR1)
+            assert g.preempted
+            for i, f in enumerate(futs):
+                assert float(f.result(timeout=10)[0, 0]) == 2.0 * i
+            with pytest.raises(serve.RejectedError, match="draining"):
+                bat.submit(_item(0))
+            bat.close()
+            assert bat.drained
+
+
+# --------------------------------------------------------------- telemetry
+
+
+class TestServeObservability:
+    def test_latency_fill_queue_depth_and_spans(self):
+        telemetry.set_enabled(True)
+        tracer = tracing.install()
+        dp = _trained_dp()
+        eng = serve.InferenceEngine.from_trainer(dp, buckets=(8,))
+        eng.warm(_x(1))
+        with serve.DynamicBatcher(eng, max_batch=8, max_wait_ms=20,
+                                  max_queue=64) as bat:
+            futs = [bat.submit(_x(1, seed=i)) for i in range(16)]
+            for f in futs:
+                f.result(timeout=60)
+        snap = telemetry.validate_snapshot(telemetry.snapshot())
+        assert snap["histograms"]["serve.latency_s"]["count"] == 16
+        assert snap["histograms"]["serve.batch_fill_ratio"]["count"] >= 1
+        assert snap["histograms"]["serve.infer_s"]["count"] >= 1
+        assert snap["counters"]["serve.requests"] == 16
+        assert snap["counters"]["serve.compiles"] == 1
+        assert "serve.queue_depth" in snap["gauges"]
+        names = {e["name"] for e in tracer.events}
+        assert {"serve.batch", "serve.infer"} <= names
+        batch_ev = next(e for e in tracer.events if e["name"] == "serve.batch")
+        assert batch_ev["args"]["bucket"] == 8
+
+    def test_counters_count_without_telemetry_gate(self):
+        """CounterGroup contract: serving stats (the bench fill-ratio
+        source) must accumulate with the telemetry export gate OFF."""
+        telemetry.set_enabled(False)
+        with serve.DynamicBatcher(StubEngine(bucket=4), max_batch=4,
+                                  max_wait_ms=20, max_queue=16) as bat:
+            futs = [bat.submit(_item(i)) for i in range(4)]
+            for f in futs:
+                f.result(timeout=10)
+        assert bat.counters.count("requests") == 4
+        assert bat.fill_ratio == 1.0
+        assert len(telemetry.REGISTRY) == 0  # nothing leaked into export

@@ -142,6 +142,39 @@ def test_eval_step_no_collectives_and_no_mutation():
     )
 
 
+def test_eval_step_normalizes_with_train_accumulated_stats():
+    """eval_step ↔ training parity (the serving contract): the BN
+    running stats that train_step accumulated are exactly what the
+    compiled sharded eval_step normalizes with — its loss equals a
+    plain local eval forward on the synced-back model (outside any
+    mesh, SyncBN's eval fallback uses the running buffers and nothing
+    else)."""
+    model = tnn.convert_sync_batchnorm(SmallCNN(nnx.Rngs(4)))
+    dp = parallel.DataParallel(model, optax.sgd(0.1), ce_loss)
+    for s in range(3):
+        dp.train_step(make_batch(s))
+    batch = make_batch(9)
+    out = dp.eval_step(batch)
+
+    m = dp.sync_to_model()
+    m.eval()
+    # the stats in play really are the train-accumulated ones
+    assert int(m.bn1.num_batches_tracked[...]) == 3
+    local_loss, local_metrics = ce_loss(m, batch)
+    np.testing.assert_allclose(float(out.loss), float(local_loss),
+                               rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(float(out.metrics["acc"]),
+                               float(local_metrics["acc"]), atol=1e-6)
+
+    # sensitivity control: perturb the running stats and eval_step's
+    # answer must move — it is normalizing with these buffers, not
+    # recomputing batch statistics
+    m.bn1.running_mean.value = m.bn1.running_mean[...] + 10.0
+    dp2 = parallel.DataParallel(m, optax.sgd(0.1), ce_loss)
+    out2 = dp2.eval_step(batch)
+    assert abs(float(out2.loss) - float(out.loss)) > 1e-3
+
+
 def test_full_recipe_end_to_end():
     """The reference's six steps, in our framework, as a user would write
     them (README.md:9-103), on 8 simulated chips."""

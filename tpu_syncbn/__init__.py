@@ -29,4 +29,4 @@ Reference step (README.md line)                  tpu-syncbn equivalent
 
 __version__ = "0.1.0"
 
-from tpu_syncbn import runtime, parallel, ops, nn, models, data, utils, obs  # noqa: F401
+from tpu_syncbn import runtime, parallel, ops, nn, models, data, utils, obs, serve  # noqa: F401

@@ -829,7 +829,9 @@ class DataParallel:
         the host (cheap relative to a checkpoint write, the main reader).
         Assigning accepts a param tree in either mode."""
         if self.zero:
-            return self._layout.unflatten_host(self._param_store)
+            from tpu_syncbn.parallel.zero import unshard_params
+
+            return unshard_params(self._layout, self._param_store)
         return self._param_store
 
     @params.setter

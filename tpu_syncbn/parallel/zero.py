@@ -72,6 +72,19 @@ def check_elementwise(optimizer) -> None:
         )
 
 
+def unshard_params(layout: "FlatLayout", store: dict):
+    """Gather ZeRO flat parameter shards back into the full pytree — the
+    serving-side inverse of the training layout (each device holds a
+    1/world contiguous slice of one flat vector per dtype; serving wants
+    the whole tree, once, to re-replicate). This is the layout-change
+    problem of "Memory-efficient array redistribution through portable
+    collective communication" (arxiv 2112.01075) at whole-model
+    granularity: one gather per dtype group, then the host-side
+    unflatten. ``serve.InferenceEngine.from_trainer`` and
+    ``DataParallel.params`` both restore through here."""
+    return layout.unflatten_host(store)
+
+
 class FlatLayout:
     """Dtype-grouped flat layout of a pytree.
 

@@ -1,0 +1,44 @@
+"""Serving subsystem: dynamic-batching inference on the trained model.
+
+The first non-training subsystem in the codebase (ROADMAP north star:
+"serves heavy traffic from millions of users"). Two layers:
+
+* :mod:`tpu_syncbn.serve.engine` — :class:`InferenceEngine`: params
+  restored out of their training layout once (ZeRO flat shards gathered
+  via ``parallel.zero.unshard_params``, then re-replicated), model
+  pinned in eval mode (BN on running stats — collective-free, hence
+  embarrassingly data-parallel), and a FIFO-bounded set of bucketed
+  AOT-compiled eval programs sharded over the ``data`` axis.
+* :mod:`tpu_syncbn.serve.batcher` — :class:`DynamicBatcher`: bounded
+  request queue with a ``max_batch``/``max_wait_ms`` admission policy,
+  pad-to-bucket coalescing, queue-full rejection (backpressure), and
+  graceful drain wired to the resilience layer's
+  :class:`~tpu_syncbn.runtime.resilience.PreemptionGuard`.
+
+Quickstart::
+
+    from tpu_syncbn import serve
+
+    engine = serve.InferenceEngine.from_trainer(dp, buckets=(8, 32, 128))
+    engine.warm(example_batch)                     # AOT-compile buckets
+    with serve.DynamicBatcher(engine, max_batch=128,
+                              max_wait_ms=5) as batcher:
+        fut = batcher.submit(x[i:i + 1])           # per-request future
+        logits = fut.result()
+
+``bench.py --serve`` runs a closed-loop offered-load sweep against this
+stack and reports throughput / p50-p99 latency / batch-fill ratio in the
+schema-pinned ``serve`` block (docs/PERFORMANCE.md "Serving";
+docs/OBSERVABILITY.md for the ``serve.*`` metric schemas).
+"""
+
+from tpu_syncbn.parallel.zero import unshard_params  # noqa: F401
+from tpu_syncbn.serve.batcher import DynamicBatcher, RejectedError  # noqa: F401
+from tpu_syncbn.serve.engine import InferenceEngine  # noqa: F401
+
+__all__ = [
+    "InferenceEngine",
+    "DynamicBatcher",
+    "RejectedError",
+    "unshard_params",
+]

@@ -1,0 +1,315 @@
+"""Dynamic request batching: queueing, admission, backpressure, drain.
+
+The engine (:mod:`tpu_syncbn.serve.engine`) executes *batches*; real
+traffic arrives as small independent requests. :class:`DynamicBatcher`
+sits between them — the reference recipe has no serving story at all, so
+this is the standard dynamic-batching design (bounded queue + a single
+collector thread) rebuilt on this codebase's seams:
+
+* **admission policy** — a batch dispatches when it reaches
+  ``max_batch`` items OR its oldest request has waited ``max_wait_ms``,
+  whichever comes first: full batches under load (throughput), bounded
+  queueing delay when idle (latency);
+* **backpressure** — the request queue is bounded (``max_queue``); a
+  full queue *rejects* the submit (:class:`RejectedError`) instead of
+  growing latency without bound — load shedding at the edge, where the
+  client can retry against another replica;
+* **graceful drain** — wired to PR 1's preemption contract: give the
+  batcher a :class:`~tpu_syncbn.runtime.resilience.PreemptionGuard`
+  (anything with a truthy ``preempted`` property works) and the first
+  SIGTERM flips it into drain mode — new submits are rejected, every
+  already-admitted request is answered, then the worker exits. The same
+  drain runs on ``close(drain=True)``.
+
+Coalesced requests are concatenated along the batch axis, padded to a
+bucket by the engine, and each caller's slice is handed back through its
+``concurrent.futures.Future``. The engine is only ever called from the
+single collector thread, so jax never sees concurrent dispatch.
+
+Observability (docs/OBSERVABILITY.md): ``serve.latency_s``
+enqueue→response histogram, ``serve.queue_depth`` gauge,
+``serve.batch_fill_ratio`` histogram, a ``serve.batch`` trace span per
+executed batch, and a ``CounterGroup`` (prefix ``serve``) whose counts —
+``requests`` / ``rejected`` / ``batches`` / ``items`` / ``slots`` /
+``errors`` — always accumulate locally and mirror into the process
+registry when telemetry is enabled.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any
+
+import numpy as np
+
+from tpu_syncbn.obs import stepstats as obs_stepstats
+from tpu_syncbn.obs import telemetry
+from tpu_syncbn.runtime import distributed as dist
+
+__all__ = ["DynamicBatcher", "RejectedError"]
+
+#: Fill-ratio histogram boundaries (a ratio in (0, 1], not a duration).
+FILL_BUCKETS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0)
+
+
+class RejectedError(RuntimeError):
+    """The batcher refused a request: queue full (backpressure), or the
+    batcher is draining/closed. Clients should retry elsewhere."""
+
+
+class _Request:
+    __slots__ = ("payload", "n", "future", "t0")
+
+    def __init__(self, payload, n: int):
+        self.payload = payload
+        self.n = n
+        self.future: Future = Future()
+        self.t0 = time.perf_counter()
+
+
+class DynamicBatcher:
+    """Coalesce single requests into engine batches.
+
+    ``engine`` needs ``bucket_for(n)``, ``max_bucket``, and
+    ``predict(batch) -> host outputs`` (duck-typed; tests drive the
+    queueing logic with a stub). ``max_batch`` defaults to the engine's
+    largest bucket and may not exceed it — an admitted batch must always
+    fit one program. ``guard`` is the preemption hook (see module
+    docstring).
+
+    ``submit(item)`` takes a host batch pytree with a leading axis of
+    ``n >= 1`` (a single example is ``x[i:i+1]``) and returns a
+    ``Future`` resolving to that request's output slice.
+    """
+
+    def __init__(
+        self,
+        engine,
+        *,
+        max_batch: int | None = None,
+        max_wait_ms: float = 5.0,
+        max_queue: int = 64,
+        guard: Any = None,
+    ):
+        if max_batch is None:
+            max_batch = int(engine.max_bucket)
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_batch > engine.max_bucket:
+            raise ValueError(
+                f"max_batch={max_batch} exceeds the engine's largest "
+                f"bucket {engine.max_bucket} — a full batch must fit one "
+                "compiled program"
+            )
+        if max_wait_ms < 0:
+            raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self._engine = engine
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self._guard = guard
+        self._q: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._closing = False
+        self._drain_on_close = True
+        self._stopped = threading.Event()
+        #: always-on local counts; mirrored into the registry as
+        #: ``serve.*`` when telemetry is enabled (obs.CounterGroup)
+        self.counters = telemetry.CounterGroup(prefix="serve")
+        self._log = dist.get_logger("tpu_syncbn.serve")
+        self._thread = threading.Thread(
+            target=self._run, name="dynamic-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # -- admission ---------------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        """True once a preemption signal or close() stopped admission."""
+        return self._closing or (
+            self._guard is not None and bool(self._guard.preempted)
+        )
+
+    @property
+    def drained(self) -> bool:
+        """True once the worker has answered everything and exited."""
+        return self._stopped.is_set() and self._q.empty()
+
+    @property
+    def fill_ratio(self) -> float | None:
+        """Aggregate batch-fill ratio so far: admitted items over padded
+        program slots (1.0 = every program ran completely full)."""
+        slots = self.counters.count("slots")
+        if not slots:
+            return None
+        return self.counters.count("items") / slots
+
+    def submit(self, item) -> Future:
+        """Enqueue one request; returns its ``Future``. Raises
+        :class:`RejectedError` on backpressure (queue full) or once the
+        batcher is draining/closed."""
+        n = _leading(item)
+        if n > self.max_batch:
+            raise RejectedError(
+                f"request of {n} items exceeds max_batch={self.max_batch}; "
+                "split it or call the engine directly"
+            )
+        if self.draining or self._stopped.is_set():
+            self.counters.bump("rejected")
+            raise RejectedError("batcher is draining — not admitting")
+        req = _Request(item, n)
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            self.counters.bump("rejected")
+            raise RejectedError(
+                f"request queue full ({self._q.maxsize}) — shed load"
+            ) from None
+        if self._stopped.is_set():
+            # the worker can drain-and-exit between the admission check
+            # above and the put landing — nothing may rot in a dead
+            # queue, so fail whatever is still in it (possibly our own
+            # request; a result already set by the worker wins)
+            self._reject_dead_queue()
+            if req.future.done() and req.future.exception() is not None:
+                self.counters.bump("rejected")
+                raise RejectedError("batcher is draining — not admitting")
+        self.counters.bump("requests")
+        telemetry.set_gauge("serve.queue_depth", self._q.qsize())
+        return req.future
+
+    def _reject_dead_queue(self) -> None:
+        """The worker has exited; answer anything still queued with the
+        drain rejection so no Future blocks forever."""
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_exception(
+                    RejectedError("batcher is draining — not admitting")
+                )
+
+    # -- collector ---------------------------------------------------------
+
+    def _run(self) -> None:
+        carry: _Request | None = None
+        try:
+            while True:
+                if carry is not None:
+                    first, carry = carry, None
+                else:
+                    try:
+                        first = self._q.get(timeout=0.01)
+                    except queue.Empty:
+                        if self.draining:
+                            break
+                        continue
+                if self._closing and not self._drain_on_close:
+                    if first.future.set_running_or_notify_cancel():
+                        first.future.set_exception(
+                            RejectedError("batcher closed without drain")
+                        )
+                    continue
+                reqs, n = [first], first.n
+                deadline = first.t0 + self.max_wait_s
+                while n < self.max_batch:
+                    wait = (0.0 if self.draining
+                            else deadline - time.perf_counter())
+                    try:
+                        r = (self._q.get(timeout=wait) if wait > 0
+                             else self._q.get_nowait())
+                    except queue.Empty:
+                        break
+                    if n + r.n > self.max_batch:
+                        carry = r  # opens the next batch
+                        break
+                    reqs.append(r)
+                    n += r.n
+                self._execute(reqs)
+        finally:
+            self._stopped.set()
+
+    def _execute(self, reqs: list[_Request]) -> None:
+        import jax
+
+        # claim every request (RUNNING) before touching payloads: a
+        # client that cancelled while queued is silently dropped, and a
+        # claimed future can no longer be cancelled out from under the
+        # set_result below
+        live = [r for r in reqs if r.future.set_running_or_notify_cancel()]
+        if not live:
+            return
+        n = sum(r.n for r in live)
+        try:
+            bucket = self._engine.bucket_for(n)
+            payload = jax.tree_util.tree_map(
+                lambda *ls: np.concatenate(
+                    [np.asarray(l) for l in ls], axis=0
+                ),
+                *[r.payload for r in live],
+            )
+            with obs_stepstats.timed_span(
+                "serve.batch", "serve.batch_s", n=n, bucket=bucket,
+                requests=len(live),
+            ):
+                out = self._engine.predict(payload)
+        except Exception as e:  # answer everyone; keep serving —
+            # coalescing itself can fail too (e.g. requests whose
+            # trailing shapes disagree reach np.concatenate), and that
+            # must fail the batch, never the collector thread
+            self.counters.bump("errors")
+            self._log.exception("serve batch failed (%d requests)",
+                                len(live))
+            for r in live:
+                r.future.set_exception(e)
+            return
+        reqs = live
+        now = time.perf_counter()
+        off = 0
+        for r in reqs:
+            lo = off
+            off += r.n
+            telemetry.observe("serve.latency_s", now - r.t0)
+            r.future.set_result(jax.tree_util.tree_map(
+                lambda a: a[lo:lo + r.n], out
+            ))
+        self.counters.bump("batches")
+        self.counters.bump("items", n)
+        self.counters.bump("slots", bucket)
+        telemetry.observe("serve.batch_fill_ratio", n / bucket, FILL_BUCKETS)
+        telemetry.set_gauge("serve.queue_depth", self._q.qsize())
+
+    # -- shutdown ----------------------------------------------------------
+
+    def close(self, *, drain: bool = True, timeout: float | None = None) -> None:
+        """Stop the batcher. ``drain=True`` (default) answers every
+        already-admitted request first — the preemption-exit path;
+        ``drain=False`` fails pending requests with
+        :class:`RejectedError`. Idempotent."""
+        self._drain_on_close = self._drain_on_close and drain
+        self._closing = True
+        self._thread.join(timeout)
+
+    def __enter__(self) -> "DynamicBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _leading(item) -> int:
+    from tpu_syncbn.serve.engine import _leading_dim
+
+    n = _leading_dim(item)  # validates cross-leaf agreement up front
+    if n < 1:
+        raise ValueError(
+            "requests need a leading batch axis of >= 1 (a single example "
+            "is x[i:i+1])"
+        )
+    return n

@@ -1,0 +1,314 @@
+"""Bucketed AOT inference engine: the serving-side execution core.
+
+Training ends with parameters in a *training* layout — replicated pytrees
+(or, under ``DataParallel(zero=True)``, dtype-grouped flat vectors sharded
+1/world across the data axis) with BN statistics accumulated into
+``BatchStat`` buffers. Serving needs the opposite arrangement: params
+gathered out of their shards and re-replicated once
+(:func:`tpu_syncbn.parallel.zero.unshard_params` — the layout-change
+problem of "Memory-efficient array redistribution through portable
+collective communication", arxiv 2112.01075, at whole-model granularity),
+the model pinned in eval mode so BatchNorm normalizes with running stats
+(``nn/normalization.py`` eval fallback: zero collectives — which is what
+makes eval embarrassingly parallel over the ``data`` axis), and a small,
+*fixed* set of compiled programs so request traffic never waits on XLA.
+
+:class:`InferenceEngine` owns that arrangement:
+
+* **shape buckets** — incoming batches are padded up to the nearest
+  configured bucket size, so the compile cache sees a handful of shapes
+  no matter what sizes clients send; bucket sizes are normalized up to
+  multiples of the mesh world so every program shards evenly over
+  ``DATA_AXIS``;
+* **AOT compilation** — each bucket's eval program is lowered and
+  compiled ahead of its first request (``jit.lower(...).compile()``);
+  the compiled executable is what requests run, so the request path
+  never traces;
+* **FIFO-bounded program retention** — compiled programs are cached
+  through :func:`tpu_syncbn.parallel.scan_driver.cached_program`, the
+  same :data:`~tpu_syncbn.parallel.scan_driver.MAX_CACHED_PROGRAMS`
+  bound the fused-training caches use, so a client sending pathological
+  shape traffic cannot grow device memory without bound;
+* **sharded eval** — the padded global batch is split over the data
+  axis (``P('data')`` in / ``P('data')`` out), each replica runs the
+  collective-free eval forward on its shard, and results are gathered
+  back to host numpy.
+
+The request-coalescing half (queueing, admission policy, backpressure,
+drain) lives in :mod:`tpu_syncbn.serve.batcher`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from tpu_syncbn.runtime.distributed import DATA_AXIS
+
+__all__ = ["InferenceEngine"]
+
+
+def _leading_dim(batch) -> int:
+    """The (validated) shared leading-axis length of a batch pytree."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(batch)
+    if not leaves:
+        raise ValueError("batch pytree has no array leaves")
+    ns = {int(np.shape(l)[0]) if np.ndim(l) else None for l in leaves}
+    if len(ns) != 1 or None in ns:
+        raise ValueError(
+            f"batch leaves disagree on the leading (batch) axis: {ns}"
+        )
+    return ns.pop()
+
+
+class InferenceEngine:
+    """Throughput-oriented eval executor for a converted model.
+
+    ``model`` is a trained nnx module (typically
+    ``convert_sync_batchnorm``-converted, then trained through
+    ``DataParallel``); the engine flips it to eval mode — nnx's
+    ``model.eval()`` propagates ``use_running_average=True`` through
+    every converted submodule (regression-pinned in
+    tests/test_nn_modules.py) — splits it once, and re-replicates the
+    state onto ``mesh``. Build one from a live trainer with
+    :meth:`from_trainer`, which routes ZeRO flat shards through
+    ``parallel.zero.unshard_params`` before replicating.
+
+    ``apply_fn(model, batch) -> outputs`` is the eval forward (default:
+    ``model(batch)``); every output leaf must carry the batch axis
+    leading — outputs are sharded ``P(data)`` and gathered to host.
+
+    ``buckets`` are *global* batch sizes; each is rounded up to a
+    multiple of the mesh world (the data axis must divide the padded
+    batch). :meth:`predict` pads a request batch up to the smallest
+    bucket that fits, runs that bucket's AOT-compiled program, and
+    slices the padding back off; batches larger than the biggest bucket
+    are chunked through it.
+
+    Telemetry (``TPU_SYNCBN_TELEMETRY`` / bench force-enable):
+    ``serve.infer_s`` per-program-call histogram, ``serve.compiles``
+    counter + ``serve.compile_s`` histogram, and a ``serve.infer`` trace
+    span per call (docs/OBSERVABILITY.md).
+    """
+
+    def __init__(
+        self,
+        model,
+        *,
+        mesh=None,
+        axis_name: str = DATA_AXIS,
+        apply_fn: Callable[[Any, Any], Any] | None = None,
+        buckets: Sequence[int] = (8, 32, 128),
+    ):
+        import jax
+        from flax import nnx
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_syncbn import compat
+        from tpu_syncbn.parallel.trainer import _pallas_forces_vma_off
+        from tpu_syncbn.runtime import distributed as dist
+
+        self.mesh = mesh if mesh is not None else dist.data_parallel_mesh()
+        self.axis_name = axis_name
+        self.world = int(self.mesh.shape[axis_name])
+        self._apply_fn = apply_fn if apply_fn is not None else (
+            lambda m, b: m(b)
+        )
+        if not buckets:
+            raise ValueError("need at least one bucket size")
+        norm = sorted({
+            int(b) + (-int(b)) % self.world for b in buckets if int(b) >= 1
+        })
+        if not norm:
+            raise ValueError(f"no usable bucket sizes in {buckets!r}")
+        #: normalized global bucket sizes (ascending, multiples of world)
+        self.buckets: tuple[int, ...] = tuple(norm)
+
+        # eval mode ONCE, at the seam where training state becomes
+        # serving state: BN on running stats, dropout-style flags off.
+        # The module itself is NOT retained — only the split graphdef +
+        # device-put state, so the host-side param tree can be freed.
+        model.eval()
+        self.graphdef, params, rest = nnx.split(model, nnx.Param, ...)
+        self._replicated = NamedSharding(self.mesh, P())
+        self.batch_sharding = NamedSharding(self.mesh, P(axis_name))
+        # restore/reshard once: whatever layout the state arrived in
+        # (host pytree from unshard_params, trainer-replicated arrays),
+        # serving storage is replicated on THIS mesh
+        self._params = jax.device_put(params, self._replicated)
+        self._rest = jax.device_put(rest, self._replicated)
+        # same interpret-lowering concession as the trainer (see
+        # DataParallel.__init__): eval BN on running stats never traces
+        # the Pallas train kernels, but track_running_stats=False models
+        # eval on the batch-stats path, which can trace them — so the
+        # VMA checker follows the trainer's gate
+        self._check_vma = compat.HAS_VMA and not _pallas_forces_vma_off(model)
+
+        self._programs: dict = {}  # FIFO-bounded via scan_driver
+        self._programs_compiled = 0
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_trainer(cls, trainer, **kwargs) -> "InferenceEngine":
+        """Build an engine from a live trainer (``DataParallel``-shaped:
+        ``sync_to_model``, ``mesh``, ``axis_name``; for a ``GANTrainer``
+        pass one of ``sync_to_models()``'s modules to the constructor
+        directly). This is the params-out-of-training-layout path:
+        ``sync_to_model`` assembles the full parameter tree — under
+        ``zero=True`` that is the ``parallel.zero.unshard_params``
+        gather of the flat 1/world shards — and the engine re-replicates
+        it for eval. The trainer keeps training; the engine owns copies
+        on device."""
+        model = trainer.sync_to_model()
+        kwargs.setdefault("mesh", trainer.mesh)
+        kwargs.setdefault("axis_name", getattr(trainer, "axis_name", DATA_AXIS))
+        return cls(model, **kwargs)
+
+    # -- buckets / programs ------------------------------------------------
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, n: int) -> int:
+        """The smallest configured bucket that fits a global batch of
+        ``n`` — the pad target. ``n`` beyond the largest bucket is a
+        caller error (:meth:`predict` chunks before asking)."""
+        if n < 1:
+            raise ValueError(f"batch size must be >= 1, got {n}")
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"batch of {n} exceeds the largest bucket {self.max_bucket}"
+        )
+
+    def _struct_key(self, batch):
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        return treedef, tuple(
+            (tuple(np.shape(l)[1:]), str(np.asarray(l).dtype)) for l in leaves
+        )
+
+    def _program(self, bucket: int, batch):
+        """The AOT-compiled eval executable for ``bucket`` and this
+        batch's structure (leaf shapes beyond the batch axis + dtypes).
+        Cached through ``scan_driver.cached_program`` — at most
+        ``MAX_CACHED_PROGRAMS`` distinct programs stay live, FIFO
+        beyond."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from tpu_syncbn import compat
+        from tpu_syncbn.compat import shard_map
+        from tpu_syncbn.obs import telemetry
+        from tpu_syncbn.parallel import scan_driver
+
+        treedef, leafspecs = self._struct_key(batch)
+        key = (bucket, treedef, leafspecs)
+
+        def build():
+            def fwd(params, rest, b):
+                model = compat.nnx_merge(self.graphdef, params, rest, copy=True)
+                model.eval()
+                return self._apply_fn(model, b)
+
+            sharded = shard_map(
+                fwd,
+                mesh=self.mesh,
+                in_specs=(P(), P(), P(self.axis_name)),
+                out_specs=P(self.axis_name),
+                check_vma=self._check_vma,
+            )
+            sds = jax.tree_util.tree_unflatten(treedef, [
+                jax.ShapeDtypeStruct(
+                    (bucket,) + shape, np.dtype(dtype),
+                    sharding=self.batch_sharding,
+                )
+                for shape, dtype in leafspecs
+            ])
+            with telemetry.timed("serve.compile_s"):
+                compiled = jax.jit(sharded).lower(
+                    self._params, self._rest, sds
+                ).compile()
+            telemetry.count("serve.compiles")
+            self._programs_compiled += 1
+            return compiled
+
+        return scan_driver.cached_program(self._programs, key, build)
+
+    def warm(self, example_batch) -> None:
+        """AOT-compile every bucket's program for ``example_batch``'s
+        structure (any leading-axis length), off the request path — so
+        the first real request of each bucket is an execute, not a
+        compile."""
+        for b in self.buckets:
+            self._program(b, example_batch)
+
+    def stats(self) -> dict:
+        """Program-cache accounting for the serve block / monitoring:
+        configured buckets, total programs ever compiled, programs
+        currently live (FIFO bound)."""
+        return {
+            "buckets": list(self.buckets),
+            "programs_compiled": self._programs_compiled,
+            "programs_live": len(self._programs),
+        }
+
+    # -- execution ---------------------------------------------------------
+
+    def _run_one(self, batch, n: int):
+        import jax
+
+        from tpu_syncbn.obs import stepstats as obs_stepstats
+
+        bucket = self.bucket_for(n)
+        pad = bucket - n
+
+        def pad_leaf(l):
+            a = np.asarray(l)
+            if pad == 0:
+                return a
+            return np.concatenate(
+                [a, np.zeros((pad,) + a.shape[1:], a.dtype)], axis=0
+            )
+
+        fn = self._program(bucket, batch)
+        padded = jax.tree_util.tree_map(pad_leaf, batch)
+        with obs_stepstats.timed_span(
+            "serve.infer", "serve.infer_s", n=n, bucket=bucket
+        ):
+            dev = jax.device_put(padded, self.batch_sharding)
+            out = fn(self._params, self._rest, dev)
+            # gather: host numpy, padding sliced back off — the engine's
+            # callers (the batcher's response path) want settled bytes
+            return jax.tree_util.tree_map(lambda a: np.asarray(a)[:n], out)
+
+    def predict(self, batch):
+        """Run the eval forward on a host batch pytree (leading axis =
+        global batch). Pads to the nearest bucket, executes that
+        bucket's compiled program sharded over the data axis, returns
+        host numpy outputs of the *original* length. Batches beyond the
+        largest bucket are chunked through it."""
+        import jax
+
+        n = _leading_dim(batch)
+        if n <= self.max_bucket:
+            return self._run_one(batch, n)
+        outs = []
+        for off in range(0, n, self.max_bucket):
+            take = min(self.max_bucket, n - off)
+            part = jax.tree_util.tree_map(
+                lambda a: np.asarray(a)[off:off + take], batch
+            )
+            outs.append(self._run_one(part, take))
+        return jax.tree_util.tree_map(
+            lambda *ls: np.concatenate(ls, axis=0), *outs
+        )
+
+    __call__ = predict
