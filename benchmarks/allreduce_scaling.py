@@ -46,10 +46,10 @@ def main():
 
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from tpu_syncbn import parallel, runtime
+    from tpu_syncbn.compat import shard_map
 
     n_dev = jax.device_count()
     if args.sizes:

@@ -858,7 +858,9 @@ def stage_vma_probe():
 
     def flash_step(check_vma: bool):
         spec = P(None, "data", None, None)
-        fn = jax.shard_map(
+        from tpu_syncbn.compat import shard_map as compat_shard_map
+
+        fn = compat_shard_map(
             functools.partial(
                 sequence.ulysses_attention, axis_name="data",
                 causal=True, local_impl="flash",

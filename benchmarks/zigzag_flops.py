@@ -34,9 +34,9 @@ def main():
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
+    from tpu_syncbn.compat import shard_map
     from tpu_syncbn.parallel import sequence
 
     n = args.simulate
@@ -50,6 +50,8 @@ def main():
             shard_map(fn, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
         )
         cost = jitted.lower(q, q, q).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):  # pre-0.5 jax: one dict per device
+            cost = cost[0]
         return float(cost["flops"])
 
     contiguous = flops_of(

@@ -144,7 +144,9 @@ def main():
     from tpu_syncbn.ops._pallas_common import interpret as _interpret
 
     check_vma = not (args.local_impl == "flash" and _interpret())
-    step = jax.jit(jax.shard_map(
+    from tpu_syncbn.compat import shard_map as compat_shard_map
+
+    step = jax.jit(compat_shard_map(
         step_body, mesh=mesh,
         in_specs=(P(), P(), P(None, "seq"), P(None, "seq")),
         out_specs=(P(), P(), P()),

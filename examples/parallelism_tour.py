@@ -24,10 +24,12 @@ import jax
 import jax.numpy as jnp
 import optax
 from flax import nnx
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_syncbn import models, nn, parallel, runtime
+# raw `jax.shard_map` does not exist on pre-VMA jax (srclint
+# raw_api_bypass) — the compat shim picks the working entry point
+from tpu_syncbn.compat import shard_map
 
 
 def check(name, got, want, atol=2e-4):

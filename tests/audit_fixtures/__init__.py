@@ -1,0 +1,8 @@
+"""Planted-violation fixtures for the srclint rules.
+
+Each ``bad_<rule>.py`` file here contains code that MUST trigger its
+rule — tests/test_audit_srclint.py lints every fixture and asserts the
+expected rule fires (a rule with no firing fixture is dead weight).
+The fixtures are never imported or executed; they only need to parse.
+``clean.py`` holds near-miss code that must NOT fire anything.
+"""

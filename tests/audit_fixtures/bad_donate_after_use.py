@@ -1,0 +1,24 @@
+"""PLANTED VIOLATIONS — donate_after_use.
+
+State buffers read after being handed to a donating compiled dispatch —
+the PR 4 ``snapshot_to_host`` hazard: donated jit invalidates its input
+buffers, so the late read sees garbage (or crashes on TPU).
+"""
+
+
+class Trainer:
+    def train_step_then_snapshot(self, batch):
+        out = self._train_step(self._param_store, self.rest, batch)
+        snap = dict(self._param_store)  # bad: donated two lines up
+        return out, snap
+
+    def aliased_read(self, batch):
+        stale = self._param_store
+        out = self._train_step(self._param_store, self.rest, batch)
+        return out, stale  # bad: alias taken before donation
+
+    def rebound_is_fine(self, batch):
+        (self._param_store, self.rest, loss) = self._train_step(
+            self._param_store, self.rest, batch
+        )
+        return dict(self._param_store), loss  # ok: rebound from result

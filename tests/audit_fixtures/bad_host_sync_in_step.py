@@ -1,0 +1,31 @@
+"""PLANTED VIOLATIONS — host_sync_in_step.
+
+Host-sync calls inside step-building code paths: under trace they either
+fail (ConcretizationTypeError) or silently pin a per-step device→host
+round trip — the overhead class PR 4 moved off the hot path.
+"""
+
+import jax
+import numpy as np
+
+
+class Trainer:
+    def _make_step_fn(self):
+        def step(state, batch):
+            loss = (batch ** 2).mean()
+            scalar = loss.item()  # bad: host sync at trace time
+            host = np.asarray(batch)  # bad: materializes on host
+            return state, scalar + host.sum()
+
+        return step
+
+
+def outside_builder(x):
+    # fine here: plain host code, not a step builder
+    return float(np.asarray(x).mean())
+
+
+def driver(fn, state, batch):
+    stepped = jax.jit(fn)(state, batch)
+    stepped[0].block_until_ready()  # fine: dispatch site, not traced
+    return stepped

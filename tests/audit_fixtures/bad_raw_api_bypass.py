@@ -1,0 +1,30 @@
+"""PLANTED VIOLATIONS — raw_api_bypass.
+
+Raw current-jax/flax API calls that must route through compat.py: on the
+baked jax 0.4.37 / flax 0.10 toolchain these are ImportError or
+AttributeError at import/call time (the PR 1 incident class).
+"""
+
+import jax
+from flax import nnx
+from jax import shard_map  # bad: the dominant bypass form
+from jax.experimental.shard_map import shard_map  # bad: compat.shard_map
+from jax.lax import pvary  # bad: collectives.pcast_varying
+
+
+def build(fn, mesh, specs):
+    sharded = jax.shard_map(fn, mesh=mesh, in_specs=specs, out_specs=specs)
+    return sharded
+
+
+def merge(graphdef, params, rest):
+    return nnx.merge(graphdef, params, rest)  # bad: compat.nnx_merge
+
+
+def cast(x, axis):
+    return jax.lax.pvary(x, axis)  # bad: collectives.pcast_varying
+
+
+def suppressed(graphdef, params):
+    # documented escape hatch: fallback probed one line above
+    return nnx.merge(graphdef, params)  # audit: ok[raw_api_bypass]

@@ -1,0 +1,19 @@
+"""PLANTED VIOLATIONS — telemetry_name_schema.
+
+Metric names outside the dotted-lowercase subsystem schema break the
+JSONL export/merge contract and the cross-round bench trend tooling
+(docs/OBSERVABILITY.md).
+"""
+
+from tpu_syncbn.obs import telemetry
+from tpu_syncbn.obs.telemetry import CounterGroup, Registry
+
+REGISTRY = Registry()
+
+
+def record(n):
+    telemetry.count("Serve.Latency")  # bad: uppercase, no subsystem dot
+    telemetry.count("queue_depth", n)  # bad: no subsystem prefix
+    telemetry.count("serve.queue_depth", n)  # ok
+    REGISTRY.counter("serve-errors")  # bad: dash not in schema
+    return CounterGroup(prefix="serve.batcher")  # bad: prefix is one token

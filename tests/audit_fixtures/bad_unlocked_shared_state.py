@@ -1,0 +1,38 @@
+"""PLANTED VIOLATIONS — unlocked_shared_state.
+
+In a lock-owning class, shared containers mutated outside ``with
+self.<lock>:`` — the discipline serve/batcher.py, AsyncCheckpointer and
+the loader staging live by (a torn update under a second thread is a
+heisenbug, not a test failure).
+"""
+
+import threading
+
+
+class Batcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+        self._pending = {}
+        self._errors: list = []  # AnnAssign container: tracked too
+        self._inflight = 0  # shared counter: += is read-modify-write
+
+    def submit(self, item):
+        self._queue.append(item)  # bad: no lock held
+        self._inflight += 1  # bad: non-atomic counter bump, no lock
+
+    def settle(self, key):
+        self._pending[key] = True  # bad: subscript store, no lock
+
+    def record_error(self, e):
+        self._errors.append(e)  # bad: AnnAssign-declared container
+
+    def locked_submit(self, item):
+        with self._lock:
+            self._queue.append(item)  # ok: under the lock
+
+    def drain(self):
+        with self._lock:
+            items = list(self._queue)
+            self._queue.clear()  # ok: under the lock
+        return items

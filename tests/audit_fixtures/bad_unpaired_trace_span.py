@@ -1,0 +1,18 @@
+"""PLANTED VIOLATIONS — unpaired_trace_span.
+
+Span/timer context managers created as bare statements are never
+entered, never close, and silently drop the region from the trace.
+"""
+
+from tpu_syncbn.obs import telemetry
+from tpu_syncbn.obs.stepstats import timed_span
+
+
+def work(tracer, batch):
+    tracer.span("serve.batch")  # bad: discarded, never entered
+    telemetry.timed("step.time_s")  # bad: same for the timer form
+    timed_span("data.fetch")  # bad: bare-name helper form
+    with tracer.span("serve.infer"):  # ok: entered
+        out = batch * 2
+    span = tracer.span("serve.flush")  # ok: stored for a caller's with
+    return out, span

@@ -1,0 +1,55 @@
+"""Near-miss code that must NOT fire any rule — the false-positive
+guard for tests/test_audit_srclint.py."""
+
+import threading
+
+import numpy as np
+
+from tpu_syncbn import compat
+from tpu_syncbn.obs import telemetry
+
+
+def host_side(batch):
+    # host code outside any step builder: syncs are allowed
+    arr = np.asarray(batch)
+    telemetry.count("data.batches")
+    return arr.mean().item()
+
+
+def build(fn, mesh, specs):
+    # the compat route — never flagged
+    return compat.shard_map(fn, mesh=mesh, in_specs=specs, out_specs=specs)
+
+
+class UnlockedButUnshared:
+    """No lock owned — plain container mutation is fine."""
+
+    def __init__(self):
+        self._items = []
+
+    def add(self, x):
+        self._items.append(x)
+
+
+class LockedProperly:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._pending = 0
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+            self._pending += 1
+
+
+class Trainer:
+    def step_and_rebind(self, batch):
+        # donation followed by rebind from the dispatch result: safe
+        (self._params, loss) = self._train_step(self._params, batch)
+        return dict(self._params), loss
+
+
+def traced(tracer, batch):
+    with tracer.span("serve.batch"):
+        return batch * 2

@@ -1,0 +1,440 @@
+"""Layer-1 audit tests: every compiled program the stack builds honors
+its pinned golden contract, the cross-program invariants hold, planted
+mutations are caught (the regression-detection property the subsystem
+exists for), and the CLI ships a schema-stable JSON report with exit 0
+on the clean repo.
+
+Contracts are traced abstractly (``jax.make_jaxpr`` + ``.lower()``) —
+nothing here executes a training step. The traced registry is built
+once per module: the six builders construct real trainers/engines,
+which is the expensive part worth sharing.
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_syncbn.audit import contracts as contracts_mod
+from tpu_syncbn.audit import jaxpr_audit
+from tpu_syncbn.audit.contracts import (
+    ProgramContract,
+    compare_contracts,
+    extract_contract,
+)
+
+pytestmark = pytest.mark.audit
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN_DIR = os.path.join(ROOT, "tests", "contracts")
+
+
+@pytest.fixture(scope="module")
+def live():
+    """All six registered programs, traced once."""
+    return jaxpr_audit.build_contracts()
+
+
+class TestGoldens:
+    def test_every_program_has_a_pinned_golden(self, live):
+        violations, unpinned = jaxpr_audit.check_goldens(live, GOLDEN_DIR)
+        assert unpinned == []
+        assert violations == [], [v.format() for v in violations]
+
+    def test_invariants_hold(self, live):
+        vs = jaxpr_audit.check_invariants(live)
+        assert vs == [], [v.format() for v in vs]
+
+    def test_golden_files_match_registry(self):
+        pinned = {
+            f[:-len(".json")] for f in os.listdir(GOLDEN_DIR)
+            if f.endswith(".json")
+        }
+        assert pinned == set(jaxpr_audit.PROGRAM_BUILDERS)
+
+    def test_contract_json_round_trip(self, live):
+        for c in live.values():
+            again = ProgramContract.from_json(
+                json.loads(json.dumps(c.to_json()))
+            )
+            assert compare_contracts(c, again) == []
+
+    def test_schema_bump_refuses_stale_golden(self, live):
+        blob = next(iter(live.values())).to_json()
+        blob["schema"] = -1
+        with pytest.raises(ValueError, match="re-pin"):
+            ProgramContract.from_json(blob)
+
+
+class TestProgramContracts:
+    """The paper's claims, machine-checked per program."""
+
+    def test_train_step_reduces_bn_stats_and_donates_everything(self, live):
+        c = live["dataparallel.train_step"]
+        # SyncBN's one change: cross-replica psum of BN stats (+ the
+        # grad/loss reductions) — and nothing else on the wire
+        assert set(c.collectives) == {"psum"}
+        assert c.collective_bytes["psum"] > 0
+        # full training state donated, batch NOT
+        assert set(c.donated_declared) == {"params", "rest", "opt_state"}
+        for label in c.donated_declared:
+            assert c.donated_aliased.get(label, 0) > 0, (label, c.donated_aliased)
+        assert "batch" not in c.donated_aliased
+        assert c.host_callbacks == {}
+
+    def test_zero_guard_adds_exactly_the_sharding_collectives(self, live):
+        plain = live["dataparallel.train_step"]
+        zero = live["dataparallel.zero_guard.train_step"]
+        # ZeRO: params gathered, grads reduce-scattered; PR 1 guard:
+        # one world-consensus pmin
+        assert zero.collectives.get("all_gather", 0) >= 1
+        assert zero.collectives.get("reduce_scatter", 0) >= 1
+        assert zero.collectives.get("pmin", 0) == 1
+        assert set(zero.collectives) == {"psum", "all_gather",
+                                         "reduce_scatter", "pmin"}
+        assert set(plain.collectives) == {"psum"}
+
+    def test_scan_contract_is_k_invariant(self, live):
+        k1 = live["dataparallel.scan_k1.train_steps"]
+        k4 = live["dataparallel.scan_k4.train_steps"]
+        # collectives live in the scan BODY: fusing K steps adds zero
+        # communication per logical step
+        assert k1.collectives == k4.collectives
+        assert k1.collective_bytes == k4.collective_bytes
+        assert k1.collectives == live["dataparallel.train_step"].collectives
+
+    def test_gan_step_covers_both_networks(self, live):
+        c = live["gan.train_step"]
+        assert set(c.collectives) == {"psum"}
+        # D and G updates in one program: strictly more reductions than
+        # the single-net step
+        assert c.collectives["psum"] > \
+            live["dataparallel.train_step"].collectives["psum"]
+        assert set(c.donated_declared) == {
+            "g_params", "g_rest", "d_params", "d_rest",
+            "g_opt_state", "d_opt_state",
+        }
+        for label in c.donated_declared:
+            assert c.donated_aliased.get(label, 0) > 0
+
+    def test_serve_eval_is_collective_free_and_donation_free(self, live):
+        c = live["serve.eval_bucket8"]
+        assert c.collectives == {}, (
+            "PR 5 claim: converted-model eval normalizes with running "
+            "stats — NO cross-replica reduction in the bucket program"
+        )
+        assert sum(c.donated_aliased.values()) == 0, (
+            "batch inputs are never donated (batcher/staging may still "
+            "own the buffers)"
+        )
+        assert c.host_callbacks == {}
+
+
+class TestPlantedMutations:
+    """Acceptance: the golden check FAILS when a collective is added to,
+    or a donation removed from, a pinned program."""
+
+    def test_extra_collective_is_caught(self, live):
+        for name, c in live.items():
+            mutated = copy.deepcopy(c)
+            mutated.collectives["psum"] = mutated.collectives.get("psum", 0) + 1
+            diffs = compare_contracts(mutated, c)
+            assert any("collectives[psum]" in d for d in diffs), (name, diffs)
+
+    def test_lost_donation_is_caught(self, live):
+        c = live["dataparallel.train_step"]
+        mutated = copy.deepcopy(c)
+        mutated.donated_aliased.pop("params")
+        diffs = compare_contracts(mutated, c)
+        assert any("donated_aliased[params]" in d for d in diffs), diffs
+
+    def test_lost_donation_also_trips_the_invariant(self, live):
+        mutated = copy.deepcopy(live["dataparallel.train_step"])
+        mutated.donated_aliased["opt_state"] = 0
+        vs = jaxpr_audit.check_invariants({mutated.name: mutated})
+        assert [v.rule for v in vs] == ["contract.donation_lost"]
+
+    def test_new_host_callback_trips_the_invariant(self, live):
+        mutated = copy.deepcopy(live["dataparallel.train_step"])
+        mutated.host_callbacks["pure_callback"] = 1
+        vs = jaxpr_audit.check_invariants({mutated.name: mutated})
+        assert [v.rule for v in vs] == ["contract.host_callback"]
+
+    def test_serve_collective_trips_the_invariant(self, live):
+        mutated = copy.deepcopy(live["serve.eval_bucket8"])
+        mutated.collectives["psum"] = 1
+        vs = jaxpr_audit.check_invariants({mutated.name: mutated})
+        assert "contract.serve_collectives" in {v.rule for v in vs}
+
+    def test_scan_k_variance_trips_the_invariant(self, live):
+        k4 = copy.deepcopy(live["dataparallel.scan_k4.train_steps"])
+        k4.collectives["psum"] += 1
+        vs = jaxpr_audit.check_invariants({
+            "dataparallel.scan_k1.train_steps":
+                live["dataparallel.scan_k1.train_steps"],
+            "dataparallel.scan_k4.train_steps": k4,
+        })
+        assert "contract.scan_variance" in {v.rule for v in vs}
+
+    def test_world_mismatch_refuses_comparison(self, live):
+        c = live["dataparallel.train_step"]
+        mutated = copy.deepcopy(c)
+        mutated.world = 2
+        diffs = compare_contracts(mutated, c)
+        assert len(diffs) == 1 and "world" in diffs[0]
+
+
+class TestExtraction:
+    """summarize_jaxpr/extract_contract ground truth on hand-built
+    programs — the detector fires on what it claims to detect."""
+
+    def test_collective_and_bytes_detection(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import PartitionSpec as P
+
+        from tpu_syncbn.compat import shard_map
+        from tpu_syncbn.runtime import distributed as dist
+
+        mesh = dist.data_parallel_mesh()
+        world = int(np.prod(list(mesh.shape.values())))
+
+        def body(x):
+            return jax.lax.psum(x, "data")
+
+        fn = jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(P("data"),), out_specs=P(),
+        ))
+        x = jax.ShapeDtypeStruct((world * 4,), jnp.float32)
+        c = extract_contract(fn, (x,), name="t", world=world,
+                             arg_labels=("x",))
+        assert c.collectives == {"psum": 1}
+        # per-shard payload: (world*4 / world) f32 elements
+        assert c.collective_bytes == {"psum": 16}
+
+    def test_host_callback_detection(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def fn(x):
+            return jax.pure_callback(
+                lambda v: np.asarray(v) * 2,
+                jax.ShapeDtypeStruct((4,), jnp.float32),
+                x,
+            )
+
+        jfn = jax.jit(fn)
+        c = extract_contract(
+            jfn, (jax.ShapeDtypeStruct((4,), jnp.float32),),
+            name="t", world=1, arg_labels=("x",),
+        )
+        assert sum(c.host_callbacks.values()) == 1
+
+    def test_upcast_detection_counts_widening_only(self):
+        import jax
+        import jax.numpy as jnp
+
+        def fn(x):
+            wide = x.astype(jnp.float32)  # widening: counted
+            back = wide.astype(jnp.bfloat16)  # narrowing: not
+            return back
+
+        c = extract_contract(
+            jax.jit(fn), (jax.ShapeDtypeStruct((4,), jnp.bfloat16),),
+            name="t", world=1, arg_labels=("x",),
+        )
+        assert c.upcasts == {"bfloat16->float32": 1}
+
+    def test_scan_body_counts_once(self):
+        import jax
+        import jax.numpy as jnp
+
+        def fn(c0, xs):
+            def body(c, x):
+                return c + x.sum(), c
+            return jax.lax.scan(body, c0, xs)
+
+        summary = contracts_mod.summarize_jaxpr(
+            jax.make_jaxpr(fn)(
+                jnp.float32(0.0), jnp.zeros((16, 4), jnp.float32)
+            )
+        )
+        # program text, not execution count: no collectives either way,
+        # but the walk must terminate and see the body exactly once
+        assert summary["collectives"] == {}
+
+    def test_dropped_donation_shows_zero_aliased_leaves(self):
+        import jax
+        import jax.numpy as jnp
+
+        def fn(state, batch):
+            return jax.tree_util.tree_map(lambda a: a + batch.sum(), state)
+
+        state = {"w": jax.ShapeDtypeStruct((4,), jnp.float32)}
+        batch = jax.ShapeDtypeStruct((8,), jnp.float32)
+        donated = jax.jit(fn, donate_argnums=(0,))
+        undonated = jax.jit(fn)
+        kw = dict(world=1, arg_labels=("state", "batch"),
+                  declared_donated=("state",))
+        c_ok = extract_contract(donated, (state, batch), name="d", **kw)
+        c_lost = extract_contract(undonated, (state, batch), name="u", **kw)
+        assert c_ok.donated_aliased.get("state", 0) == 1
+        assert c_lost.donated_aliased == {}
+        vs = jaxpr_audit.check_invariants({"u": c_lost})
+        assert [v.rule for v in vs] == ["contract.donation_lost"]
+
+
+class TestAuditCLI:
+    """Tier-1: the CLI the driver and CI shell — same pattern as
+    TestServeBlock's bench smoke."""
+
+    def test_strict_json_exits_zero_with_valid_schema(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_syncbn.audit",
+             "--strict", "--json"],
+            capture_output=True, text=True, cwd=ROOT, timeout=600,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)
+        assert set(report) == {
+            "schema", "ok", "strict", "files_linted", "programs_checked",
+            "violations", "unpinned", "rule_counts",
+        }
+        assert report["schema"] == 1
+        assert report["ok"] is True and report["strict"] is True
+        assert report["violations"] == [] and report["unpinned"] == []
+        assert report["programs_checked"] == len(jaxpr_audit.PROGRAM_BUILDERS)
+        assert report["files_linted"] >= 50
+
+    def test_lint_only_flags_planted_fixtures_and_exits_nonzero(self):
+        # jax-free path: --no-contracts over the fixture tree must find
+        # the planted violations and fail the run
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_syncbn.audit", "--no-contracts",
+             "--json", "--root",
+             os.path.join(ROOT, "tests", "audit_fixtures")],
+            capture_output=True, text=True, cwd=ROOT, timeout=120,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)
+        assert report["ok"] is False
+        assert set(report["rule_counts"]) == set(
+            __import__("tpu_syncbn.audit.srclint",
+                       fromlist=["RULES"]).RULES
+        )
+
+    def test_unknown_rule_is_a_usage_error(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_syncbn.audit", "--no-contracts",
+             "--rules", "nonsense"],
+            capture_output=True, text=True, cwd=ROOT, timeout=120,
+        )
+        assert proc.returncode == 2
+        assert "unknown rule" in proc.stderr
+
+
+class TestTelemetryWiring:
+    def test_audit_counters_land_in_registry(self):
+        from tpu_syncbn.audit import run_audit
+        from tpu_syncbn.obs import telemetry
+
+        telemetry.set_enabled(True)
+        telemetry.REGISTRY.reset()
+        try:
+            result = run_audit(
+                contracts=False,
+                pkg_root=os.path.join(ROOT, "tests", "audit_fixtures"),
+            )
+            snap = telemetry.snapshot()
+            counters = snap["counters"]
+            assert counters["audit.runs"] == 1
+            assert counters["audit.files_linted"] == result.files_linted
+            assert counters["audit.violations"] == len(result.violations)
+            assert counters["audit.violations"] > 0
+            for rule, n in result.rule_counts.items():
+                assert counters[f"audit.rule.{rule}"] == n
+        finally:
+            telemetry.set_enabled(None)
+            telemetry.REGISTRY.reset()
+
+    def test_clean_run_reports_zero_violations_counter(self):
+        from tpu_syncbn.audit import run_audit
+        from tpu_syncbn.obs import telemetry
+
+        telemetry.set_enabled(True)
+        telemetry.REGISTRY.reset()
+        try:
+            result = run_audit(contracts=False)
+            assert result.ok
+            assert telemetry.snapshot()["counters"]["audit.violations"] == 0
+        finally:
+            telemetry.set_enabled(None)
+            telemetry.REGISTRY.reset()
+
+
+class TestProgramCacheStats:
+    """ISSUE 6 small fix: cached_program eviction/hit accounting."""
+
+    def test_stats_and_telemetry(self):
+        from tpu_syncbn.obs import telemetry
+        from tpu_syncbn.parallel import scan_driver
+
+        telemetry.set_enabled(True)
+        telemetry.REGISTRY.reset()
+        try:
+            cache = scan_driver.ProgramCache(name="serve")
+            for key in range(scan_driver.MAX_CACHED_PROGRAMS + 2):
+                scan_driver.cached_program(cache, key, lambda k=key: k)
+            scan_driver.cached_program(cache, "hit-me", lambda: "prog")
+            scan_driver.cached_program(cache, "hit-me", lambda: "prog")
+            stats = cache.stats()
+            assert stats == {
+                "live": scan_driver.MAX_CACHED_PROGRAMS,
+                "hits": 1,
+                "misses": scan_driver.MAX_CACHED_PROGRAMS + 3,
+                "evictions": 3,
+            }
+            counters = telemetry.snapshot()["counters"]
+            assert counters["serve.program_cache.hits"] == 1
+            assert counters["serve.program_cache.evictions"] == 3
+        finally:
+            telemetry.set_enabled(None)
+            telemetry.REGISTRY.reset()
+
+    def test_plain_dict_still_works(self):
+        from tpu_syncbn.parallel import scan_driver
+
+        cache: dict = {}
+        assert scan_driver.cached_program(cache, 1, lambda: "x") == "x"
+        assert scan_driver.cached_program(cache, 1, lambda: "y") == "x"
+
+    def test_engine_stats_exposes_cache_accounting(self):
+        import numpy as np
+        import optax
+        from flax import nnx
+
+        from tpu_syncbn import nn as tnn
+        from tpu_syncbn.serve.engine import InferenceEngine
+
+        class Net(nnx.Module):
+            def __init__(self, rngs):
+                self.fc = nnx.Linear(4, 4, rngs=rngs)
+                self.bn = tnn.BatchNorm1d(4)
+
+            def __call__(self, x):
+                return self.bn(self.fc(x))
+
+        eng = InferenceEngine(
+            tnn.convert_sync_batchnorm(Net(nnx.Rngs(0))), buckets=(8,)
+        )
+        batch = np.zeros((8, 4), np.float32)
+        eng.predict(batch)
+        eng.predict(batch)
+        stats = eng.stats()["program_cache"]
+        assert stats["misses"] == 1 and stats["evictions"] == 0
+        assert stats["hits"] >= 1 and stats["live"] == 1

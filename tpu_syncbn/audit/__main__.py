@@ -1,0 +1,134 @@
+"""CLI driver: ``python -m tpu_syncbn.audit [--strict] [--json]``.
+
+Exit codes: 0 — clean; 1 — violations (or, under ``--strict``, traced
+programs with no pinned golden); 2 — usage error.
+
+The contract layer traces programs over the same virtual 8-device CPU
+mesh the test suite uses (goldens record the world they were pinned on),
+so the env is forced *before* jax is imported — running under a live TPU
+tunnel would otherwise silently change every byte estimate.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEVCOUNT_FLAG = "--xla_force_host_platform_device_count=8"
+if _DEVCOUNT_FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + _DEVCOUNT_FLAG
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import argparse  # noqa: E402
+import json  # noqa: E402
+import sys  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_syncbn.audit",
+        description="Static program-contract audit: jaxpr-level "
+        "collective/donation verification + repo-hazard source lint "
+        "(docs/STATIC_ANALYSIS.md).",
+    )
+    parser.add_argument(
+        "--strict", action="store_true",
+        help="traced programs with no pinned golden are failures, "
+        "not warnings",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit one machine-readable JSON report on stdout",
+    )
+    parser.add_argument(
+        "--write-goldens", action="store_true",
+        help="re-pin every program contract under the contracts dir "
+        "(only after an INTENTIONAL program change; the diff review "
+        "is the contract review)",
+    )
+    parser.add_argument(
+        "--contracts-dir", default=None, metavar="DIR",
+        help="golden-contract directory (default: tests/contracts/ "
+        "next to the package)",
+    )
+    parser.add_argument(
+        "--no-contracts", action="store_true",
+        help="source lint only — skips program tracing entirely "
+        "(fast; no mesh, no trainer construction)",
+    )
+    parser.add_argument(
+        "--no-lint", action="store_true",
+        help="contract layer only",
+    )
+    parser.add_argument(
+        "--rules", default=None, metavar="R1,R2",
+        help="comma-separated srclint rule subset (default: all)",
+    )
+    parser.add_argument(
+        "--root", default=None, metavar="PATH",
+        help="lint this source tree instead of the installed package",
+    )
+    args = parser.parse_args(argv)
+
+    if not args.no_contracts:
+        # a site hook may re-select the TPU plugin AFTER the env vars
+        # above (jax.config wins over env) — force the pinned CPU mesh
+        # the goldens were traced on
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from tpu_syncbn import audit
+    from tpu_syncbn.audit.srclint import RULES
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)} "
+                  f"(have: {', '.join(RULES)})", file=sys.stderr)
+            return 2
+
+    if args.write_goldens:
+        from tpu_syncbn.audit import jaxpr_audit
+
+        gdir = args.contracts_dir or jaxpr_audit.default_golden_dir()
+        written = jaxpr_audit.write_goldens(
+            jaxpr_audit.build_contracts(), gdir
+        )
+        for path in written:
+            print(f"pinned {os.path.relpath(path)}")
+        return 0
+
+    result = audit.run_audit(
+        strict=args.strict,
+        lint=not args.no_lint,
+        contracts=not args.no_contracts,
+        golden_dir=args.contracts_dir,
+        pkg_root=args.root,
+        rules=rules,
+    )
+
+    if args.as_json:
+        print(json.dumps(result.to_json(), indent=1, sort_keys=False))
+    else:
+        for v in result.violations:
+            print(v.format())
+        for name in result.unpinned:
+            tag = "FAIL" if args.strict else "warn"
+            print(f"{tag}: program {name!r} has no pinned golden "
+                  "(--write-goldens to pin)")
+        print(
+            f"audit: {result.files_linted} files linted, "
+            f"{result.programs_checked} programs checked, "
+            f"{len(result.violations)} violation(s)"
+            + (f", {len(result.unpinned)} unpinned" if result.unpinned
+               else "")
+        )
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

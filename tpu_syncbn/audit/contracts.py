@@ -1,0 +1,338 @@
+"""Program contracts: what a compiled program is *allowed* to do on the
+wire and with its buffers, extracted statically from the closed jaxpr and
+the StableHLO lowering — never by executing the program.
+
+The paper's claim is that SyncBN changes exactly one thing about the
+compiled step: it inserts a cross-replica reduction of the BN statistics.
+A :class:`ProgramContract` makes that claim (and its siblings — "eval is
+collective-free", "the whole training state is donated") machine-checked:
+
+* **collectives** — named-axis collective primitives counted by kind
+  (``psum``/``all_gather``/``reduce_scatter``/``ppermute``/…), with a
+  statically-estimated bytes-on-wire figure per kind (per-shard input
+  payload × itemsize, the same estimate ``parallel.collectives`` tallies
+  at trace time). Loop bodies (``lax.scan``/``while``/``cond`` branches)
+  are counted ONCE — program text, not execution count — which is exactly
+  what makes the fused K-step contract K-invariant.
+* **donation** — the *declared* donation (the ``donate_argnums`` the
+  trainer asked for) versus the *effective* donation: input leaves the
+  StableHLO lowering actually marked donatable (``tf.aliasing_output`` /
+  ``jax.buffer_donor`` arg attributes). A donation jax silently dropped
+  (dtype/layout mismatch, aliasing conflict) shows up as a declared arg
+  with zero aliased leaves.
+* **host callbacks** — ``pure_callback``/``io_callback``/
+  ``debug_callback`` equations anywhere in the program: a host round-trip
+  in a hot program is a regression, not a feature.
+* **upcasts** — widening float ``convert_element_type`` equations by
+  dtype pair. The BN-stat math accumulates in f32 on purpose
+  (``collectives.reduce_moments``, ``obs.stepstats``); losing those
+  upcasts silently would change numerics, so the count is pinned.
+
+Contracts serialize to JSON and are pinned as goldens under
+``tests/contracts/`` (see :mod:`tpu_syncbn.audit.jaxpr_audit` for the
+program registry and docs/STATIC_ANALYSIS.md for the re-pin workflow).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import re
+from typing import Any, Callable, Iterable, Sequence
+
+#: Bump when the contract JSON shape changes incompatibly.
+CONTRACT_SCHEMA = 1
+
+#: Named-axis collective primitives (jax 0.4 names plus newer aliases —
+#: an unknown collective should fail the contract, not slip past it).
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pgather",
+    "all_gather", "all_to_all", "reduce_scatter", "psum_scatter",
+})
+
+#: Host-callback primitives: any of these in a hot program means a
+#: device→host→device round trip per execution.
+HOST_CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+})
+
+
+@dataclasses.dataclass
+class ProgramContract:
+    """The statically-verifiable communication/memory contract of one
+    compiled program. ``donated_declared`` is per top-level argument
+    label; ``donated_aliased`` maps each label to how many of its leaves
+    the lowering actually marked donatable."""
+
+    name: str
+    world: int
+    collectives: dict[str, int]
+    collective_bytes: dict[str, int]
+    donated_declared: list[str]
+    donated_aliased: dict[str, int]
+    host_callbacks: dict[str, int]
+    upcasts: dict[str, int]
+
+    def to_json(self) -> dict:
+        return {
+            "schema": CONTRACT_SCHEMA,
+            "name": self.name,
+            "world": self.world,
+            "collectives": dict(sorted(self.collectives.items())),
+            "collective_bytes": dict(sorted(self.collective_bytes.items())),
+            "donated_declared": list(self.donated_declared),
+            "donated_aliased": dict(sorted(self.donated_aliased.items())),
+            "host_callbacks": dict(sorted(self.host_callbacks.items())),
+            "upcasts": dict(sorted(self.upcasts.items())),
+        }
+
+    @classmethod
+    def from_json(cls, blob: dict) -> "ProgramContract":
+        if blob.get("schema") != CONTRACT_SCHEMA:
+            raise ValueError(
+                f"contract schema {blob.get('schema')!r} != {CONTRACT_SCHEMA}"
+                " — re-pin the golden (docs/STATIC_ANALYSIS.md)"
+            )
+        return cls(
+            name=blob["name"],
+            world=int(blob["world"]),
+            collectives={k: int(v) for k, v in blob["collectives"].items()},
+            collective_bytes={
+                k: int(v) for k, v in blob["collective_bytes"].items()
+            },
+            donated_declared=list(blob["donated_declared"]),
+            donated_aliased={
+                k: int(v) for k, v in blob["donated_aliased"].items()
+            },
+            host_callbacks={
+                k: int(v) for k, v in blob["host_callbacks"].items()
+            },
+            upcasts={k: int(v) for k, v in blob["upcasts"].items()},
+        )
+
+    @property
+    def total_collectives(self) -> int:
+        return sum(self.collectives.values())
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+
+
+def iter_eqns(jaxpr) -> Iterable[Any]:
+    """Depth-first over every equation of a (closed) jaxpr, recursing
+    into sub-jaxprs carried in equation params (``pjit``/``shard_map``
+    call jaxprs, ``scan``/``while`` bodies, ``cond`` branches, custom-vjp
+    jaxprs). Within one equation, a sub-jaxpr object reachable through
+    several params is visited once — counts are program text, not
+    execution traces (a scan body counts once regardless of length)."""
+    if hasattr(jaxpr, "jaxpr"):  # ClosedJaxpr
+        jaxpr = jaxpr.jaxpr
+    for eqn in jaxpr.eqns:
+        yield eqn
+        seen: set[int] = set()
+        for value in eqn.params.values():
+            subs = value if isinstance(value, (list, tuple)) else (value,)
+            for sub in subs:
+                inner = getattr(sub, "jaxpr", sub)
+                if hasattr(inner, "eqns") and id(inner) not in seen:
+                    seen.add(id(inner))
+                    yield from iter_eqns(inner)
+
+
+def _aval_bytes(aval) -> int:
+    try:
+        import numpy as np
+
+        shape = tuple(getattr(aval, "shape", ()))
+        dtype = getattr(aval, "dtype", None)
+        if dtype is None:
+            return 0
+        return int(math.prod(shape)) * np.dtype(dtype).itemsize
+    except (TypeError, ValueError):
+        return 0
+
+
+def _is_float_upcast(src_dtype, dst_dtype) -> bool:
+    import numpy as np
+    from jax import numpy as jnp
+
+    try:
+        src, dst = jnp.dtype(src_dtype), jnp.dtype(dst_dtype)
+    except TypeError:
+        return False
+    return (
+        jnp.issubdtype(src, np.floating)
+        and jnp.issubdtype(dst, np.floating)
+        and dst.itemsize > src.itemsize
+    )
+
+
+def summarize_jaxpr(closed_jaxpr) -> dict:
+    """One pass over the program text: collective counts + per-shard
+    payload-byte estimates, host-callback counts, and widening-float
+    convert counts by dtype pair."""
+    collectives: dict[str, int] = {}
+    coll_bytes: dict[str, int] = {}
+    callbacks: dict[str, int] = {}
+    upcasts: dict[str, int] = {}
+    for eqn in iter_eqns(closed_jaxpr):
+        prim = eqn.primitive.name
+        if prim in COLLECTIVE_PRIMS:
+            collectives[prim] = collectives.get(prim, 0) + 1
+            nbytes = sum(_aval_bytes(v.aval) for v in eqn.invars
+                         if hasattr(v, "aval"))
+            coll_bytes[prim] = coll_bytes.get(prim, 0) + nbytes
+        elif prim in HOST_CALLBACK_PRIMS:
+            callbacks[prim] = callbacks.get(prim, 0) + 1
+        elif prim == "convert_element_type":
+            invar = eqn.invars[0] if eqn.invars else None
+            src = getattr(getattr(invar, "aval", None), "dtype", None)
+            dst = eqn.params.get("new_dtype")
+            if src is not None and _is_float_upcast(src, dst):
+                key = f"{src}->{dst}"
+                upcasts[key] = upcasts.get(key, 0) + 1
+    return {
+        "collectives": collectives,
+        "collective_bytes": coll_bytes,
+        "host_callbacks": callbacks,
+        "upcasts": upcasts,
+    }
+
+
+# ---------------------------------------------------------------------------
+# donation (StableHLO arg attributes)
+
+_MAIN_SIG_RE = re.compile(
+    r"func\.func\s+(?:public\s+)?@main\((.*?)\)\s*->", re.S
+)
+_ARG_RE = re.compile(r"%arg(\d+): tensor<[^>]*>\s*(\{[^}]*\})?")
+
+
+def aliased_arg_indices(mlir_text: str) -> set[int]:
+    """Flat input indices the lowering marked donatable: args whose
+    attribute dict carries ``tf.aliasing_output`` (aliased to a specific
+    output) or ``jax.buffer_donor`` (donated, XLA chooses the reuse)."""
+    sig = _MAIN_SIG_RE.search(mlir_text)
+    if sig is None:
+        raise ValueError("no @main function signature in lowering text")
+    out: set[int] = set()
+    for idx, attrs in _ARG_RE.findall(sig.group(1)):
+        if attrs and ("tf.aliasing_output" in attrs
+                      or "jax.buffer_donor" in attrs):
+            out.add(int(idx))
+    return out
+
+
+def donation_by_arg(
+    mlir_text: str, arg_labels: Sequence[str], example_args: Sequence[Any]
+) -> dict[str, int]:
+    """Map the lowering's flat donated-arg indices back onto the
+    top-level argument labels via each argument's pytree leaf count.
+    Falls back to an aggregate ``__total__`` entry if the flat arity
+    does not line up (e.g. a lowering that hoisted constants)."""
+    import jax
+
+    aliased = aliased_arg_indices(mlir_text)
+    if not aliased:
+        return {}
+    leaf_counts = [
+        len(jax.tree_util.tree_leaves(a)) for a in example_args
+    ]
+    sig = _MAIN_SIG_RE.search(mlir_text)
+    n_args = len(_ARG_RE.findall(sig.group(1))) if sig else -1
+    if sum(leaf_counts) != n_args:
+        return {"__total__": len(aliased)}
+    out: dict[str, int] = {}
+    offset = 0
+    for label, count in zip(arg_labels, leaf_counts):
+        hit = sum(1 for i in range(offset, offset + count) if i in aliased)
+        if hit:
+            out[label] = hit
+        offset += count
+    return out
+
+
+# ---------------------------------------------------------------------------
+# extraction + comparison
+
+
+def extract_contract(
+    fn: Callable,
+    example_args: Sequence[Any],
+    *,
+    name: str,
+    world: int,
+    arg_labels: Sequence[str],
+    declared_donated: Sequence[str] = (),
+) -> ProgramContract:
+    """Abstractly trace ``fn`` (a jitted callable) on ``example_args``
+    (arrays or ShapeDtypeStructs) and assemble its contract. Nothing is
+    compiled or executed — ``jax.make_jaxpr`` for the program text,
+    ``fn.lower(...)`` for the donation attributes."""
+    import jax
+
+    summary = summarize_jaxpr(jax.make_jaxpr(fn)(*example_args))
+    lowered = fn.lower(*example_args)
+    aliased = donation_by_arg(lowered.as_text(), arg_labels, example_args)
+    return ProgramContract(
+        name=name,
+        world=world,
+        collectives=summary["collectives"],
+        collective_bytes=summary["collective_bytes"],
+        donated_declared=list(declared_donated),
+        donated_aliased=aliased,
+        host_callbacks=summary["host_callbacks"],
+        upcasts=summary["upcasts"],
+    )
+
+
+def compare_contracts(
+    actual: ProgramContract, golden: ProgramContract
+) -> list[str]:
+    """Field-by-field diff; empty list means the program still honors
+    its pinned contract. Messages name the drift precisely — they are
+    the violation text the CLI and the tier-1 tests surface."""
+    diffs: list[str] = []
+
+    def _dict_diff(field: str, a: dict, g: dict) -> None:
+        for key in sorted(set(a) | set(g)):
+            av, gv = a.get(key, 0), g.get(key, 0)
+            if av != gv:
+                diffs.append(
+                    f"{actual.name}: {field}[{key}] = {av}, golden pins {gv}"
+                )
+
+    if actual.world != golden.world:
+        diffs.append(
+            f"{actual.name}: traced on world={actual.world} but golden "
+            f"was pinned on world={golden.world} — contracts are only "
+            "comparable on the pinned mesh"
+        )
+        return diffs
+    _dict_diff("collectives", actual.collectives, golden.collectives)
+    _dict_diff("collective_bytes", actual.collective_bytes,
+               golden.collective_bytes)
+    _dict_diff("host_callbacks", actual.host_callbacks,
+               golden.host_callbacks)
+    _dict_diff("upcasts", actual.upcasts, golden.upcasts)
+    if list(actual.donated_declared) != list(golden.donated_declared):
+        diffs.append(
+            f"{actual.name}: declared donation {actual.donated_declared} "
+            f"!= golden {golden.donated_declared}"
+        )
+    _dict_diff("donated_aliased", actual.donated_aliased,
+               golden.donated_aliased)
+    return diffs
+
+
+def save_contract(contract: ProgramContract, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(contract.to_json(), f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+def load_contract(path: str) -> ProgramContract:
+    with open(path) as f:
+        return ProgramContract.from_json(json.load(f))

@@ -1,0 +1,340 @@
+"""Layer 1 of the program auditor: trace every compiled program the
+stack builds and hold it to its pinned :class:`ProgramContract`.
+
+The registry below builds each program the way the trainers/engine
+actually build it — same step factories, same shard_map specs, same
+donation — on tiny deterministic models over the standard data-parallel
+mesh, then extracts contracts **abstractly** (``jax.make_jaxpr`` +
+``.lower()``; nothing compiles, nothing executes). Audited programs:
+
+* ``dataparallel.train_step`` — the paper's program: BN-stat psum +
+  grad pmean + loss/metric reductions, full state donated.
+* ``dataparallel.zero_guard.train_step`` — ``zero=True`` with the PR 1
+  divergence guard armed: adds the param all_gather, the grad
+  reduce_scatter, and the guard's world-consensus ``pmin``.
+* ``gan.train_step`` — GANTrainer's fused D-then-G program (both
+  updates, both networks' BN stats, replica-0 buffer broadcasts).
+* ``dataparallel.scan_k{1,4}.train_steps`` — the fused K-step scan
+  program at K=1 and K=4. Collectives live in the scan *body*, so the
+  contract is K-invariant by construction — pinned as an explicit
+  cross-program invariant, turning "fusing steps adds no communication"
+  into a regression test.
+* ``serve.eval_bucket8`` — the InferenceEngine bucket program: **zero
+  collectives** (PR 5's collective-free eval claim) and **no donation**
+  (batch inputs are never donated; the staging/batcher may still own
+  them).
+
+Contracts are compared against goldens in ``tests/contracts/``
+(re-pin with ``python -m tpu_syncbn.audit --write-goldens`` after an
+*intentional* change — docs/STATIC_ANALYSIS.md). Golden byte estimates
+depend on the mesh world, so contracts record the world they were pinned
+on (the CLI forces the 8-device CPU mesh the test suite uses).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Sequence
+
+from tpu_syncbn.audit.contracts import (
+    ProgramContract,
+    compare_contracts,
+    extract_contract,
+    load_contract,
+    save_contract,
+)
+from tpu_syncbn.audit.srclint import Violation
+
+#: Mesh world the goldens are pinned on (the test suite's virtual CPU
+#: mesh — conftest.py and the audit CLI both force this device count).
+PINNED_WORLD = 8
+
+_GLOBAL_BATCH = 16
+_FEATURES = 8
+_LATENT = 4
+
+
+def default_golden_dir() -> str:
+    """``tests/contracts/`` next to the package — valid for in-repo use
+    (the CLI accepts ``--contracts-dir`` for anything else)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg), "tests", "contracts")
+
+
+def golden_path(golden_dir: str, name: str) -> str:
+    return os.path.join(golden_dir, f"{name}.json")
+
+
+# ---------------------------------------------------------------------------
+# tiny deterministic models (contract fixtures, not benchmarks)
+
+
+def _tiny_model():
+    from flax import nnx
+
+    from tpu_syncbn import nn as tnn
+
+    class Net(nnx.Module):
+        def __init__(self, rngs):
+            self.fc = nnx.Linear(_FEATURES, _FEATURES, rngs=rngs)
+            self.bn = tnn.BatchNorm1d(_FEATURES)
+
+        def __call__(self, x):
+            return self.bn(self.fc(x))
+
+    return tnn.convert_sync_batchnorm(Net(nnx.Rngs(0)))
+
+
+def _tiny_gan():
+    from flax import nnx
+
+    from tpu_syncbn import nn as tnn
+
+    class G(nnx.Module):
+        def __init__(self, rngs):
+            self.fc = nnx.Linear(_LATENT, _FEATURES, rngs=rngs)
+            self.bn = tnn.BatchNorm1d(_FEATURES)
+
+        def __call__(self, z):
+            return self.bn(self.fc(z))
+
+    class D(nnx.Module):
+        def __init__(self, rngs):
+            self.fc = nnx.Linear(_FEATURES, 1, rngs=rngs)
+            self.bn = tnn.BatchNorm1d(1)
+
+        def __call__(self, x):
+            return self.bn(self.fc(x))
+
+    return (tnn.convert_sync_batchnorm(G(nnx.Rngs(0))),
+            tnn.convert_sync_batchnorm(D(nnx.Rngs(1))))
+
+
+def _mse(m, b):
+    return (m(b) ** 2).mean()
+
+
+def _batch_struct(*lead):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct((*lead, _FEATURES), jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# program registry
+
+
+def _dp_train_step() -> ProgramContract:
+    import optax
+
+    from tpu_syncbn import parallel
+
+    dp = parallel.DataParallel(
+        _tiny_model(), optax.sgd(0.1, momentum=0.9), _mse
+    )
+    return extract_contract(
+        dp._train_step,
+        (dp._param_store, dp.rest, dp.opt_state, _batch_struct(_GLOBAL_BATCH)),
+        name="dataparallel.train_step",
+        world=dp.world,
+        arg_labels=("params", "rest", "opt_state", "batch"),
+        declared_donated=("params", "rest", "opt_state"),
+    )
+
+
+def _dp_zero_guard_train_step() -> ProgramContract:
+    import optax
+
+    from tpu_syncbn import parallel
+
+    dp = parallel.DataParallel(
+        _tiny_model(), optax.adam(1e-3), _mse,
+        zero=True, divergence_guard="skip_step",
+    )
+    return extract_contract(
+        dp._train_step,
+        (dp._param_store, dp.rest, dp.opt_state, _batch_struct(_GLOBAL_BATCH)),
+        name="dataparallel.zero_guard.train_step",
+        world=dp.world,
+        arg_labels=("params", "rest", "opt_state", "batch"),
+        declared_donated=("params", "rest", "opt_state"),
+    )
+
+
+def _dp_scan(k: int) -> ProgramContract:
+    import optax
+
+    from tpu_syncbn import parallel
+
+    dp = parallel.DataParallel(
+        _tiny_model(), optax.sgd(0.1, momentum=0.9), _mse
+    )
+    fn = dp._build_train_steps(k, stacked=True)
+    return extract_contract(
+        fn,
+        (dp._param_store, dp.rest, dp.opt_state,
+         _batch_struct(k, _GLOBAL_BATCH)),
+        name=f"dataparallel.scan_k{k}.train_steps",
+        world=dp.world,
+        arg_labels=("params", "rest", "opt_state", "batches"),
+        declared_donated=("params", "rest", "opt_state"),
+    )
+
+
+def _gan_train_step() -> ProgramContract:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_syncbn import parallel
+
+    g, d = _tiny_gan()
+    gan = parallel.GANTrainer(g, d, optax.adam(1e-4), optax.adam(1e-4))
+    real = _batch_struct(_GLOBAL_BATCH)
+    z = jax.ShapeDtypeStruct((_GLOBAL_BATCH, _LATENT), jnp.float32)
+    return extract_contract(
+        gan._step,
+        (gan.g_params, gan.g_rest, gan.d_params, gan.d_rest,
+         gan.g_opt_state, gan.d_opt_state, real, z, z),
+        name="gan.train_step",
+        world=int(gan.mesh.shape[gan.axis_name]),
+        arg_labels=("g_params", "g_rest", "d_params", "d_rest",
+                    "g_opt_state", "d_opt_state", "real", "z_d", "z_g"),
+        declared_donated=("g_params", "g_rest", "d_params", "d_rest",
+                          "g_opt_state", "d_opt_state"),
+    )
+
+
+def _serve_eval_bucket() -> ProgramContract:
+    import jax
+    import numpy as np
+
+    from tpu_syncbn.serve.engine import InferenceEngine
+
+    eng = InferenceEngine(_tiny_model(), buckets=(8,))
+    bucket = eng.buckets[0]
+    example = np.zeros((bucket, _FEATURES), np.float32)
+    treedef, leafspecs = eng._struct_key(example)
+    fn = jax.jit(eng._sharded_fwd())
+    return extract_contract(
+        fn,
+        (eng._params, eng._rest,
+         eng._bucket_struct(bucket, treedef, leafspecs)),
+        name="serve.eval_bucket8",
+        world=eng.world,
+        arg_labels=("params", "rest", "batch"),
+        declared_donated=(),
+    )
+
+
+PROGRAM_BUILDERS: dict[str, Callable[[], ProgramContract]] = {
+    "dataparallel.train_step": _dp_train_step,
+    "dataparallel.zero_guard.train_step": _dp_zero_guard_train_step,
+    "dataparallel.scan_k1.train_steps": lambda: _dp_scan(1),
+    "dataparallel.scan_k4.train_steps": lambda: _dp_scan(4),
+    "gan.train_step": _gan_train_step,
+    "serve.eval_bucket8": _serve_eval_bucket,
+}
+
+
+def build_contracts(
+    names: Sequence[str] | None = None,
+) -> dict[str, ProgramContract]:
+    """Trace the registered programs and return their live contracts."""
+    picked = list(PROGRAM_BUILDERS) if names is None else list(names)
+    out: dict[str, ProgramContract] = {}
+    for name in picked:
+        out[name] = PROGRAM_BUILDERS[name]()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# invariants + golden comparison
+
+
+def check_invariants(
+    contracts: dict[str, ProgramContract]
+) -> list[Violation]:
+    """Cross-program rules that hold regardless of what the goldens pin
+    — the claims the subsystem exists to machine-check."""
+    out: list[Violation] = []
+
+    def v(rule: str, msg: str) -> None:
+        out.append(Violation(rule=rule, message=msg, path="<jaxpr>", line=0))
+
+    serve = contracts.get("serve.eval_bucket8")
+    if serve is not None:
+        if serve.total_collectives:
+            v("contract.serve_collectives",
+              "serve eval program must be collective-free, found "
+              f"{serve.collectives} — eval BN must normalize with running "
+              "stats (PR 5 claim)")
+        if sum(serve.donated_aliased.values()):
+            v("contract.serve_donation",
+              "serve eval program must not donate any input "
+              f"(batcher/staging may still own the buffers), found "
+              f"{serve.donated_aliased}")
+
+    k1 = contracts.get("dataparallel.scan_k1.train_steps")
+    k4 = contracts.get("dataparallel.scan_k4.train_steps")
+    if k1 is not None and k4 is not None and (
+        k1.collectives != k4.collectives
+        or k1.collective_bytes != k4.collective_bytes
+    ):
+        v("contract.scan_variance",
+          "fused scan program's collectives must be K-invariant "
+          f"(per logical step): K=1 {k1.collectives} vs K=4 "
+          f"{k4.collectives}")
+
+    for name, c in contracts.items():
+        for label in c.donated_declared:
+            if not c.donated_aliased.get(label):
+                v("contract.donation_lost",
+                  f"{name}: argument {label!r} is declared donated but "
+                  "the lowering aliased none of its leaves — jax dropped "
+                  "the donation silently (dtype/layout mismatch?)")
+        if c.host_callbacks:
+            v("contract.host_callback",
+              f"{name}: host callback(s) {c.host_callbacks} inside a hot "
+              "program — every execution pays a device→host round trip")
+    return out
+
+
+def check_goldens(
+    contracts: dict[str, ProgramContract],
+    golden_dir: str,
+) -> tuple[list[Violation], list[str]]:
+    """Compare live contracts to the pinned goldens. Returns
+    ``(violations, unpinned)`` — programs with no golden file are
+    reported separately so the CLI can treat them as warnings
+    (default) or failures (``--strict``)."""
+    violations: list[Violation] = []
+    unpinned: list[str] = []
+    for name, contract in contracts.items():
+        path = golden_path(golden_dir, name)
+        if not os.path.exists(path):
+            unpinned.append(name)
+            continue
+        golden = load_contract(path)
+        for diff in compare_contracts(contract, golden):
+            violations.append(Violation(
+                rule="contract.golden_mismatch", message=diff,
+                path=os.path.relpath(path), line=0,
+            ))
+    return violations, unpinned
+
+
+def write_goldens(
+    contracts: dict[str, ProgramContract], golden_dir: str
+) -> list[str]:
+    """Pin (or re-pin) every contract as a golden JSON file. Returns the
+    written paths. Only do this after an *intentional* program change —
+    the diff review IS the contract review (docs/STATIC_ANALYSIS.md)."""
+    os.makedirs(golden_dir, exist_ok=True)
+    written = []
+    for name, contract in contracts.items():
+        path = golden_path(golden_dir, name)
+        save_contract(contract, path)
+        written.append(path)
+    return written

@@ -1,0 +1,777 @@
+"""Layer 2 of the program auditor: an AST lint enforcing the
+repo-specific hazard rules PRs 1–5 learned the hard way. Each rule is a
+class of bug that actually bit (or nearly bit) this codebase; the rule
+docstrings cite the incident. Every rule has a planted-violation fixture
+under ``tests/audit_fixtures/`` proving it can fire — a rule that cannot
+fire is dead weight (tests/test_audit_srclint.py enforces this).
+
+Suppression: a source line ending in ``# audit: ok`` suppresses every
+rule on that line; ``# audit: ok[rule_id]`` suppresses one rule. Use it
+the way the rule catalog (docs/STATIC_ANALYSIS.md) documents — with a
+reason in a nearby comment.
+
+The rules themselves are stdlib-only (``ast``): no tracing, no
+compilation, no device — fast enough for a pre-commit hook. (The CLI
+still imports the package for file discovery, which pulls in jax; use
+``lint_file``/``lint_source`` directly to lint in isolation.)
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Callable, Iterable, Sequence
+
+#: Telemetry metric-name schema: dotted lowercase with a subsystem
+#: prefix (``serve.latency_s``, ``collectives.psum.bytes``).
+METRIC_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+#: CounterGroup prefixes are a single schema token (the dot is added
+#: when mirroring into the registry).
+PREFIX_RE = re.compile(r"^[a-z0-9_]+$")
+
+_SUPPRESS_RE = re.compile(r"#\s*audit:\s*ok(?:\[([a-z0-9_,\s]+)\])?")
+
+
+@dataclasses.dataclass
+class Violation:
+    """One finding — from either audit layer (srclint rules use real
+    file/line positions; jaxpr-layer rules use ``path='<jaxpr>'``)."""
+
+    rule: str
+    message: str
+    path: str
+    line: int
+    col: int = 0
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# AST helpers
+
+
+def _attach_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._audit_parent = node  # type: ignore[attr-defined]
+
+
+def _parent(node: ast.AST):
+    return getattr(node, "_audit_parent", None)
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain; None for anything dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _enclosing_functions(node: ast.AST) -> Iterable[ast.AST]:
+    cur = _parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield cur
+        cur = _parent(cur)
+
+
+def _in_with_on(node: ast.AST, attr_names: set[str]) -> bool:
+    """Is ``node`` lexically inside a ``with self.<lock>:`` block for any
+    lock attribute in ``attr_names``?"""
+    cur = _parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                d = _dotted(item.context_expr)
+                if d is None and isinstance(item.context_expr, ast.Call):
+                    d = _dotted(item.context_expr.func)
+                if d and d.startswith("self.") and d[5:] in attr_names:
+                    return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        cur = _parent(cur)
+    return False
+
+
+def _first_str_arg(call: ast.Call) -> tuple[str, ast.AST] | None:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value, call.args[0]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# rule: raw_api_bypass
+
+#: APIs that MUST route through compat.py (PR 1: the package has to
+#: import and degrade on the container's jax 0.4.37 / flax 0.10 —
+#: calling the new API directly crashes there). Maps dotted pattern →
+#: the compat replacement to name in the message.
+RAW_APIS: dict[str, str] = {
+    "jax.shard_map": "compat.shard_map",
+    "jax.experimental.shard_map.shard_map": "compat.shard_map",
+    "nnx.merge": "compat.nnx_merge",
+    "nnx.List": "compat.nnx_list",
+    "nnx.Dict": "compat.nnx_dict",
+    "nnx.data": "compat.nnx_data",
+    "nnx.to_pure_dict": "compat.nnx_to_pure_dict",
+    "nnx.replace_by_pure_dict": "compat.nnx_replace_by_pure_dict",
+    "lax.pvary": "collectives.pcast_varying",
+    "jax.lax.pvary": "collectives.pcast_varying",
+    "lax.pcast": "collectives.pcast_varying",
+    "jax.lax.pcast": "collectives.pcast_varying",
+    "lax.axis_size": "compat.axis_size",
+    "jax.lax.axis_size": "compat.axis_size",
+}
+
+#: ``from <module> import <name>`` forms of the same bypasses — the
+#: repo's dominant form in practice (the PR 6 sweep fixed exactly this
+#: in examples/ and benchmarks/). Keyed ``(module, name)``; the dotted
+#: equivalent is used for the allowlist and the message.
+RAW_IMPORT_FROMS: dict[tuple[str, str], str] = {
+    ("jax", "shard_map"): "compat.shard_map",
+    ("jax.experimental", "shard_map"): "compat.shard_map",
+    ("jax.lax", "pvary"): "collectives.pcast_varying",
+    ("jax.lax", "pcast"): "collectives.pcast_varying",
+    ("jax.lax", "axis_size"): "compat.axis_size",
+    ("flax.nnx", "merge"): "compat.nnx_merge",
+}
+
+#: (file suffix, dotted api) pairs allowed to touch the raw API — the
+#: compat shims themselves, and collectives.py as the one documented
+#: home of the VMA cast (``pcast_varying``).
+RAW_API_ALLOW: tuple[tuple[str, str], ...] = (
+    ("tpu_syncbn/compat.py", "*"),
+    ("tpu_syncbn/parallel/collectives.py", "lax.pcast"),
+    ("tpu_syncbn/parallel/collectives.py", "jax.lax.pcast"),
+)
+
+
+def _raw_api_allowed(path: str, api: str) -> bool:
+    norm = path.replace(os.sep, "/")
+    for suffix, allowed in RAW_API_ALLOW:
+        if norm.endswith(suffix) and allowed in ("*", api):
+            return True
+    return False
+
+
+def check_raw_api_bypass(
+    tree: ast.AST, path: str, src_lines: Sequence[str]
+) -> list[Violation]:
+    """``raw_api_bypass``: a current-jax/flax API called directly instead
+    of through ``compat.py``. PR 1's whole point: the raw call is an
+    ImportError/AttributeError on the baked toolchain; the shim picks a
+    documented fallback once at import."""
+    out: list[Violation] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.startswith("jax.experimental.shard_map"):
+                if not _raw_api_allowed(
+                    path, "jax.experimental.shard_map.shard_map"
+                ):
+                    out.append(Violation(
+                        rule="raw_api_bypass", path=path, line=node.lineno,
+                        col=node.col_offset,
+                        message="import of jax.experimental.shard_map — "
+                                "route through compat.shard_map",
+                    ))
+                continue
+            for alias in node.names:
+                repl = RAW_IMPORT_FROMS.get((node.module, alias.name))
+                if repl is None:
+                    continue
+                dotted = f"{node.module}.{alias.name}"
+                if _raw_api_allowed(path, dotted):
+                    continue
+                out.append(Violation(
+                    rule="raw_api_bypass", path=path, line=node.lineno,
+                    col=node.col_offset,
+                    message=f"`from {node.module} import {alias.name}` — "
+                            f"route through {repl} (compat gate for the "
+                            "baked jax/flax toolchain)",
+                ))
+            continue
+        if not isinstance(node, ast.Attribute):
+            continue
+        if isinstance(_parent(node), ast.Attribute):
+            continue  # only the top of each chain
+        dotted = _dotted(node)
+        if dotted is None or dotted not in RAW_APIS:
+            continue
+        if _raw_api_allowed(path, dotted):
+            continue
+        out.append(Violation(
+            rule="raw_api_bypass", path=path, line=node.lineno,
+            col=node.col_offset,
+            message=f"raw API {dotted} — route through {RAW_APIS[dotted]} "
+                    "(compat gate for the baked jax/flax toolchain)",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule: host_sync_in_step
+
+#: Function names whose *nested* functions are step bodies / traced
+#: closures — the step factories of the stack. A host sync inside one
+#: executes at TRACE time (usually an error under jit) or, worse, forces
+#: a device sync per step.
+STEP_BUILDER_RE = re.compile(
+    r"^(_make_step_fn|_build_train_steps?|_build_eval_step|_build_step"
+    r"|build_scan_steps|_microbatch_grads|_sharded_fwd|_program|generate)$"
+)
+
+#: Call targets that trace their function argument (marking it, and
+#: everything nested in it, as device code).
+TRACE_ENTRIES = {
+    "shard_map", "compat.shard_map", "jax.jit", "jax.checkpoint",
+    "jax.remat", "jax.grad", "jax.value_and_grad", "jax.vmap",
+    "jax.lax.scan", "lax.scan",
+}
+
+#: Host-sync calls that must never appear in traced code: each one
+#: either fails at trace time or forces a device→host roundtrip.
+HOST_SYNC_DOTTED = {"np.asarray", "np.array", "numpy.asarray",
+                    "numpy.array", "jax.device_get"}
+HOST_SYNC_ATTRS = {"item", "block_until_ready"}
+
+
+def _walk_own_body(fdef: ast.AST) -> Iterable[ast.AST]:
+    """Every node of ``fdef`` EXCLUDING the subtrees of nested
+    function/class definitions (lambdas are descended into — they share
+    the enclosing trace context)."""
+    stack = list(ast.iter_child_nodes(fdef))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _traced_functions(tree: ast.AST) -> set[ast.AST]:
+    """FunctionDefs that end up inside a compiled program: nested in a
+    step-builder method, or passed by name to a tracing entry point."""
+    traced: set[ast.AST] = set()
+    defs_by_name: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+            if any(STEP_BUILDER_RE.match(f.name)
+                   for f in _enclosing_functions(node)):
+                traced.add(node)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if dotted not in TRACE_ENTRIES:
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Name):
+                for fdef in defs_by_name.get(arg.id, ()):
+                    traced.add(fdef)
+    # close over nesting: anything inside a traced def is traced
+    closed: set[ast.AST] = set(traced)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(f in traced for f in _enclosing_functions(node)):
+                closed.add(node)
+    return closed
+
+
+def check_host_sync_in_step(
+    tree: ast.AST, path: str, src_lines: Sequence[str]
+) -> list[Violation]:
+    """``host_sync_in_step``: ``.item()`` / ``np.asarray`` /
+    ``.block_until_ready()`` / ``jax.device_get`` inside step-building
+    code. Inside a trace these either fail (ConcretizationTypeError) or
+    silently pin a per-step host sync — the exact overhead class PR 4
+    moved off the hot path."""
+    out: list[Violation] = []
+    traced = _traced_functions(tree)
+    for fdef in traced:
+        # shallow walk: nested defs are their own traced entries — a
+        # hit inside one must be reported exactly once
+        for node in _walk_own_body(fdef):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            hit = None
+            if dotted in HOST_SYNC_DOTTED:
+                hit = dotted
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in HOST_SYNC_ATTRS:
+                hit = f".{node.func.attr}()"
+            if hit:
+                out.append(Violation(
+                    rule="host_sync_in_step", path=path, line=node.lineno,
+                    col=node.col_offset,
+                    message=f"host-sync call {hit} inside step-building "
+                            f"function {fdef.name!r} — this code is traced "
+                            "into the compiled program",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule: donate_after_use
+
+#: Internal dispatch attributes whose calls consume (donate) the state
+#: buffers passed to them — after the call those arrays are invalid.
+DONATING_ATTRS = {"_train_step", "_step", "_gen_step"}
+#: Factory calls whose result is a donating compiled program.
+DONATING_FACTORIES = ("cached_program", "build_scan_steps")
+
+
+def check_donate_after_use(
+    tree: ast.AST, path: str, src_lines: Sequence[str]
+) -> list[Violation]:
+    """``donate_after_use``: a ``self.<state>`` buffer read after being
+    passed to a donating dispatch without being rebound — the PR 4
+    ``snapshot_to_host`` hazard class (donated jit invalidates the
+    input buffers; a snapshot that merely references them reads garbage
+    or crashes). Aliases (``snap = self._param_store``) taken before
+    the dispatch are tracked too."""
+    out: list[Violation] = []
+    for fdef in ast.walk(tree):
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        donating_names: set[str] = set()
+        aliases: dict[str, str] = {}  # local name -> self.<attr> expr
+        donated: dict[str, int] = {}  # dotted expr -> donating lineno
+        statements = list(_statements_in_order(fdef))
+        for stmt in statements:
+            calls = [n for n in ast.walk(stmt) if isinstance(n, ast.Call)]
+            # 1. reads of already-donated buffers in this statement
+            for node in ast.walk(stmt):
+                dotted = None
+                if isinstance(node, ast.Attribute) \
+                        and isinstance(node.ctx, ast.Load):
+                    dotted = _dotted(node)
+                elif isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Load):
+                    dotted = aliases.get(node.id)
+                if dotted and dotted in donated:
+                    out.append(Violation(
+                        rule="donate_after_use", path=path,
+                        line=node.lineno, col=node.col_offset,
+                        message=f"{dotted} read after being donated to a "
+                                f"compiled dispatch on line "
+                                f"{donated[dotted]} — copy before donation "
+                                "(utils.checkpoint.snapshot_to_host) or "
+                                "rebind from the dispatch result",
+                    ))
+            # 2. donations made by this statement
+            for call in calls:
+                if not _is_donating_call(call, donating_names):
+                    continue
+                for arg in call.args:
+                    d = _dotted(arg) if isinstance(arg, ast.Attribute) \
+                        else aliases.get(arg.id) \
+                        if isinstance(arg, ast.Name) else None
+                    if d and d.startswith("self."):
+                        donated[d] = call.lineno
+            # 3. rebinds clear the donated/alias state
+            for target_expr in _assigned_exprs(stmt):
+                donated.pop(target_expr, None)
+                for alias, ref in list(aliases.items()):
+                    if ref == target_expr:
+                        aliases.pop(alias)
+            # 4. track new aliases and donating-factory bindings
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                name = stmt.targets[0].id
+                val = stmt.value
+                vd = _dotted(val)
+                if vd and vd.startswith("self."):
+                    if vd[5:].split(".")[0] in DONATING_ATTRS:
+                        donating_names.add(name)
+                    else:
+                        aliases[name] = vd
+                elif isinstance(val, ast.Call):
+                    fd = _dotted(val.func) or ""
+                    if fd.split(".")[-1] in DONATING_FACTORIES:
+                        donating_names.add(name)
+                    else:
+                        aliases.pop(name, None)
+                        donating_names.discard(name)
+                else:
+                    aliases.pop(name, None)
+                    donating_names.discard(name)
+    return out
+
+
+def _is_donating_call(call: ast.Call, donating_names: set[str]) -> bool:
+    if isinstance(call.func, ast.Attribute):
+        d = _dotted(call.func)
+        return bool(d and d.startswith("self.")
+                    and call.func.attr in DONATING_ATTRS)
+    if isinstance(call.func, ast.Name):
+        return call.func.id in donating_names
+    return False
+
+
+def _statements_in_order(fdef: ast.AST) -> Iterable[ast.stmt]:
+    """The function's statements in source order, recursing into control
+    flow but NOT into nested function definitions."""
+    def rec(body):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            yield stmt
+            for field in ("body", "orelse", "finalbody"):
+                yield from rec(getattr(stmt, field, []) or [])
+            for handler in getattr(stmt, "handlers", []) or []:
+                yield from rec(handler.body)
+    yield from rec(fdef.body)
+
+
+def _assigned_exprs(stmt: ast.stmt) -> list[str]:
+    out: list[str] = []
+    targets: list[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    flat: list[ast.AST] = []
+    while targets:
+        t = targets.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            targets.extend(t.elts)
+        else:
+            flat.append(t)
+    for t in flat:
+        d = _dotted(t)
+        if d:
+            out.append(d)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule: unlocked_shared_state
+
+#: Methods of a lock-owning class that mutate a shared container in
+#: place must do it under the lock. These are the in-place mutators.
+CONTAINER_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "clear", "update",
+    "add", "discard", "popitem", "setdefault", "appendleft", "popleft",
+}
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+
+def check_unlocked_shared_state(
+    tree: ast.AST, path: str, src_lines: Sequence[str]
+) -> list[Violation]:
+    """``unlocked_shared_state``: in a class that owns a lock (it
+    created ``threading.Lock/RLock/Condition`` in ``__init__``), an
+    in-place mutation of a shared container attribute — or a
+    ``+=``/``-=`` on a shared numeric counter (non-atomic
+    read-modify-write, the AsyncCheckpointer ``_pending`` discipline) —
+    outside a ``with self.<lock>:`` block. The threaded modules
+    (serve/batcher.py, AsyncCheckpointer, loader staging) live and die
+    by this discipline — a torn dict update under a watchdog thread is
+    a heisenbug, not a test failure."""
+    out: list[Violation] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        init = next((n for n in cls.body
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name == "__init__"), None)
+        if init is None:
+            continue
+        lock_attrs: set[str] = set()
+        container_attrs: set[str] = set()
+        counter_attrs: set[str] = set()
+        for stmt in ast.walk(init):
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            else:
+                continue
+            for target in targets:
+                d = _dotted(target)
+                if not d or not d.startswith("self.") or "." in d[5:]:
+                    continue
+                attr = d[5:]
+                if _creates_lock(value):
+                    lock_attrs.add(attr)
+                elif _creates_container(value):
+                    container_attrs.add(attr)
+                elif isinstance(value, ast.Constant) \
+                        and isinstance(value.value, (int, float)) \
+                        and not isinstance(value.value, bool):
+                    counter_attrs.add(attr)
+        if not lock_attrs or not (container_attrs or counter_attrs):
+            continue
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)) \
+                    or method.name == "__init__":
+                continue
+            for node in ast.walk(method):
+                attr = _mutated_container_attr(node, container_attrs)
+                if attr is None and isinstance(node, ast.AugAssign):
+                    d = _dotted(node.target)
+                    if d and d.startswith("self.") \
+                            and d[5:] in counter_attrs:
+                        attr = d[5:]
+                if attr is None:
+                    continue
+                if _in_with_on(node, lock_attrs):
+                    continue
+                out.append(Violation(
+                    rule="unlocked_shared_state", path=path,
+                    line=node.lineno, col=node.col_offset,
+                    message=f"self.{attr} mutated outside "
+                            f"`with self.<lock>:` in {cls.name}."
+                            f"{method.name} — this class owns "
+                            f"{sorted(lock_attrs)} precisely because its "
+                            "state is shared across threads",
+                ))
+    return out
+
+
+def _creates_lock(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    d = _dotted(value.func) or ""
+    return d.split(".")[-1] in _LOCK_FACTORIES
+
+
+def _creates_container(value: ast.AST) -> bool:
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                          ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        d = _dotted(value.func) or ""
+        return d.split(".")[-1] in {"dict", "list", "set", "deque",
+                                    "defaultdict", "OrderedDict"}
+    if isinstance(value, ast.BinOp):  # e.g. [0] * (n + 1)
+        return _creates_container(value.left) \
+            or _creates_container(value.right)
+    return False
+
+
+def _mutated_container_attr(
+    node: ast.AST, container_attrs: set[str]
+) -> str | None:
+    def attr_of(expr: ast.AST) -> str | None:
+        d = _dotted(expr)
+        if d and d.startswith("self.") and d[5:] in container_attrs:
+            return d[5:]
+        return None
+
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                hit = attr_of(t.value)
+                if hit:
+                    return hit
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                hit = attr_of(t.value)
+                if hit:
+                    return hit
+    elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if node.func.attr in CONTAINER_MUTATORS:
+            return attr_of(node.func.value)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# rule: telemetry_name_schema
+
+_TELEMETRY_HELPERS = {"count", "observe", "set_gauge", "timed"}
+_REGISTRY_METHODS = {"counter", "gauge", "histogram"}
+
+
+def check_telemetry_name_schema(
+    tree: ast.AST, path: str, src_lines: Sequence[str]
+) -> list[Violation]:
+    """``telemetry_name_schema``: literal metric names must be dotted
+    lowercase with a subsystem prefix (``serve.latency_s``) and
+    ``CounterGroup`` prefixes a single token — the export/merge
+    contract (docs/OBSERVABILITY.md) and the cross-round bench trend
+    tooling both key on it."""
+    out: list[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        func_name = func.id if isinstance(func, ast.Name) else (
+            func.attr if isinstance(func, ast.Attribute) else None
+        )
+        if func_name == "CounterGroup":
+            for kw in node.keywords:
+                if kw.arg == "prefix" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    if not PREFIX_RE.match(kw.value.value):
+                        out.append(Violation(
+                            rule="telemetry_name_schema", path=path,
+                            line=kw.value.lineno, col=kw.value.col_offset,
+                            message=f"CounterGroup prefix "
+                                    f"{kw.value.value!r} must match "
+                                    f"{PREFIX_RE.pattern}",
+                        ))
+            continue
+        if not isinstance(func, ast.Attribute):
+            continue
+        base = _dotted(func.value) or ""
+        checked = None
+        if func.attr in _TELEMETRY_HELPERS and base.endswith("telemetry"):
+            checked = _first_str_arg(node)
+        elif func.attr in _REGISTRY_METHODS and (
+            "registry" in base.lower() or base.endswith("REGISTRY")
+        ):
+            checked = _first_str_arg(node)
+        if checked is None:
+            continue
+        name, lit = checked
+        if not METRIC_NAME_RE.match(name):
+            out.append(Violation(
+                rule="telemetry_name_schema", path=path, line=lit.lineno,
+                col=lit.col_offset,
+                message=f"telemetry name {name!r} does not match the "
+                        f"schema {METRIC_NAME_RE.pattern} "
+                        "(subsystem-dotted lowercase)",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule: unpaired_trace_span
+
+_SPAN_MAKERS_ATTR = {"span", "timed", "timed_span"}
+
+
+def check_unpaired_trace_span(
+    tree: ast.AST, path: str, src_lines: Sequence[str]
+) -> list[Violation]:
+    """``unpaired_trace_span``: a span/timer context manager created and
+    discarded (``tracer.span("x")`` as a bare statement) — the span is
+    never entered, so it never closes, and the trace silently loses the
+    region. Spans must be ``with``-entered (or returned/stored for a
+    caller's ``with``)."""
+    out: list[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Expr) or not isinstance(node.value,
+                                                            ast.Call):
+            continue
+        call = node.value
+        name = None
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in _SPAN_MAKERS_ATTR:
+            base = _dotted(call.func.value) or ""
+            # tracer.span / tracing.span / telemetry.timed /
+            # obs_stepstats.timed_span — not arbitrary .timed attrs
+            if call.func.attr == "timed" and not base.endswith("telemetry"):
+                continue
+            name = _dotted(call.func)
+        elif isinstance(call.func, ast.Name) \
+                and call.func.id == "timed_span":
+            name = "timed_span"
+        if name is None:
+            continue
+        out.append(Violation(
+            rule="unpaired_trace_span", path=path, line=node.lineno,
+            col=node.col_offset,
+            message=f"{name}(...) creates a context manager that is "
+                    "immediately discarded — the span is never "
+                    "entered/closed; use `with {0}(...):`".format(name),
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+RULES: dict[str, Callable] = {
+    "raw_api_bypass": check_raw_api_bypass,
+    "host_sync_in_step": check_host_sync_in_step,
+    "donate_after_use": check_donate_after_use,
+    "unlocked_shared_state": check_unlocked_shared_state,
+    "telemetry_name_schema": check_telemetry_name_schema,
+    "unpaired_trace_span": check_unpaired_trace_span,
+}
+
+
+def _suppressed(src_lines: Sequence[str], v: Violation) -> bool:
+    if not v.line or v.line > len(src_lines):
+        return False
+    m = _SUPPRESS_RE.search(src_lines[v.line - 1])
+    if not m:
+        return False
+    rules = m.group(1)
+    if rules is None:
+        return True
+    return v.rule in {r.strip() for r in rules.split(",")}
+
+
+def lint_file(path: str, *, rules: Sequence[str] | None = None) -> list[Violation]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return lint_source(src, path, rules=rules)
+
+
+def lint_source(
+    src: str, path: str, *, rules: Sequence[str] | None = None
+) -> list[Violation]:
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Violation(rule="parse_error", path=path,
+                          line=e.lineno or 0,
+                          message=f"file does not parse: {e.msg}")]
+    _attach_parents(tree)
+    src_lines = src.splitlines()
+    out: list[Violation] = []
+    for rule_id in (rules if rules is not None else RULES):
+        for v in RULES[rule_id](tree, path, src_lines):
+            if not _suppressed(src_lines, v):
+                out.append(v)
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return out
+
+
+def package_files(pkg_root: str | None = None) -> list[str]:
+    """Every ``.py`` file of the installed ``tpu_syncbn`` package (or an
+    explicit root), sorted for deterministic output."""
+    if pkg_root is None:
+        import tpu_syncbn
+
+        pkg_root = os.path.dirname(os.path.abspath(tpu_syncbn.__file__))
+    files: list[str] = []
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                files.append(os.path.join(dirpath, fn))
+    return sorted(files)
+
+
+def lint_package(
+    pkg_root: str | None = None, *, rules: Sequence[str] | None = None
+) -> list[Violation]:
+    out: list[Violation] = []
+    for path in package_files(pkg_root):
+        out.extend(lint_file(path, rules=rules))
+    return out

@@ -115,7 +115,10 @@ class GANTrainer:
 
         self._donate = bool(donate)
         self._step = self._build_step(donate)
-        self._train_steps_cache: dict = {}  # n_steps -> scanned jit
+        from tpu_syncbn.parallel import scan_driver
+
+        # n_steps -> scanned jit (FIFO-bounded, hit/miss/eviction counted)
+        self._train_steps_cache = scan_driver.ProgramCache(name="gan")
 
     def _make_step_fn(self):
         """The pure per-device step body

@@ -142,14 +142,58 @@ def build_scan_steps(
     return jax.jit(sharded, donate_argnums=donate_argnums)
 
 
+class ProgramCache(dict):
+    """A compiled-program cache dict with hit/miss/eviction accounting.
+
+    Plain ``dict`` semantics (the historical cache shape — existing
+    pickling/inspection keeps working), plus counters that make the
+    FIFO-4 policy measurable: ROADMAP item 4's "cache smarter than
+    FIFO-4" needs a hit rate to argue from. When ``name`` is given,
+    every event also lands in the telemetry registry as
+    ``<name>.program_cache.{hits,misses,evictions}``
+    (docs/OBSERVABILITY.md)."""
+
+    def __init__(self, name: str | None = None):
+        super().__init__()
+        self.name = name
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def _record(self, event: str) -> None:
+        setattr(self, event, getattr(self, event) + 1)
+        if self.name is not None:
+            from tpu_syncbn.obs import telemetry
+
+            telemetry.count(f"{self.name}.program_cache.{event}")
+
+    def stats(self) -> dict:
+        """Accounting snapshot: programs currently live plus lifetime
+        hits/misses/evictions (hit rate = hits / (hits + misses))."""
+        return {
+            "live": len(self),
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+        }
+
+
 def cached_program(cache: dict, key, build: Callable[[], Any]):
     """FIFO-bounded compiled-program retention shared by the trainers'
     fused-step caches: at most :data:`MAX_CACHED_PROGRAMS` distinct
     programs stay live; beyond that the oldest is evicted (a varying K
-    pays a fresh compile every call — call with a FIXED chunk size)."""
+    pays a fresh compile every call — call with a FIXED chunk size).
+    ``cache`` is ideally a :class:`ProgramCache` (hit/miss/eviction
+    accounting); a plain dict still works."""
+    record = cache._record if isinstance(cache, ProgramCache) \
+        else lambda event: None
     fn = cache.get(key)
     if fn is None:
+        record("misses")
         while len(cache) >= MAX_CACHED_PROGRAMS:
             cache.pop(next(iter(cache)))
+            record("evictions")
         fn = cache[key] = build()
+    else:
+        record("hits")
     return fn

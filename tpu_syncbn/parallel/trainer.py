@@ -411,7 +411,10 @@ class DataParallel:
 
         self._donate = donate
         self._train_step = self._build_train_step(donate)
-        self._train_steps_cache: dict = {}  # n_steps -> scanned jit
+        from tpu_syncbn.parallel import scan_driver
+
+        # n_steps -> scanned jit (FIFO-bounded, hit/miss/eviction counted)
+        self._train_steps_cache = scan_driver.ProgramCache(name="train")
         self._eval_step = self._build_eval_step()
 
     # -- step builders ----------------------------------------------------

@@ -147,7 +147,11 @@ class InferenceEngine:
         # VMA checker follows the trainer's gate
         self._check_vma = compat.HAS_VMA and not _pallas_forces_vma_off(model)
 
-        self._programs: dict = {}  # FIFO-bounded via scan_driver
+        from tpu_syncbn.parallel import scan_driver
+
+        # FIFO-bounded via scan_driver; hit/miss/eviction accounted so
+        # the bucket-program cache hit rate is measurable (ROADMAP 4)
+        self._programs = scan_driver.ProgramCache(name="serve")
         self._programs_compiled = 0
 
     # -- construction ------------------------------------------------------
@@ -195,6 +199,44 @@ class InferenceEngine:
             (tuple(np.shape(l)[1:]), str(np.asarray(l).dtype)) for l in leaves
         )
 
+    def _sharded_fwd(self):
+        """The uncompiled sharded eval function ``(params, rest, batch)
+        -> out``: replicated state in, batch split over the data axis
+        (the batch's structure flows in through the argument, not the
+        program text). This is what the audit layer traces
+        (:mod:`tpu_syncbn.audit.jaxpr_audit`) — :meth:`_program` compiles
+        exactly this, so the pinned contract is the shipped program."""
+        from jax.sharding import PartitionSpec as P
+
+        from tpu_syncbn import compat
+        from tpu_syncbn.compat import shard_map
+
+        def fwd(params, rest, b):
+            model = compat.nnx_merge(self.graphdef, params, rest, copy=True)
+            model.eval()
+            return self._apply_fn(model, b)
+
+        return shard_map(
+            fwd,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(self.axis_name)),
+            out_specs=P(self.axis_name),
+            check_vma=self._check_vma,
+        )
+
+    def _bucket_struct(self, bucket: int, treedef, leafspecs):
+        """``ShapeDtypeStruct`` pytree for a padded ``bucket``-sized batch
+        of this structure, sharded like the real input."""
+        import jax
+
+        return jax.tree_util.tree_unflatten(treedef, [
+            jax.ShapeDtypeStruct(
+                (bucket,) + shape, np.dtype(dtype),
+                sharding=self.batch_sharding,
+            )
+            for shape, dtype in leafspecs
+        ])
+
     def _program(self, bucket: int, batch):
         """The AOT-compiled eval executable for ``bucket`` and this
         batch's structure (leaf shapes beyond the batch axis + dtypes).
@@ -202,10 +244,7 @@ class InferenceEngine:
         ``MAX_CACHED_PROGRAMS`` distinct programs stay live, FIFO
         beyond."""
         import jax
-        from jax.sharding import PartitionSpec as P
 
-        from tpu_syncbn import compat
-        from tpu_syncbn.compat import shard_map
         from tpu_syncbn.obs import telemetry
         from tpu_syncbn.parallel import scan_driver
 
@@ -213,25 +252,8 @@ class InferenceEngine:
         key = (bucket, treedef, leafspecs)
 
         def build():
-            def fwd(params, rest, b):
-                model = compat.nnx_merge(self.graphdef, params, rest, copy=True)
-                model.eval()
-                return self._apply_fn(model, b)
-
-            sharded = shard_map(
-                fwd,
-                mesh=self.mesh,
-                in_specs=(P(), P(), P(self.axis_name)),
-                out_specs=P(self.axis_name),
-                check_vma=self._check_vma,
-            )
-            sds = jax.tree_util.tree_unflatten(treedef, [
-                jax.ShapeDtypeStruct(
-                    (bucket,) + shape, np.dtype(dtype),
-                    sharding=self.batch_sharding,
-                )
-                for shape, dtype in leafspecs
-            ])
+            sharded = self._sharded_fwd()
+            sds = self._bucket_struct(bucket, treedef, leafspecs)
             with telemetry.timed("serve.compile_s"):
                 compiled = jax.jit(sharded).lower(
                     self._params, self._rest, sds
@@ -253,11 +275,13 @@ class InferenceEngine:
     def stats(self) -> dict:
         """Program-cache accounting for the serve block / monitoring:
         configured buckets, total programs ever compiled, programs
-        currently live (FIFO bound)."""
+        currently live (FIFO bound), and the cache's lifetime
+        hits/misses/evictions (hit rate = hits / (hits + misses))."""
         return {
             "buckets": list(self.buckets),
             "programs_compiled": self._programs_compiled,
             "programs_live": len(self._programs),
+            "program_cache": self._programs.stats(),
         }
 
     # -- execution ---------------------------------------------------------
