@@ -621,6 +621,159 @@ def measure_serve(dp, batch, *, n_chips: int) -> dict:
     }
 
 
+def measure_monitor(agg) -> dict:
+    """The ``monitor`` block of the bench line: the live-monitoring
+    layer (docs/OBSERVABILITY.md "Live monitoring"), benchmarked on the
+    run's own metrics.
+
+    Spins an ephemeral :class:`~tpu_syncbn.obs.server.MonitoringServer`
+    on port 0 sharing the run's windowed aggregator (``agg`` was ticked
+    around the timed loop) and reports:
+
+    * ``metrics_fetch_s`` / ``exposition_bytes`` / ``series`` — one
+      ``/metrics`` scrape end to end (render + HTTP), the latency a
+      Prometheus scraper would pay against this process;
+    * ``healthz_ok`` / ``readyz_ok`` — the probe endpoints answer;
+    * ``window_agreement`` — windowed ``step.time_s`` count over the
+      cumulative count: the delta layer saw exactly the steps the
+      registry did (1.0 = no samples lost between ticks);
+    * rolling ``steps_per_s_windowed`` / ``step_p99_s_windowed`` and one
+      SLO evaluation (``step.time_s p99 < 60`` — a liveness-grade
+      objective any healthy run meets) with its burn rate, proving the
+      alert path computes on real data.
+
+    Schema pinned by tests/test_bench_tooling.py."""
+    import urllib.error
+    from urllib.request import urlopen
+
+    from tpu_syncbn.obs import server as obs_server, slo as obs_slo, telemetry
+
+    def probe(url):
+        """(status, body) without raising on 5xx — a 503 readiness
+        answer is a *measurement* (readyz_ok: false), not a failure
+        that should null the whole block."""
+        try:
+            with urlopen(url, timeout=30) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    srv = obs_server.MonitoringServer(port=0, host="127.0.0.1",
+                                      aggregator=agg)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        t0 = time.perf_counter()
+        status, body = probe(base + "/metrics")
+        fetch_s = time.perf_counter() - t0
+        if status != 200:
+            raise RuntimeError(f"/metrics answered {status}")
+        healthz_ok = probe(base + "/healthz")[0] == 200
+        readyz_ok = probe(base + "/readyz")[0] == 200
+    finally:
+        srv.close()
+
+    windowed = agg.windowed_snapshot()
+    telemetry.validate_snapshot(windowed)
+    w_steps = windowed["histograms"].get("step.time_s", {}).get("count", 0)
+    c_steps = telemetry.snapshot()["histograms"].get(
+        "step.time_s", {}).get("count", 0)
+    tracker = obs_slo.SLOTracker(agg, [obs_slo.AlertRule(
+        "bench_step", "step.time_s p99 < 60", windows_s=(3600.0,),
+    )])
+    tracker.evaluate()
+    state = tracker.state()["bench_step"]
+    burns = [b for b in state["burns"].values() if b is not None]
+    p99 = agg.quantile("step.time_s", 0.99)
+    rate = agg.rate("step.time_s")
+    return {
+        "port": srv.port,
+        "metrics_fetch_s": round(fetch_s, 6),
+        "exposition_bytes": len(body),
+        "series": body.count(b"# TYPE "),
+        "healthz_ok": bool(healthz_ok),
+        "readyz_ok": bool(readyz_ok),
+        "windowed_steps": w_steps,
+        "cumulative_steps": c_steps,
+        "window_agreement": round(w_steps / c_steps, 4) if c_steps else None,
+        "steps_per_s_windowed": round(rate, 4) if rate is not None else None,
+        "step_p99_s_windowed": round(p99, 6) if p99 is not None else None,
+        "slo_burn_rate": round(max(burns), 4) if burns else None,
+        "slo_firing": bool(state["firing"]),
+    }
+
+
+def check_regression(
+    line: dict, *, baseline_path: str = _BASELINE_PATH,
+    tolerance: float = 0.1,
+) -> list[str]:
+    """The ``--check-regression`` CI gate: compare the emitted JSON
+    line against every entry of BASELINE.json's ``published`` map and
+    return the list of regressions (empty = pass; the CLI exits 1 on
+    any).
+
+    A published key is either the headline metric name (compared
+    against ``line["value"]``) or a dotted path into the line
+    (``serve.latency_p99_ms`` → ``line["serve"]["latency_p99_ms"]``).
+    Entries are a bare number (higher-is-better, default tolerance) or
+    ``{"value": N, "direction": "higher"|"lower", "tolerance": t}`` —
+    latency-style metrics declare ``"lower"``. A key the line cannot
+    resolve (e.g. a serve metric on a run without ``--serve``) is
+    skipped with a stderr note, not failed — but an unusable baseline
+    file IS a failure: a gate that silently passes on a corrupt anchor
+    is worse than no gate."""
+    try:
+        with open(baseline_path) as f:
+            published = json.load(f).get("published", {})
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"BASELINE.json unusable for --check-regression: {e}"]
+    if not isinstance(published, dict):
+        return ["BASELINE.json 'published' is not a map"]
+    failures: list[str] = []
+    for key, entry in sorted(published.items()):
+        base, direction, tol = entry, "higher", tolerance
+        if isinstance(entry, dict):
+            base = entry.get("value")
+            direction = entry.get("direction", "higher")
+            tol = float(entry.get("tolerance", tolerance))
+        if not isinstance(base, (int, float)) or isinstance(base, bool) \
+                or base <= 0:
+            failures.append(f"{key}: unusable published value {base!r}")
+            continue
+        if direction not in ("higher", "lower"):
+            failures.append(f"{key}: unknown direction {direction!r}")
+            continue
+        value = _resolve_metric(line, key)
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            log(f"check-regression: {key} not in this line "
+                f"(got {value!r}); skipped")
+            continue
+        ratio = value / base
+        if direction == "higher" and ratio < 1.0 - tol:
+            failures.append(
+                f"{key}: {value:g} is {1.0 - ratio:.1%} below the "
+                f"published {base:g} (tolerance {tol:.1%})"
+            )
+        elif direction == "lower" and ratio > 1.0 + tol:
+            failures.append(
+                f"{key}: {value:g} is {ratio - 1.0:.1%} above the "
+                f"published {base:g} (tolerance {tol:.1%})"
+            )
+    return failures
+
+
+def _resolve_metric(line: dict, key: str):
+    """``key`` is the headline metric name or a dotted path into the
+    bench line (``serve.latency_p99_ms``, ``monitor.metrics_fetch_s``)."""
+    if key == line.get("metric"):
+        return line.get("value")
+    cur = line
+    for part in key.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
 def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
     """``trace_path`` (the ``--trace`` flag) writes a Chrome trace-event
     JSON of the run — data-wait/step/checkpoint spans — that loads
@@ -695,6 +848,15 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
         steps *= 6
         log(f"compile was a cache hit ({warm_s:.1f}s); extending to {steps} steps")
 
+    # windowed aggregation (obs.timeseries): anchored right before the
+    # timed loop and ticked right after, so the ring holds exactly the
+    # loop's deltas — the monitor block's windowed-vs-cumulative
+    # agreement check reads from this
+    from tpu_syncbn.obs import timeseries
+
+    agg = timeseries.WindowedAggregator()
+    agg.tick()
+
     # instrumented loop: per-step "data_wait"/"step" spans + the
     # step.time_s histogram (host DISPATCH time per step — jax dispatch
     # is async, the final fetch_sync settles the chain). perf_counter
@@ -707,6 +869,7 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
     fetch_sync(out.loss)  # the final loss value transitively forces
     # every step in the donated-state chain
     dt = time.perf_counter() - t0
+    agg.tick()  # close the timed loop's window frame
     telemetry.set_gauge("step.wall_avg_s", dt / steps)  # incl. device time
 
     img_per_sec = global_batch * steps / dt
@@ -815,6 +978,20 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
             log(f"serve measurement failed: {type(e).__name__}: {e}")
             serve_info = None
 
+    # live-monitoring layer benchmarked on the run's own metrics
+    # (docs/OBSERVABILITY.md "Live monitoring") — an annotation, never
+    # fatal to the metric
+    try:
+        with stepstats.timed_span("monitor_bench", "bench.monitor_s"):
+            monitor_info = measure_monitor(agg)
+        log(f"monitor: /metrics fetched in "
+            f"{monitor_info['metrics_fetch_s'] * 1e3:.1f} ms "
+            f"({monitor_info['series']} series), window agreement "
+            f"{monitor_info['window_agreement']}")
+    except Exception as e:
+        log(f"monitor measurement failed: {type(e).__name__}: {e}")
+        monitor_info = None
+
     mfu = None
     peak, peak_source = (_peak_flops(jax.devices()[0], backend)
                          if on_accel else (None, None))
@@ -864,6 +1041,11 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
         # ratio, compiled-bucket count); null without --serve; schema
         # pinned by tests/test_bench_tooling.py
         "serve": serve_info,
+        # docs/OBSERVABILITY.md "Live monitoring": exposition fetch
+        # latency, probe endpoints, windowed-vs-cumulative agreement,
+        # rolling step stats + one SLO evaluation; schema pinned by
+        # tests/test_bench_tooling.py
+        "monitor": monitor_info,
         # a fallback line is a liveness smoke signal, not a measurement
         # of anything the project tracks — cross-round diffs of it are
         # meaningless and tagged as such
@@ -894,6 +1076,7 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
                     "%Y-%m-%dT%H:%M:%S")}) + "\n")
         except OSError as e:  # history is an annotation, never fatal
             log(f"bench history append failed: {e}")
+    return line
 
 
 if __name__ == "__main__":
@@ -918,4 +1101,26 @@ if __name__ == "__main__":
                 raise SystemExit("--scan requires an integer chunk size")
             if scan < 1:
                 raise SystemExit("--scan chunk size must be >= 1")
-        main(trace_path=trace, scan=scan, serve="--serve" in argv)
+        tol = 0.1
+        if "--regression-tolerance" in argv:
+            i = argv.index("--regression-tolerance")
+            try:
+                tol = float(argv[i + 1])
+            except (IndexError, ValueError):
+                raise SystemExit(
+                    "--regression-tolerance requires a fraction (e.g. 0.1)"
+                )
+            if not 0.0 <= tol < 1.0:
+                raise SystemExit(
+                    "--regression-tolerance must be in [0, 1)"
+                )
+        result = main(trace_path=trace, scan=scan, serve="--serve" in argv)
+        if "--check-regression" in argv:
+            # CI gate: the JSON line above always ships; the exit code
+            # is the verdict against BASELINE.json's published anchors
+            failures = check_regression(result, tolerance=tol)
+            for f in failures:
+                log(f"REGRESSION: {f}")
+            if failures:
+                raise SystemExit(1)
+            log("check-regression: no regression vs published baselines")

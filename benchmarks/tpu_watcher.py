@@ -193,7 +193,7 @@ def main():
     args = p.parse_args()
 
     sys.path.insert(0, ROOT)
-    deadline = time.time() + args.max_hours * 3600
+    deadline = time.monotonic() + args.max_hours * 3600
     # a stage that fails while the tunnel is live goes to the back of the
     # line, so one persistently-broken stage cannot starve the rest of a
     # live window; a full cycle of failures earns a sleep (no tight loop)
@@ -202,7 +202,7 @@ def main():
     # battery child re-probes at startup anyway — only pay the watcher's
     # own probe when the last attempt failed or we just slept
     window_live = False
-    while time.time() < deadline:
+    while time.monotonic() < deadline:
         todo = [s for s in args.stages if not stage_done(s)]
         if not todo:
             log("all stages have TPU-tagged artifacts; done")
@@ -213,11 +213,11 @@ def main():
         # the chip must be free when the driver's end-of-round runs begin.
         # Coarse pre-probe check, then recompute AFTER the probe (which
         # itself can take probe_timeout_s out of the margin).
-        if deadline - time.time() - 60 < 120:
+        if deadline - time.monotonic() - 60 < 120:
             break
         if window_live or probe_live(args.probe_timeout_s):
             stage_budget = min(args.stage_timeout_s,
-                               deadline - time.time() - 60)
+                               deadline - time.monotonic() - 60)
             if stage_budget < 120:
                 break
             stage = ordered[0]
@@ -241,10 +241,10 @@ def main():
                     log(f"every pending stage failed this window; "
                         f"sleeping {args.poll_s:.0f}s")
                     demoted.clear()
-                    time.sleep(min(args.poll_s, max(0.0, deadline - time.time())))
+                    time.sleep(min(args.poll_s, max(0.0, deadline - time.monotonic())))
         else:
             log(f"tunnel down (todo: {ordered}); sleeping {args.poll_s:.0f}s")
-            time.sleep(min(args.poll_s, max(0.0, deadline - time.time())))
+            time.sleep(min(args.poll_s, max(0.0, deadline - time.monotonic())))
     log("max watch time reached; remaining: "
         f"{[s for s in args.stages if not stage_done(s)]}")
     return 1

@@ -15,5 +15,7 @@ def record(n):
     telemetry.count("Serve.Latency")  # bad: uppercase, no subsystem dot
     telemetry.count("queue_depth", n)  # bad: no subsystem prefix
     telemetry.count("serve.queue_depth", n)  # ok
+    telemetry.count("sevre.latency_s", n)  # bad: typo'd subsystem token
     REGISTRY.counter("serve-errors")  # bad: dash not in schema
+    CounterGroup(prefix="metricz")  # bad: unknown subsystem token
     return CounterGroup(prefix="serve.batcher")  # bad: prefix is one token

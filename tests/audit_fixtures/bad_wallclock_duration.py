@@ -1,0 +1,46 @@
+"""Planted violations for the ``wallclock_duration`` srclint rule
+(lint input only — never imported).
+
+Every subtraction of ``time.time()`` readings below is the alert-engine
+hazard: wall clock steps/slews under NTP, so these "durations" can be
+negative or minutes off. Durations must use ``time.monotonic()`` /
+``time.perf_counter()``.
+"""
+
+import time
+
+
+def direct_subtraction():
+    t0 = time.time()
+    do_work = sum(range(10))
+    elapsed = time.time() - t0  # VIOLATION: wallclock duration
+    return do_work, elapsed
+
+
+def both_sides_named():
+    start = time.time()
+    end = time.time()
+    return end - start  # VIOLATION: both operands are wallclock readings
+
+
+class Poller:
+    def __init__(self):
+        self._deadline_anchor = time.time()
+
+    def stale_for(self):
+        # VIOLATION: attribute bound from time.time() in this class,
+        # subtracted for an age — exactly the heartbeat-age bug the
+        # monotonic Heartbeats table exists to avoid
+        self._deadline_anchor = time.time()
+        return time.time() - self._deadline_anchor
+
+
+def timestamp_only_is_fine():
+    # near-miss: time.time() used as a timestamp (no subtraction) is
+    # legitimate — this line must NOT fire
+    return {"wall_time": round(time.time(), 3)}
+
+
+def monotonic_is_fine():
+    t0 = time.monotonic()
+    return time.monotonic() - t0  # near-miss: the correct clock

@@ -26,8 +26,9 @@ RULE_FIXTURES = {
     "host_sync_in_step": ("bad_host_sync_in_step.py", 2),
     "donate_after_use": ("bad_donate_after_use.py", 2),
     "unlocked_shared_state": ("bad_unlocked_shared_state.py", 4),
-    "telemetry_name_schema": ("bad_telemetry_name_schema.py", 4),
+    "telemetry_name_schema": ("bad_telemetry_name_schema.py", 6),
     "unpaired_trace_span": ("bad_unpaired_trace_span.py", 3),
+    "wallclock_duration": ("bad_wallclock_duration.py", 3),
 }
 
 
@@ -226,6 +227,81 @@ class TestRuleEdges:
             "        pass\n"
             "    s = tracer.span('c.d')\n"
             "    return s\n"
+        )
+        assert lint_source(src, "x.py") == []
+
+    def test_wallclock_subtraction_fires_monotonic_clean(self):
+        """ISSUE 8 satellite: time.time() subtraction is a duration bug
+        (wall clock steps under NTP — an alert-engine hazard);
+        monotonic/perf_counter subtraction is the sanctioned form."""
+        bad = (
+            "import time\n"
+            "def f():\n"
+            "    t0 = time.time()\n"
+            "    return time.time() - t0\n"
+        )
+        vs = lint_source(bad, "x.py")
+        assert [v.rule for v in vs] == ["wallclock_duration"]
+        assert "monotonic" in vs[0].message
+        clean = (
+            "import time\n"
+            "def f():\n"
+            "    t0 = time.perf_counter()\n"
+            "    ts = time.time()  # timestamp, never subtracted\n"
+            "    return time.perf_counter() - t0, ts\n"
+        )
+        assert lint_source(clean, "x.py") == []
+
+    def test_wallclock_from_import_and_attr_forms(self):
+        # `from time import time` spelling and self-attribute anchors
+        # are the same hazard
+        src = (
+            "from time import time\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._t0 = time()\n"
+            "    def age(self):\n"
+            "        return time() - self._t0\n"
+        )
+        vs = lint_source(src, "x.py")
+        assert [v.rule for v in vs] == ["wallclock_duration"]
+
+    def test_wallclock_binding_does_not_leak_across_functions(self):
+        # a wallclock name in one function must not taint an unrelated
+        # subtraction of the same name elsewhere
+        src = (
+            "import time\n"
+            "def stamp():\n"
+            "    t0 = time.time()\n"
+            "    return t0\n"
+            "def other(t0, t1):\n"
+            "    return t1 - t0\n"
+        )
+        assert lint_source(src, "x.py") == []
+
+    def test_unknown_subsystem_prefix_fires_known_clean(self):
+        """ISSUE 8 satellite: the metric-name vocabulary is closed —
+        obs./slo./monitor. (the live-monitoring families) are known,
+        a typo'd subsystem is a finding."""
+        assert lint_source(
+            "telemetry.count('obs.alert.fired')\n"
+            "telemetry.count('slo.evaluations')\n"
+            "telemetry.set_gauge('monitor.heartbeat_age_s', 1.0)\n",
+            "x.py",
+        ) == []
+        vs = lint_source("telemetry.count('sevre.latency_s')\n", "x.py")
+        assert [v.rule for v in vs] == ["telemetry_name_schema"]
+        assert "sevre" in vs[0].message
+
+    def test_monitor_metric_pins_satisfy_the_allowance(self):
+        """The six pinned live-monitoring names (obs.server.MONITOR_METRICS)
+        must all pass the schema+vocabulary rule — the pin and the
+        allowance cannot drift apart."""
+        from tpu_syncbn.obs.server import MONITOR_METRICS
+
+        assert len(MONITOR_METRICS) == 6
+        src = "".join(
+            f"telemetry.count({name!r})\n" for name in MONITOR_METRICS
         )
         assert lint_source(src, "x.py") == []
 

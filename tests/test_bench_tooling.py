@@ -549,6 +549,9 @@ class TestTelemetryBlock:
         # the scan block is always present (k=1 default: the per-step
         # loop IS the measurement) with the pinned field set
         self._validate_scan_block(line["scan"], k=1)
+        # the monitor block is always present (the live-monitoring
+        # layer is measured on every run — ISSUE 8)
+        self._validate_monitor_block(line["monitor"], steps=3)
         # the serve block is null unless --serve ran the sweep
         assert line["serve"] is None
         # the --trace file is valid Chrome trace JSON with the three
@@ -573,6 +576,35 @@ class TestTelemetryBlock:
                     "dispatch_frac", "dispatch_frac_scan1"):
             assert block[key] is None or 0.0 <= block[key] <= 1.5, key
         assert block["img_per_sec_per_chip"] > 0
+
+    @staticmethod
+    def _validate_monitor_block(block, *, steps):
+        """The schema-pinned `monitor` block (ISSUE 8): the live
+        monitoring layer benchmarked on the run's own metrics —
+        exposition fetch latency and windowed-vs-cumulative agreement
+        are the acceptance quantities."""
+        assert set(block) == {
+            "port", "metrics_fetch_s", "exposition_bytes", "series",
+            "healthz_ok", "readyz_ok", "windowed_steps",
+            "cumulative_steps", "window_agreement",
+            "steps_per_s_windowed", "step_p99_s_windowed",
+            "slo_burn_rate", "slo_firing",
+        }
+        assert block["port"] > 0
+        assert 0 < block["metrics_fetch_s"] < 30
+        assert block["exposition_bytes"] > 0 and block["series"] >= 3
+        assert block["healthz_ok"] is True
+        assert block["readyz_ok"] is True
+        # the delta layer saw exactly the timed loop's steps
+        assert block["windowed_steps"] == steps
+        assert block["cumulative_steps"] >= steps
+        assert block["window_agreement"] is not None
+        assert 0 < block["window_agreement"] <= 1.0
+        assert block["steps_per_s_windowed"] > 0
+        assert block["step_p99_s_windowed"] > 0
+        # the liveness-grade SLO (p99 < 60s) holds on a healthy run
+        assert block["slo_firing"] is False
+        assert block["slo_burn_rate"] is not None
 
     def test_scan_flag_emits_fused_block(self, tmp_path, monkeypatch, capsys):
         """--scan K: the fused K-step loop runs and the scan block
@@ -692,6 +724,121 @@ class TestServeBlock:
         tel = telemetry.validate_snapshot(line["telemetry"])
         assert tel["histograms"]["serve.latency_s"]["count"] >= 1
         assert tel["counters"]["serve.compiles"] >= 1
+
+class TestCheckRegression:
+    """bench's `--check-regression` CI gate (ISSUE 8 satellite): the
+    emitted line vs BASELINE.json published anchors, with tolerance,
+    exit non-zero on regression — vs_baseline stops being informational."""
+
+    _tiny_build = TestTelemetryBlock._tiny_build
+
+    LINE = {
+        "metric": "resnet50_syncbn_dp_train_throughput",
+        "value": 100.0,
+        "serve": {"latency_p99_ms": 12.0},
+        "monitor": {"metrics_fetch_s": 0.004},
+    }
+
+    def _baseline(self, tmp_path, published):
+        p = str(tmp_path / "BASELINE.json")
+        with open(p, "w") as f:
+            json.dump({"published": published}, f)
+        return p
+
+    def _check(self, tmp_path, published, **kw):
+        bench = _load_bench()
+        return bench.check_regression(
+            dict(self.LINE), baseline_path=self._baseline(tmp_path, published),
+            **kw,
+        )
+
+    def test_within_tolerance_passes(self, tmp_path):
+        assert self._check(tmp_path, {
+            "resnet50_syncbn_dp_train_throughput": 105.0,  # -4.8% ok
+        }, tolerance=0.1) == []
+
+    def test_degraded_headline_metric_fails(self, tmp_path):
+        fails = self._check(tmp_path, {
+            "resnet50_syncbn_dp_train_throughput": 200.0,  # measured half
+        }, tolerance=0.1)
+        assert len(fails) == 1 and "below the published" in fails[0]
+
+    def test_lower_is_better_direction(self, tmp_path):
+        # latency anchors declare direction=lower: a RISE is a regression
+        fails = self._check(tmp_path, {
+            "serve.latency_p99_ms": {"value": 6.0, "direction": "lower"},
+        })
+        assert len(fails) == 1 and "above the published" in fails[0]
+        assert self._check(tmp_path, {
+            "serve.latency_p99_ms": {"value": 12.5, "direction": "lower"},
+        }) == []
+
+    def test_dotted_path_resolution_and_skip(self, tmp_path):
+        # a key the line cannot resolve is skipped (e.g. serve metrics
+        # on a run without --serve), never a false failure
+        assert self._check(tmp_path, {
+            "serve.nonexistent_field": 1.0,
+            "monitor.metrics_fetch_s": {"value": 0.005,
+                                        "direction": "lower"},
+        }) == []
+
+    def test_per_entry_tolerance_overrides(self, tmp_path):
+        published = {"resnet50_syncbn_dp_train_throughput": {
+            "value": 104.0, "tolerance": 0.01,
+        }}
+        fails = self._check(tmp_path, published)  # -3.8% vs 1% tolerance
+        assert len(fails) == 1
+
+    def test_unusable_baseline_is_a_failure(self, tmp_path):
+        """A CI gate that silently passes on a corrupt anchor file is
+        worse than no gate — unusable baseline must exit non-zero."""
+        bench = _load_bench()
+        p = str(tmp_path / "BASELINE.json")
+        with open(p, "w") as f:
+            f.write('{"trunc')
+        fails = bench.check_regression(dict(self.LINE), baseline_path=p)
+        assert len(fails) == 1 and "unusable" in fails[0]
+        assert self._check(tmp_path, {"m": 0.0}) \
+            == ["m: unusable published value 0.0"]
+        assert self._check(tmp_path, {
+            "resnet50_syncbn_dp_train_throughput": {
+                "value": 100.0, "direction": "sideways"},
+        }) == ["resnet50_syncbn_dp_train_throughput: unknown direction "
+               "'sideways'"]
+
+    def test_empty_published_map_passes(self, tmp_path):
+        # the shipped BASELINE.json publishes nothing yet: the gate is
+        # vacuously green until an anchor lands (recorded trajectory
+        # starts empty, ISSUE 8 motivation)
+        assert self._check(tmp_path, {}) == []
+
+    def test_cli_exit_codes(self, tmp_path, monkeypatch, capsys):
+        """End to end through bench.main + the gate: a synthetically
+        degraded anchor exits non-zero, a met anchor exits zero."""
+        from tpu_syncbn.obs import telemetry, tracing
+
+        bench = _load_bench()
+        monkeypatch.setenv("TPU_SYNCBN_FORCE_CPU", "1")
+        monkeypatch.setenv("BENCH_STEPS", "3")
+        monkeypatch.setattr(bench, "build_program", self._tiny_build())
+        telemetry.REGISTRY.reset()
+        try:
+            line = bench.main()
+        finally:
+            telemetry.set_enabled(None)
+            telemetry.REGISTRY.reset()
+            tracing.uninstall()
+        capsys.readouterr()
+        assert isinstance(line, dict) and line["value"] > 0
+        good = str(tmp_path / "good.json")
+        with open(good, "w") as f:
+            json.dump({"published": {line["metric"]: line["value"]}}, f)
+        assert bench.check_regression(line, baseline_path=good) == []
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            json.dump({"published": {line["metric"]: line["value"] * 10}}, f)
+        assert bench.check_regression(line, baseline_path=bad) != []
+
 
 class TestRecoveryBlock:
     """bench's `recovery` block: the robustness-cost measurement that

@@ -1,0 +1,839 @@
+"""The live-monitoring layer (tpu_syncbn.obs timeseries/server/slo):
+windowed rates and quantiles over the registry, Prometheus /metrics
+exposition, /healthz heartbeat liveness, /readyz readiness flips under
+preemption-drain / queue overload / divergence rollback (PR 1 fault
+hooks), and the SLO burn-rate alert state machine with hysteresis.
+
+Reference parity note: the torch recipe's observability is rank-0
+console printing — an operator cannot ask a *running* process anything.
+This layer is entirely OUR capability surface (ROADMAP items 3–4 both
+presuppose it), so its semantics are pinned directly.
+
+Every server in this suite binds port 0 (ephemeral) — the `monitor`
+marker's contract: tier-1 must never contend on a fixed port.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpu_syncbn.obs import server as obs_server
+from tpu_syncbn.obs import slo as obs_slo
+from tpu_syncbn.obs import telemetry, timeseries, tracing
+from tpu_syncbn.runtime import resilience
+
+pytestmark = pytest.mark.monitor
+
+
+@pytest.fixture(autouse=True)
+def _clean_monitor_state():
+    """Every test starts and ends with telemetry at its env default, an
+    empty registry, no tracer, no heartbeats, no readiness hooks, and
+    no env-gated server."""
+    def reset():
+        telemetry.set_enabled(None)
+        telemetry.REGISTRY.reset()
+        tracing.uninstall()
+        obs_server.HEARTBEATS.clear()
+        with obs_server._readiness_lock:
+            obs_server._readiness.clear()
+        obs_server.stop_env_server()
+
+    reset()
+    yield
+    reset()
+
+
+def _get(url, timeout=10):
+    """GET returning (status, parsed-or-text) without raising on 5xx."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            body = resp.read()
+            status = resp.status
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        status = e.code
+    text = body.decode()
+    try:
+        return status, json.loads(text)
+    except json.JSONDecodeError:
+        return status, text
+
+
+# ----------------------------------------------------------- timeseries
+
+
+class TestWindowedAggregator:
+    def _setup(self):
+        r = telemetry.Registry()
+        agg = timeseries.WindowedAggregator(r, interval_s=1.0, capacity=4)
+        return r, agg
+
+    def test_counter_rate_over_window(self):
+        r, agg = self._setup()
+        agg.tick(now=0.0)
+        r.counter("serve.requests").inc(10)
+        agg.tick(now=1.0)
+        r.counter("serve.requests").inc(30)
+        agg.tick(now=2.0)
+        # whole ring: 40 events over 2 covered seconds
+        assert agg.rate("serve.requests", now=2.0) == pytest.approx(20.0)
+        # trailing 1s window: only the second frame
+        assert agg.rate("serve.requests", 1.0, now=2.0) == pytest.approx(30.0)
+        assert agg.rate("nonexistent.metric", now=2.0) == 0.0
+        # no frames at all -> None (not a fake zero)
+        _, empty = self._setup()
+        assert empty.rate("serve.requests") is None
+
+    def test_histogram_count_rate_is_steps_per_s(self):
+        r, agg = self._setup()
+        agg.tick(now=0.0)
+        h = r.histogram("step.time_s", buckets=(0.1, 1.0))
+        for _ in range(6):
+            h.observe(0.05)
+        agg.tick(now=2.0)
+        assert agg.rate("step.time_s", now=2.0) == pytest.approx(3.0)
+
+    def test_rolling_quantiles_see_only_the_window(self):
+        r, agg = self._setup()
+        h = r.histogram("serve.latency_s", buckets=(0.01, 0.1, 1.0))
+        agg.tick(now=0.0)
+        for _ in range(100):
+            h.observe(0.005)  # old fast frame
+        agg.tick(now=1.0)
+        for _ in range(100):
+            h.observe(0.5)  # recent slow frame
+        agg.tick(now=2.0)
+        # over everything the p50 is fast; over the last second slow
+        assert agg.quantile("serve.latency_s", 0.5, now=2.0) < 0.05
+        assert agg.quantile("serve.latency_s", 0.5, 1.0, now=2.0) > 0.1
+        assert agg.quantile("serve.latency_s", 0.5, 1.0, now=60.0) is None
+
+    def test_quantile_interpolation_and_overflow_saturation(self):
+        assert timeseries.quantile_from_counts((1.0, 2.0), (0, 4, 0), 0.5) \
+            == pytest.approx(1.5)
+        # everything in the overflow bucket: saturate at the last edge
+        assert timeseries.quantile_from_counts((1.0, 2.0), (0, 0, 7), 0.99) \
+            == pytest.approx(2.0)
+        assert timeseries.quantile_from_counts((1.0,), (0, 0), 0.5) is None
+        with pytest.raises(ValueError, match="quantile"):
+            timeseries.quantile_from_counts((1.0,), (1, 0), 1.5)
+
+    def test_fraction_above_interpolates(self):
+        r, agg = self._setup()
+        h = r.histogram("serve.latency_s", buckets=(0.1, 0.2))
+        agg.tick(now=0.0)
+        for _ in range(10):
+            h.observe(0.15)  # all land in the (0.1, 0.2] bucket
+        agg.tick(now=1.0)
+        # threshold at the bucket midpoint: uniform assumption -> 0.5
+        assert agg.fraction_above("serve.latency_s", 0.15, now=1.0) \
+            == pytest.approx(0.5)
+        assert agg.fraction_above("serve.latency_s", 0.25, now=1.0) == 0.0
+
+    def test_fraction_above_overflow_needs_evidence(self):
+        """Overflow observations count as above only when the threshold
+        is covered by the bucket edges — a threshold past the last edge
+        must not fire alerts on bucket blindness (an overflow sample at
+        301s is not evidence of a >600s violation)."""
+        r, agg = self._setup()
+        h = r.histogram("step.time_s", buckets=(1.0, 300.0))
+        agg.tick(now=0.0)
+        for _ in range(10):
+            h.observe(301.0)  # all in the overflow bucket
+        agg.tick(now=1.0)
+        # threshold at/below the last edge: overflow IS above it
+        assert agg.fraction_above("step.time_s", 300.0, now=1.0) == 1.0
+        # threshold beyond the last edge: unattributable -> not counted
+        assert agg.fraction_above("step.time_s", 600.0, now=1.0) == 0.0
+
+    def test_ring_capacity_bounds_memory(self):
+        r, agg = self._setup()  # capacity=4
+        agg.tick(now=0.0)
+        for i in range(10):
+            r.counter("loader.batches").inc()
+            agg.tick(now=float(i + 1))
+        # only the last 4 frames survive: 4 of the 10 increments
+        assert agg.rate("loader.batches", now=10.0) == pytest.approx(1.0)
+        snap = agg.windowed_snapshot(now=10.0)
+        assert snap["counters"]["loader.batches"] == 4
+        assert snap["window"]["frames"] == 4
+
+    def test_registry_reset_reanchors_without_negative_deltas(self):
+        r, agg = self._setup()
+        agg.tick(now=0.0)
+        r.counter("serve.requests").inc(5)
+        h = r.histogram("step.time_s", buckets=(1.0,))
+        h.observe(0.5)
+        agg.tick(now=1.0)
+        r.reset()  # counters restart from zero
+        r.counter("serve.requests").inc(1)
+        agg.tick(now=2.0)
+        snap = agg.windowed_snapshot(now=2.0)
+        # no negative counter deltas leaked into the window
+        assert all(v >= 0 for v in snap["counters"].values())
+        telemetry.validate_snapshot(snap)
+
+    def test_background_sampler_thread(self):
+        r = telemetry.Registry()
+        with timeseries.WindowedAggregator(
+            r, interval_s=0.02, capacity=64
+        ).start() as agg:
+            r.counter("serve.requests").inc(7)
+            deadline = time.monotonic() + 5
+            while (agg.windowed_snapshot().get("counters", {})
+                   .get("serve.requests", 0) < 7
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+        assert agg.windowed_snapshot()["counters"]["serve.requests"] == 7
+
+
+class TestWindowedMergeAcrossHosts:
+    def test_two_host_windowed_merge_schema_validated(self, tmp_path):
+        """ISSUE 8: windowed snapshots ride the SAME export/merge path
+        as cumulative ones — two hosts' rolling windows merge into one
+        rank-0 summary with summed counters/bucket vectors."""
+        paths = []
+        for host in (0, 1):
+            r = telemetry.Registry()
+            agg = timeseries.WindowedAggregator(r, interval_s=1.0)
+            agg.tick(now=0.0)
+            r.counter("serve.requests").inc(10 * (host + 1))
+            h = r.histogram("serve.latency_s", buckets=(0.1, 1.0))
+            h.observe(0.05 if host == 0 else 0.5)
+            r.gauge("serve.queue_depth").set(host + 1)
+            agg.tick(now=1.0)
+            snap = agg.windowed_snapshot(now=1.0)
+            telemetry.validate_snapshot(snap)  # schema gate pre-export
+            p = str(tmp_path / f"win{host}.jsonl")
+            telemetry.export_snapshot_jsonl(snap, p, host=host)
+            paths.append(p)
+        merged = telemetry.merge_exports(paths)
+        assert merged["hosts"] == [0, 1]
+        assert merged["counters"]["serve.requests"] == 30
+        h = merged["histograms"]["serve.latency_s"]
+        assert h["count"] == 2 and h["counts"] == [1, 1, 0]
+        assert merged["gauges"]["serve.queue_depth"] == 2  # last write wins
+
+    def test_bad_windowed_snapshot_is_refused_at_export(self, tmp_path):
+        snap = {"schema": telemetry.SCHEMA_VERSION, "counters": {"x.y": 1.5},
+                "gauges": {}, "histograms": {}}
+        with pytest.raises(ValueError, match="not an int"):
+            telemetry.export_snapshot_jsonl(
+                snap, str(tmp_path / "bad.jsonl"), host=0
+            )
+
+
+# ------------------------------------------------------------ exposition
+
+
+class TestPrometheusExposition:
+    def test_render_golden(self):
+        """The exposition format is the scrape contract: exact text for
+        a known registry (counter -> _total, gauge plain, histogram ->
+        cumulative le-buckets + +Inf + sum + count, TYPE lines)."""
+        r = telemetry.Registry()
+        r.counter("serve.requests").inc(3)
+        r.gauge("serve.queue_depth").set(2.5)
+        h = r.histogram("serve.latency_s", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.05)
+        h.observe(5.0)
+        text = obs_server.render_prometheus(r.snapshot())
+        assert text == (
+            "# TYPE tpu_syncbn_serve_requests_total counter\n"
+            "tpu_syncbn_serve_requests_total 3\n"
+            "# TYPE tpu_syncbn_serve_queue_depth gauge\n"
+            "tpu_syncbn_serve_queue_depth 2.5\n"
+            "# TYPE tpu_syncbn_serve_latency_s histogram\n"
+            'tpu_syncbn_serve_latency_s_bucket{le="0.1"} 2\n'
+            'tpu_syncbn_serve_latency_s_bucket{le="1"} 2\n'
+            'tpu_syncbn_serve_latency_s_bucket{le="+Inf"} 3\n'
+            "tpu_syncbn_serve_latency_s_sum 5.1\n"
+            "tpu_syncbn_serve_latency_s_count 3\n"
+        )
+
+    def test_metrics_endpoint_serves_exposition(self):
+        r = telemetry.Registry()
+        r.counter("step.count").inc(4)
+        with obs_server.MonitoringServer(
+            port=0, host="127.0.0.1", registry=r
+        ) as srv:
+            status, text = _get(f"http://127.0.0.1:{srv.port}/metrics")
+        assert status == 200
+        assert "# TYPE tpu_syncbn_step_count_total counter" in text
+        assert "tpu_syncbn_step_count_total 4" in text
+
+    def test_unknown_route_404s_with_route_list(self):
+        with obs_server.MonitoringServer(
+            port=0, host="127.0.0.1", registry=telemetry.Registry()
+        ) as srv:
+            status, doc = _get(f"http://127.0.0.1:{srv.port}/nope")
+        assert status == 404
+        assert "/metrics" in doc["routes"]
+
+    def test_env_gate_off_means_no_server(self, monkeypatch):
+        monkeypatch.delenv("TPU_SYNCBN_METRICS_PORT", raising=False)
+        assert obs_server.start_from_env() is None
+
+    def test_env_gate_starts_once_and_is_shared(self, monkeypatch):
+        monkeypatch.setenv("TPU_SYNCBN_METRICS_PORT", "0")
+        srv = obs_server.start_from_env()
+        assert srv is not None and srv.port > 0
+        assert obs_server.start_from_env() is srv  # idempotent
+        status, _ = _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert status == 200
+
+
+# ------------------------------------------------------- health/readiness
+
+
+class TestHealthz:
+    def test_fresh_heartbeats_are_live(self):
+        with obs_server.MonitoringServer(
+            port=0, host="127.0.0.1", registry=telemetry.Registry(),
+            max_age_s=60.0,
+        ) as srv:
+            obs_server.HEARTBEATS.beat("train")
+            status, doc = _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert status == 200 and doc["ok"] is True
+        assert "train" in doc["heartbeat_age_s"]
+
+    def test_stalled_heartbeat_flips_503(self):
+        """The injected-stall liveness flip: a heartbeat older than
+        max_age reads as a stuck host — 503 names the stale source."""
+        with obs_server.MonitoringServer(
+            port=0, host="127.0.0.1", registry=telemetry.Registry(),
+            max_age_s=0.05,
+        ) as srv:
+            obs_server.HEARTBEATS.beat("train")
+            time.sleep(0.15)  # the stall
+            status, doc = _get(f"http://127.0.0.1:{srv.port}/healthz")
+            assert status == 503 and doc["ok"] is False
+            assert doc["stale"] == ["train"]
+            # recovery: a fresh beat restores liveness
+            obs_server.HEARTBEATS.beat("train")
+            status2, doc2 = _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert status2 == 200 and doc2["stale"] == []
+
+    def test_liveness_publishes_heartbeat_age_gauge(self):
+        telemetry.set_enabled(True)
+        srv = obs_server.MonitoringServer(
+            port=0, host="127.0.0.1", registry=telemetry.Registry(),
+        )
+        try:
+            obs_server.HEARTBEATS.beat("train", now=0.0)
+            ok, _ = srv.liveness(now=2.5)
+            assert ok  # 2.5s < 60s default
+            assert telemetry.REGISTRY.gauge(
+                "monitor.heartbeat_age_s").value == pytest.approx(2.5)
+        finally:
+            srv.close()
+
+
+class TestReadyz:
+    def test_hook_conjunction_and_fail_closed(self):
+        obs_server.register_readiness("a", lambda: (True, {"x": 1}))
+        obs_server.register_readiness("b", lambda: (True, {}))
+        ok, checks = obs_server.evaluate_readiness()
+        assert ok and checks["a"]["x"] == 1
+        obs_server.register_readiness("b", lambda: (False, {"why": "nope"}))
+        ok, checks = obs_server.evaluate_readiness()
+        assert not ok and checks["b"]["why"] == "nope"
+
+        def boom():
+            raise RuntimeError("hook crashed")
+
+        obs_server.register_readiness("b", boom)
+        ok, checks = obs_server.evaluate_readiness()
+        assert not ok  # a raising hook is NOT a ready signal
+        assert "RuntimeError" in checks["b"]["error"]
+        obs_server.unregister_readiness("b")
+        ok, _ = obs_server.evaluate_readiness()
+        assert ok
+
+    def test_endpoint_reflects_hooks(self):
+        with obs_server.MonitoringServer(
+            port=0, host="127.0.0.1", registry=telemetry.Registry()
+        ) as srv:
+            base = f"http://127.0.0.1:{srv.port}"
+            obs_server.register_readiness("gate", lambda: (True, {}))
+            status, doc = _get(base + "/readyz")
+            assert status == 200 and doc["ok"] is True
+            obs_server.register_readiness("gate", lambda: (False, {}))
+            status, doc = _get(base + "/readyz")
+        assert status == 503 and doc["checks"]["gate"]["ok"] is False
+
+
+class _StubEngine:
+    """Duck-typed engine (the tests/test_serve.py convention) with a
+    blockable predict so overload is deterministic."""
+
+    def __init__(self, bucket=4, release=None):
+        self.max_bucket = bucket
+        self._release = release
+
+    def bucket_for(self, n):
+        return self.max_bucket
+
+    def predict(self, b):
+        if self._release is not None:
+            assert self._release.wait(timeout=30)
+        return np.asarray(b) * 2.0
+
+
+def _item(v, n=1):
+    return np.full((n, 1), v, np.float32)
+
+
+class TestServeReadinessFlips:
+    def test_queue_overload_flips_not_ready_then_recovers(self):
+        """Queue-overload readiness: depth >= ready_depth flips the
+        serve hook BEFORE queue-full rejection starts shedding, and
+        drains back to ready."""
+        from tpu_syncbn import serve
+
+        release = threading.Event()
+        eng = _StubEngine(bucket=2, release=release)
+        bat = serve.DynamicBatcher(eng, max_batch=2, max_wait_ms=1,
+                                   max_queue=8, ready_depth=3)
+        try:
+            ok, detail = bat.readiness()
+            assert ok and detail["queue_depth"] < 3
+            futs = [bat.submit(_item(i)) for i in range(6)]
+            # the worker is wedged inside predict; the queue backs up
+            deadline = time.monotonic() + 5
+            while bat._q.qsize() < 3 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            ok, detail = bat.readiness()
+            assert not ok and detail["queue_depth"] >= 3
+            release.set()  # unwedge the engine
+            for f in futs:
+                f.result(timeout=10)
+            ok, _ = bat.readiness()
+            assert ok
+        finally:
+            release.set()
+            bat.close()
+
+    def test_preemption_drain_flips_readyz_on_the_wire(self):
+        """The acceptance flip: a serving run with the metrics port set
+        answers /readyz 200, then SIGUSR1-shaped preemption (the PR 1
+        fault-suite convention) flips it 503 while admitted requests
+        still drain."""
+        from tpu_syncbn import serve
+
+        with obs_server.MonitoringServer(
+            port=0, host="127.0.0.1"
+        ) as srv:
+            base = f"http://127.0.0.1:{srv.port}"
+            with resilience.PreemptionGuard(signals=(signal.SIGUSR1,)) as g:
+                bat = serve.DynamicBatcher(
+                    _StubEngine(bucket=4), max_batch=4, max_wait_ms=5,
+                    max_queue=16, guard=g,
+                )
+                status, doc = _get(base + "/readyz")
+                assert status == 200 and doc["checks"]["serve"]["ok"]
+                futs = [bat.submit(_item(i)) for i in range(4)]
+                os.kill(os.getpid(), signal.SIGUSR1)
+                assert g.preempted
+                status, doc = _get(base + "/readyz")
+                assert status == 503
+                assert doc["checks"]["serve"]["draining"] is True
+                # graceful drain still answers everything admitted
+                for i, f in enumerate(futs):
+                    assert float(f.result(timeout=10)[0, 0]) == 2.0 * i
+                bat.close()
+            # close() removed the hook: probes see no stale serve claim
+            _, doc = _get(base + "/readyz")
+            assert "serve" not in doc["checks"]
+
+    def test_collector_heartbeat_feeds_healthz(self):
+        from tpu_syncbn import serve
+
+        bat = serve.DynamicBatcher(_StubEngine(bucket=4), max_batch=4,
+                                   max_wait_ms=5, max_queue=16)
+        try:
+            deadline = time.monotonic() + 5
+            while "serve" not in obs_server.HEARTBEATS.ages() \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert "serve" in obs_server.HEARTBEATS.ages()
+        finally:
+            bat.close()
+        # a cleanly-closed batcher leaves no stale heartbeat behind
+        assert "serve" not in obs_server.HEARTBEATS.ages()
+
+    def test_engine_health_rides_readiness_detail(self):
+        from tpu_syncbn import serve
+
+        class Healthy(_StubEngine):
+            def health(self):
+                return {"buckets": [4], "programs_live": 1,
+                        "programs_compiled": 1}
+
+        with serve.DynamicBatcher(Healthy(bucket=4), max_batch=4,
+                                  max_wait_ms=5, max_queue=16) as bat:
+            _, detail = bat.readiness()
+        assert detail["engine"]["programs_live"] == 1
+
+
+class TestTrainReadinessFlips:
+    class _Trainer:
+        """Minimal state_dict/load_state_dict/train_step trainer whose
+        nonfinite metric is scripted — the divergence-path driver."""
+
+        divergence_guard = "restore_last_good"
+
+        def __init__(self, script):
+            self._script = list(script)
+            self._state = {"w": np.zeros(2, np.float32)}
+
+        def state_dict(self):
+            return {k: v.copy() for k, v in self._state.items()}
+
+        def load_state_dict(self, d):
+            self._state = {k: np.asarray(v).copy() for k, v in d.items()}
+
+        def train_step(self, batch):
+            nonfinite = float(self._script.pop(0)) if self._script else 0.0
+
+            class Out:
+                loss = np.float32(0.1)
+                metrics = {"nonfinite": np.float32(nonfinite)}
+                monitors = {}
+
+            return Out()
+
+    def test_divergence_rollback_flips_recovering_then_clears(self, tmp_path):
+        """ISSUE 8 acceptance: a divergence rollback makes the train
+        readiness hook report not-ready mid-recovery; the next finite
+        step clears it. Observed through a probe hook sampled at every
+        step (the hook registry IS how /readyz would see it)."""
+        trainer = self._Trainer(script=[0.0, 1.0, 0.0, 0.0])
+        loop = resilience.ResilientLoop(trainer, str(tmp_path),
+                                        ckpt_every=1)
+        seen: list[tuple[bool, dict]] = []
+
+        class Probe:
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                seen.append(loop.readiness())
+                if len(seen) > 4:
+                    raise StopIteration
+                return np.zeros(2, np.float32)
+
+        summary = loop.run(Probe())
+        assert summary["divergence_restores"] == 1
+        # the batch fetch AFTER the nonfinite step saw recovering=True...
+        assert any(not ok and d["recovering"] for ok, d in seen)
+        # ...and the loop ends ready again (finite step cleared it)
+        ok, detail = loop.readiness()
+        assert ok and not detail["recovering"]
+
+    def test_loop_registers_train_hook_and_heartbeat(self, tmp_path):
+        telemetry.set_enabled(True)
+        trainer = self._Trainer(script=[])
+        trainer.divergence_guard = None
+        loop = resilience.ResilientLoop(trainer, str(tmp_path),
+                                        ckpt_every=100)
+        during: list = []
+
+        class Probe:
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                ok, checks = obs_server.evaluate_readiness()
+                during.append(("train" in checks, dict(
+                    obs_server.HEARTBEATS.ages())))
+                if len(during) > 2:
+                    raise StopIteration
+                return np.zeros(2, np.float32)
+
+        loop.run(Probe())
+        # mid-run: the train hook answered and the step heartbeat beat
+        assert during[-1][0] is True
+        assert "train" in during[-1][1]
+        assert telemetry.REGISTRY.gauge("train.step").value == 2
+        # post-run: the hook is gone (no stale claims)
+        _, checks = obs_server.evaluate_readiness()
+        assert "train" not in checks
+
+    def test_preempted_loop_reports_not_ready(self, tmp_path):
+        """SIGTERM-at-step (the PR 1 signal_at hook) mid-run: readiness
+        goes false before the loop checkpoints and exits."""
+        from tpu_syncbn.testing import faults
+
+        trainer = self._Trainer(script=[])
+        trainer.divergence_guard = None
+        loop = resilience.ResilientLoop(trainer, str(tmp_path),
+                                        ckpt_every=100)
+        seen: list = []
+
+        def probe_batches():
+            for i in faults.signal_at(iter(range(6)), at_step=2,
+                                      sig=signal.SIGTERM):
+                seen.append(loop.readiness())
+                yield np.zeros(2, np.float32)
+
+        summary = loop.run(probe_batches())
+        assert summary["preempted"] is True
+        # the fetch after the signal observed preempted -> not ready
+        assert any(not ok and d["preempted"] for ok, d in seen)
+
+
+class TestEnvGatedRuns:
+    """ISSUE 8 acceptance: with TPU_SYNCBN_METRICS_PORT set, a training
+    run (ResilientLoop) and a serving run (DynamicBatcher) each answer
+    /metrics in Prometheus exposition and /healthz + /readyz — no other
+    wiring, the env var is the whole knob."""
+
+    def test_training_run_answers_endpoints_mid_run(self, monkeypatch,
+                                                    tmp_path):
+        monkeypatch.setenv("TPU_SYNCBN_METRICS_PORT", "0")
+        telemetry.set_enabled(True)
+        trainer = TestTrainReadinessFlips._Trainer(script=[])
+        trainer.divergence_guard = None
+        loop = resilience.ResilientLoop(trainer, str(tmp_path),
+                                        ckpt_every=100)
+        probes: list = []
+
+        def batches():
+            for i in range(3):
+                if i == 2:  # mid-run, from inside the step loop
+                    srv = obs_server.active_server()
+                    assert srv is not None, "env gate did not start a server"
+                    base = f"http://127.0.0.1:{srv.port}"
+                    probes.append(("metrics", *_get(base + "/metrics")))
+                    probes.append(("healthz", *_get(base + "/healthz")))
+                    probes.append(("readyz", *_get(base + "/readyz")))
+                yield np.zeros(2, np.float32)
+
+        loop.run(batches())
+        by_name = {name: (status, body) for name, status, body in probes}
+        status, text = by_name["metrics"]
+        assert status == 200
+        # the live step-position gauge is being exported
+        assert "# TYPE tpu_syncbn_train_step gauge" in text
+        status, doc = by_name["healthz"]
+        assert status == 200 and doc["ok"]
+        assert "train" in doc["heartbeat_age_s"]  # the step heartbeat
+        status, doc = by_name["readyz"]
+        assert status == 200 and doc["checks"]["train"]["ok"]
+
+    def test_serving_run_answers_endpoints(self, monkeypatch):
+        from tpu_syncbn import serve
+
+        monkeypatch.setenv("TPU_SYNCBN_METRICS_PORT", "0")
+        telemetry.set_enabled(True)
+        bat = serve.DynamicBatcher(_StubEngine(bucket=4), max_batch=4,
+                                   max_wait_ms=5, max_queue=16)
+        try:
+            srv = obs_server.active_server()
+            assert srv is not None, "env gate did not start a server"
+            base = f"http://127.0.0.1:{srv.port}"
+            for f in [bat.submit(_item(i)) for i in range(4)]:
+                f.result(timeout=10)
+            status, text = _get(base + "/metrics")
+            assert status == 200
+            assert "tpu_syncbn_serve_requests_total 4" in text
+            status, doc = _get(base + "/readyz")
+            assert status == 200 and doc["checks"]["serve"]["ok"]
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                status, doc = _get(base + "/healthz")
+                if doc["ok"] and "serve" in doc["heartbeat_age_s"]:
+                    break
+                time.sleep(0.01)
+            assert status == 200 and "serve" in doc["heartbeat_age_s"]
+        finally:
+            bat.close()
+
+
+# ------------------------------------------------------------------- slo
+
+
+class TestSLO:
+    def _hot_agg(self, *, frac_slow=0.1):
+        """An aggregator whose serve.latency_s window has ``frac_slow``
+        of observations at 0.5s (vs a 0.05s threshold p99 objective
+        budget of 1%)."""
+        r = telemetry.Registry()
+        agg = timeseries.WindowedAggregator(r, interval_s=1.0)
+        agg.tick(now=0.0)
+        h = r.histogram("serve.latency_s", buckets=(0.05, 1.0))
+        n_slow = int(100 * frac_slow)
+        for _ in range(100 - n_slow):
+            h.observe(0.01)
+        for _ in range(n_slow):
+            h.observe(0.5)
+        r.counter("serve.requests").inc(95)
+        r.counter("serve.rejected").inc(5)
+        agg.tick(now=1.0)
+        return r, agg
+
+    def test_objective_parser(self):
+        obj = obs_slo.parse_objective("serve.latency_s p99 < 0.25")
+        assert obj.metric == "serve.latency_s"
+        assert obj.quantile == pytest.approx(0.99)
+        assert obj.threshold == 0.25
+        assert obj.budget == pytest.approx(0.01)
+        obj50 = obs_slo.parse_objective("step.time_s p50 < 2")
+        assert obj50.quantile == pytest.approx(0.50)
+        for bad in ("serve.latency_s p99 > 0.25", "latency p99 < 1",
+                    "serve.latency_s < 0.25", ""):
+            with pytest.raises(ValueError, match="objective"):
+                obs_slo.parse_objective(bad)
+
+    def test_latency_burn_fires_and_resolves_with_hysteresis(self):
+        r, agg = self._hot_agg(frac_slow=0.1)  # 10% over a 1% budget
+        rule = obs_slo.AlertRule(
+            "latency", "serve.latency_s p99 < 0.05",
+            windows_s=(0.8, 2.0), burn_threshold=2.0, clear_for=2,
+        )
+        tracker = obs_slo.SLOTracker(agg, [rule])
+        out = tracker.evaluate(now=1.0)
+        assert out["latency"]["firing"] is True
+        assert not tracker.ready()
+        # burn cools: new frames are all-fast, old hot frame ages out
+        h = r.histogram("serve.latency_s", buckets=(0.05, 1.0))
+        for t in (2.0, 3.0, 4.0):
+            for _ in range(200):
+                h.observe(0.01)
+            agg.tick(now=t)
+        # hysteresis: one cool evaluation is not enough...
+        out = tracker.evaluate(now=4.0)
+        assert out["latency"]["firing"] is True
+        # ...the second consecutive cool evaluation resolves
+        out = tracker.evaluate(now=4.0)
+        assert out["latency"]["firing"] is False
+        assert tracker.ready()
+
+    def test_alert_counters_and_trace_markers(self):
+        telemetry.set_enabled(True)
+        tracer = tracing.install()
+        _, agg = self._hot_agg(frac_slow=0.2)
+        tracker = obs_slo.SLOTracker(agg, [obs_slo.AlertRule(
+            "latency", "serve.latency_s p99 < 0.05",
+            windows_s=(2.0,), burn_threshold=2.0, clear_for=1,
+        )])
+        tracker.evaluate(now=1.0)
+        snap = telemetry.snapshot()
+        assert snap["counters"]["obs.alert.fired"] == 1
+        assert snap["counters"]["slo.evaluations"] == 1
+        assert snap["gauges"]["slo.latency.burn_rate"] > 2.0
+        assert any(e["name"] == "slo_alert_fired" for e in tracer.events)
+
+    def test_availability_objective_from_counters(self):
+        _, agg = self._hot_agg()  # 5 rejected / 100 total = 5% errors
+        obj = obs_slo.Availability(good="serve.requests",
+                                   bad="serve.rejected", target=0.99)
+        err = obj.error_rate(agg, 2.0, now=1.0)
+        assert err == pytest.approx(0.05)
+        rule = obs_slo.AlertRule("avail", obj, windows_s=(2.0,),
+                                 burn_threshold=2.0)
+        tracker = obs_slo.SLOTracker(agg, [rule])
+        out = tracker.evaluate(now=1.0)
+        assert out["avail"]["firing"] is True  # 5x the 1% budget
+
+    def test_no_data_means_no_alert(self):
+        r = telemetry.Registry()
+        agg = timeseries.WindowedAggregator(r, interval_s=1.0)
+        tracker = obs_slo.SLOTracker(agg, [obs_slo.AlertRule(
+            "latency", "serve.latency_s p99 < 0.05", windows_s=(1.0,),
+        )])
+        out = tracker.evaluate(now=1.0)
+        assert out["latency"]["firing"] is False
+        assert out["latency"]["burns"]["1.0"] is None
+
+    def test_attach_feeds_readyz(self):
+        _, agg = self._hot_agg(frac_slow=0.2)
+        tracker = obs_slo.SLOTracker(agg, [obs_slo.AlertRule(
+            "latency", "serve.latency_s p99 < 0.05",
+            windows_s=(1e6,), burn_threshold=2.0, clear_for=1,
+        )]).attach()
+        try:
+            ok, checks = obs_server.evaluate_readiness()
+            assert not ok and checks["slo"]["firing"] == ["latency"]
+        finally:
+            obs_server.unregister_readiness("slo")
+
+    def test_rule_validation(self):
+        with pytest.raises(ValueError, match="token"):
+            obs_slo.AlertRule("Bad Name", "serve.latency_s p99 < 1")
+        with pytest.raises(ValueError, match="windows"):
+            obs_slo.AlertRule("r", "serve.latency_s p99 < 1",
+                              windows_s=())
+        with pytest.raises(ValueError, match="duplicate"):
+            obs_slo.SLOTracker(None, [
+                obs_slo.AlertRule("r", "serve.latency_s p99 < 1"),
+                obs_slo.AlertRule("r", "serve.latency_s p50 < 1"),
+            ])
+
+
+# ----------------------------------------------------- metric name pins
+
+
+class TestMonitorMetricPins:
+    def test_six_pinned_names(self):
+        """ISSUE 8 satellite: the live-monitoring layer's metric names
+        are a closed, documented set — drift here silently breaks
+        dashboards keyed on them."""
+        assert obs_server.MONITOR_METRICS == (
+            "obs.server.requests",
+            "obs.server.scrape_s",
+            "obs.alert.fired",
+            "obs.alert.resolved",
+            "slo.evaluations",
+            "monitor.heartbeat_age_s",
+        )
+
+    def test_pinned_names_validate_and_are_produced(self):
+        """Every pinned name passes the schema validator inside a real
+        snapshot, and the layer actually produces each one."""
+        telemetry.set_enabled(True)
+        tracer_agg = self._produce_all()
+        snap = telemetry.validate_snapshot(telemetry.snapshot())
+        produced = (set(snap["counters"]) | set(snap["gauges"])
+                    | set(snap["histograms"]))
+        missing = set(obs_server.MONITOR_METRICS) - produced
+        assert not missing, f"never produced: {sorted(missing)}"
+
+    @staticmethod
+    def _produce_all():
+        r = telemetry.Registry()
+        agg = timeseries.WindowedAggregator(r, interval_s=1.0)
+        agg.tick(now=0.0)
+        h = r.histogram("serve.latency_s", buckets=(0.01, 1.0))
+        for _ in range(100):
+            h.observe(0.5)
+        agg.tick(now=1.0)
+        tracker = obs_slo.SLOTracker(agg, [obs_slo.AlertRule(
+            "latency", "serve.latency_s p99 < 0.05",
+            windows_s=(2.0,), clear_for=1,
+        )])
+        tracker.evaluate(now=1.0)  # obs.alert.fired + slo.evaluations
+        # starve the window -> resolve
+        for t in (2.0, 3.0):
+            agg.tick(now=t)
+        h2 = r.histogram("serve.latency_s", buckets=(0.01, 1.0))
+        for _ in range(500):
+            h2.observe(0.001)
+        agg.tick(now=4.0)
+        tracker.evaluate(now=4.0)  # obs.alert.resolved
+        with obs_server.MonitoringServer(
+            port=0, host="127.0.0.1", registry=r
+        ) as srv:
+            _get(f"http://127.0.0.1:{srv.port}/metrics")   # requests+scrape
+            _get(f"http://127.0.0.1:{srv.port}/healthz")   # heartbeat gauge
+        return agg

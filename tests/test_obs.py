@@ -55,6 +55,45 @@ class TestCounterGaugeHistogram:
         g.set(1.5)
         assert g.value == 1.5
 
+    def test_gauge_inc_dec(self):
+        """ISSUE 8 satellite: level gauges (queue depth, in-flight)
+        need atomic adjust — read-modify-write via set() loses updates
+        under concurrency."""
+        r = telemetry.Registry()
+        g = r.gauge("q")
+        assert g.inc() == 1.0
+        assert g.inc(2.5) == 3.5
+        assert g.dec(0.5) == 3.0
+        assert g.value == 3.0
+
+    def test_gauge_inc_dec_thread_safety(self):
+        r = telemetry.Registry()
+        g = r.gauge("inflight")
+
+        def work():
+            for _ in range(1000):
+                g.inc()
+                g.dec()
+
+        ts = [threading.Thread(target=work) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        # balanced inc/dec across 8 racing threads nets exactly zero —
+        # the set()-based RMW this replaces would drift
+        assert g.value == 0.0
+
+    def test_inc_gauge_helper_gated(self, monkeypatch):
+        monkeypatch.delenv("TPU_SYNCBN_TELEMETRY", raising=False)
+        telemetry.set_enabled(None)
+        telemetry.inc_gauge("serve.inflight")
+        assert len(telemetry.REGISTRY) == 0
+        telemetry.set_enabled(True)
+        telemetry.inc_gauge("serve.inflight", 2)
+        telemetry.inc_gauge("serve.inflight", -1)
+        assert telemetry.REGISTRY.gauge("serve.inflight").value == 1.0
+
     def test_histogram_bucketing(self):
         r = telemetry.Registry()
         h = r.histogram("h", buckets=(0.1, 1.0, 10.0))

@@ -31,6 +31,22 @@ METRIC_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
 #: when mirroring into the registry).
 PREFIX_RE = re.compile(r"^[a-z0-9_]+$")
 
+#: The subsystem vocabulary: the first dotted token of every literal
+#: metric name (and every CounterGroup prefix) must come from here.
+#: This is what keeps the export/merge/trend tooling's keyspace closed —
+#: a typo'd subsystem (``sevre.latency_s``) would otherwise mint a new
+#: top-level family that every dashboard and docs table silently lacks.
+#: Extending the vocabulary is a deliberate act: add the token here AND
+#: a docs/OBSERVABILITY.md table for it. ``obs`` / ``slo`` /
+#: ``monitor`` are ISSUE 8's live-monitoring families
+#: (``obs.server.*`` / ``obs.alert.*``, ``slo.*``,
+#: ``monitor.heartbeat_age_s`` — pinned in obs.server.MONITOR_METRICS).
+KNOWN_METRIC_PREFIXES = frozenset({
+    "audit", "bench", "checkpoint", "collectives", "data", "events",
+    "gan", "loader", "monitor", "obs", "probe", "rendezvous",
+    "resilience", "scan", "serve", "slo", "step", "train",
+})
+
 _SUPPRESS_RE = re.compile(r"#\s*audit:\s*ok(?:\[([a-z0-9_,\s]+)\])?")
 
 
@@ -632,6 +648,16 @@ def check_telemetry_name_schema(
                                     f"{kw.value.value!r} must match "
                                     f"{PREFIX_RE.pattern}",
                         ))
+                    elif kw.value.value not in KNOWN_METRIC_PREFIXES:
+                        out.append(Violation(
+                            rule="telemetry_name_schema", path=path,
+                            line=kw.value.lineno, col=kw.value.col_offset,
+                            message=f"CounterGroup prefix "
+                                    f"{kw.value.value!r} is not a known "
+                                    "subsystem token — typo, or extend "
+                                    "KNOWN_METRIC_PREFIXES (and the docs "
+                                    "table) deliberately",
+                        ))
             continue
         if not isinstance(func, ast.Attribute):
             continue
@@ -653,6 +679,15 @@ def check_telemetry_name_schema(
                 message=f"telemetry name {name!r} does not match the "
                         f"schema {METRIC_NAME_RE.pattern} "
                         "(subsystem-dotted lowercase)",
+            ))
+        elif name.split(".", 1)[0] not in KNOWN_METRIC_PREFIXES:
+            out.append(Violation(
+                rule="telemetry_name_schema", path=path, line=lit.lineno,
+                col=lit.col_offset,
+                message=f"telemetry name {name!r} has unknown subsystem "
+                        f"prefix {name.split('.', 1)[0]!r} — typo, or "
+                        "extend KNOWN_METRIC_PREFIXES (and the docs "
+                        "table) deliberately",
             ))
     return out
 
@@ -702,6 +737,89 @@ def check_unpaired_trace_span(
 
 
 # ---------------------------------------------------------------------------
+# rule: wallclock_duration
+
+def _is_wallclock_call(node: ast.AST) -> bool:
+    """``time.time()`` in either spelling (``import time`` /
+    ``from time import time``)."""
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func)
+    return d == "time.time" or (
+        isinstance(node.func, ast.Name) and node.func.id == "time"
+    )
+
+
+def check_wallclock_duration(
+    tree: ast.AST, path: str, src_lines: Sequence[str]
+) -> list[Violation]:
+    """``wallclock_duration``: a duration computed by subtracting
+    ``time.time()`` readings. Wall clock steps and slews under NTP (and
+    jumps across suspend), so a "duration" from it can be negative or
+    minutes off — harmless in a log line's timestamp, catastrophic in a
+    deadline/watchdog/rate computation (the alert engine in ``obs.slo``
+    and every rate window in ``obs.timeseries`` key off elapsed time).
+    Durations must come from ``time.monotonic()`` /
+    ``time.perf_counter()``; ``time.time()`` is for *timestamps* only
+    (never subtracted).
+
+    Detected forms: a ``-`` expression with a ``time.time()`` call on
+    either side, and subtraction of names/attributes previously bound
+    from ``time.time()`` in the same function (``t0 = time.time(); ...;
+    elapsed = time.time() - t0`` — the classic shape)."""
+    out: list[Violation] = []
+
+    def scan(scope_body: Iterable[ast.AST]) -> None:
+        nodes = list(scope_body)
+        # pass 1: names/attrs bound from time.time() anywhere in the
+        # scope (walk order is not source order; binding-before-use is
+        # over-approximated, which for a lint errs the right way)
+        wall_names: set[str] = set()
+        for node in nodes:
+            if isinstance(node, ast.Assign) and _is_wallclock_call(node.value):
+                for t in node.targets:
+                    d = _dotted(t)
+                    if d:
+                        wall_names.add(d)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                    and _is_wallclock_call(node.value):
+                d = _dotted(node.target)
+                if d:
+                    wall_names.add(d)
+        # pass 2: subtractions touching a wall-clock reading
+        for node in nodes:
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)):
+                continue
+            sides = (node.left, node.right)
+            hit = any(_is_wallclock_call(s) for s in sides) or any(
+                (d := _dotted(s)) and d in wall_names for s in sides
+            )
+            if hit:
+                out.append(Violation(
+                    rule="wallclock_duration", path=path,
+                    line=node.lineno, col=node.col_offset,
+                    message="duration computed from time.time() — wall "
+                            "clock steps/slews under NTP; use "
+                            "time.monotonic() or time.perf_counter() "
+                            "for elapsed time (time.time() is for "
+                            "timestamps only)",
+                ))
+
+    # one scope per function (bindings don't leak across defs), plus the
+    # module top level
+    for fdef in ast.walk(tree):
+        if isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan(_walk_own_body(fdef))
+    module_nodes = [
+        n for n in ast.walk(tree)
+        if not any(True for _ in _enclosing_functions(n))
+    ]
+    scan(module_nodes)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # driver
 
 RULES: dict[str, Callable] = {
@@ -711,6 +829,7 @@ RULES: dict[str, Callable] = {
     "unlocked_shared_state": check_unlocked_shared_state,
     "telemetry_name_schema": check_telemetry_name_schema,
     "unpaired_trace_span": check_unpaired_trace_span,
+    "wallclock_duration": check_wallclock_duration,
 }
 
 

@@ -1,6 +1,6 @@
-"""Observability subsystem: structured telemetry, step tracing, step stats.
+"""Observability subsystem: telemetry, tracing, step stats, live monitoring.
 
-Three modules, one budget rule — near-zero cost when off:
+Six modules, one budget rule — near-zero cost when off:
 
 * :mod:`tpu_syncbn.obs.telemetry` — process-wide named counters, gauges,
   and fixed-bucket histograms; env-gated (``TPU_SYNCBN_TELEMETRY``),
@@ -13,11 +13,30 @@ Three modules, one budget rule — near-zero cost when off:
   data-wait / transfer / step timing seams, and on-device scalar
   monitors (grad norm, BN running-stat health, non-finite counts) that
   ride the compiled step's outputs so no extra device syncs are added.
+* :mod:`tpu_syncbn.obs.timeseries` — windowed aggregation over the
+  registry: ring buffer of per-interval deltas giving rolling rates
+  (steps/s, req/s, bytes/s) and rolling-window p50/p99.
+* :mod:`tpu_syncbn.obs.server` — env-gated (``TPU_SYNCBN_METRICS_PORT``)
+  stdlib HTTP server: ``/metrics`` Prometheus exposition, ``/healthz``
+  heartbeat liveness, ``/readyz`` readiness-hook conjunction.
+* :mod:`tpu_syncbn.obs.slo` — declarative SLO objectives with
+  multi-window error-budget burn-rate alert rules (hysteresis), feeding
+  ``/readyz`` and the ``obs.alert.*`` counters.
 
-See docs/OBSERVABILITY.md for knobs, schemas, and the Perfetto how-to.
+See docs/OBSERVABILITY.md for knobs, schemas, the Perfetto how-to, and
+the live-monitoring quickstart.
 """
 
-from tpu_syncbn.obs import stepstats, telemetry, tracing  # noqa: F401
+from tpu_syncbn.obs import (  # noqa: F401
+    server,
+    slo,
+    stepstats,
+    telemetry,
+    timeseries,
+    tracing,
+)
+from tpu_syncbn.obs.server import MONITOR_METRICS, MonitoringServer  # noqa: F401
+from tpu_syncbn.obs.slo import AlertRule, Availability, SLOTracker  # noqa: F401
 from tpu_syncbn.obs.telemetry import (  # noqa: F401
     REGISTRY,
     Counter,
@@ -26,12 +45,16 @@ from tpu_syncbn.obs.telemetry import (  # noqa: F401
     Histogram,
     Registry,
 )
+from tpu_syncbn.obs.timeseries import WindowedAggregator  # noqa: F401
 from tpu_syncbn.obs.tracing import Tracer  # noqa: F401
 
 __all__ = [
     "telemetry",
     "tracing",
     "stepstats",
+    "timeseries",
+    "server",
+    "slo",
     "REGISTRY",
     "Registry",
     "Counter",
@@ -39,4 +62,10 @@ __all__ = [
     "Gauge",
     "Histogram",
     "Tracer",
+    "WindowedAggregator",
+    "MonitoringServer",
+    "MONITOR_METRICS",
+    "SLOTracker",
+    "AlertRule",
+    "Availability",
 ]

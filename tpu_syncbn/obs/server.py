@@ -1,0 +1,366 @@
+"""Live monitoring endpoints: ``/metrics``, ``/healthz``, ``/readyz``.
+
+The surface a load balancer, a Prometheus scraper, or a k8s probe points
+at. Stdlib-only (``http.server`` on a daemon thread), **off by
+default**: nothing listens unless ``TPU_SYNCBN_METRICS_PORT`` is set
+(:func:`start_from_env` — both :class:`~tpu_syncbn.runtime.resilience.ResilientLoop`
+and :class:`~tpu_syncbn.serve.batcher.DynamicBatcher` call it, so
+exporting the port is the only knob a training or serving run needs) or
+a :class:`MonitoringServer` is built explicitly (tests bind port 0).
+
+* ``/metrics`` — Prometheus text exposition (``text/plain; version=0.0.4``)
+  rendered from the telemetry registry: counters as ``*_total``, gauges
+  plain, histograms as cumulative ``_bucket{le=...}`` / ``_sum`` /
+  ``_count`` families with correct ``# TYPE`` lines.
+* ``/healthz`` — liveness: every registered heartbeat
+  (:data:`HEARTBEATS`; ResilientLoop beats per step/chunk, the batcher's
+  collector per loop iteration) must be younger than ``max_age``;
+  otherwise 503 with the stale sources named. A process that answers
+  but whose step loop stopped moving is exactly the "stuck host" the
+  cumulative-export design could not see.
+* ``/readyz`` — readiness: every hook in the process readiness registry
+  (:func:`register_readiness`) must pass — the batcher's hook (not
+  draining, queue depth below threshold), the loop's hook (preemption
+  not signaled, no divergence rollback in progress), and any attached
+  SLO alert state (:meth:`tpu_syncbn.obs.slo.SLOTracker.attach`). 503
+  tells the balancer to stop sending traffic *before* the queue-full
+  rejection path has to shed it.
+
+Six monitoring metric names are pinned (:data:`MONITOR_METRICS`) into
+the telemetry-name allowance (``audit.srclint.KNOWN_METRIC_PREFIXES``)
+and the docs table; drift fails tests/test_monitor.py.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from tpu_syncbn.obs import telemetry
+
+_ENV_PORT = "TPU_SYNCBN_METRICS_PORT"
+
+#: The live-monitoring layer's own pinned metric names (schema-pinned in
+#: tests/test_monitor.py; documented in docs/OBSERVABILITY.md).
+MONITOR_METRICS = (
+    "obs.server.requests",      # counter: HTTP requests answered
+    "obs.server.scrape_s",      # histogram: /metrics render+serve latency
+    "obs.alert.fired",          # counter: SLO alert rule transitions to firing
+    "obs.alert.resolved",       # counter: SLO alert rule resolutions
+    "slo.evaluations",          # counter: SLO rule-set evaluations
+    "monitor.heartbeat_age_s",  # gauge: oldest registered heartbeat age
+)
+
+
+# ---------------------------------------------------------------------------
+# liveness: heartbeats
+
+
+class Heartbeats:
+    """Named liveness beats on the monotonic clock. Producers call
+    :meth:`beat` from their hot loop (a dict store under a lock — cheap
+    enough per step); ``/healthz`` reads :meth:`ages`. ``now`` is
+    injectable for deterministic tests."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._beats: dict[str, float] = {}
+
+    def beat(self, source: str, now: float | None = None) -> None:
+        t = time.monotonic() if now is None else float(now)
+        with self._lock:
+            self._beats[source] = t
+
+    def clear(self, source: str | None = None) -> None:
+        with self._lock:
+            if source is None:
+                self._beats.clear()
+            else:
+                self._beats.pop(source, None)
+
+    def ages(self, now: float | None = None) -> dict[str, float]:
+        t = time.monotonic() if now is None else float(now)
+        with self._lock:
+            return {name: max(0.0, t - ts) for name, ts in self._beats.items()}
+
+
+#: Process-wide heartbeat table every producer beats into.
+HEARTBEATS = Heartbeats()
+
+
+# ---------------------------------------------------------------------------
+# readiness: hook registry
+
+
+_readiness_lock = threading.Lock()
+_readiness: dict[str, Callable[[], tuple[bool, dict]]] = {}
+
+
+def register_readiness(
+    name: str, fn: Callable[[], tuple[bool, dict]]
+) -> None:
+    """Register (or replace) readiness hook ``name``. ``fn`` returns
+    ``(ok, detail_dict)``; a raising hook reads as NOT ready (fail
+    closed — an un-evaluable readiness claim is not a ready signal)."""
+    with _readiness_lock:
+        _readiness[name] = fn
+
+
+def unregister_readiness(name: str) -> None:
+    with _readiness_lock:
+        _readiness.pop(name, None)
+
+
+def evaluate_readiness() -> tuple[bool, dict]:
+    """Run every registered hook; overall ok is the conjunction."""
+    with _readiness_lock:
+        hooks = dict(_readiness)
+    ok = True
+    checks: dict[str, dict] = {}
+    for name, fn in sorted(hooks.items()):
+        try:
+            hook_ok, detail = fn()
+            hook_ok = bool(hook_ok)
+        except Exception as e:  # fail closed, never crash the endpoint
+            hook_ok, detail = False, {"error": f"{type(e).__name__}: {e}"}
+        checks[name] = {"ok": hook_ok, **dict(detail)}
+        ok = ok and hook_ok
+    return ok, checks
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+
+
+_NAME_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str, namespace: str) -> str:
+    return f"{namespace}_{_NAME_SANITIZE_RE.sub('_', name)}"
+
+
+def _prom_num(v: float) -> str:
+    if v != v:  # NaN
+        return "NaN"
+    if v in (float("inf"), float("-inf")):
+        return "+Inf" if v > 0 else "-Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def render_prometheus(snap: dict, *, namespace: str = "tpu_syncbn") -> str:
+    """Render a snapshot-shaped dict (``Registry.snapshot()``) as
+    Prometheus text exposition format 0.0.4: counters become
+    ``<ns>_<name>_total``, gauges ``<ns>_<name>``, histograms the
+    ``_bucket{le=...}`` (cumulative counts, closed with ``le="+Inf"``) /
+    ``_sum`` / ``_count`` family — each with its ``# TYPE`` line.
+    Dots in registry names become underscores (Prometheus name charset)."""
+    lines: list[str] = []
+    for name in sorted(snap.get("counters", {})):
+        pn = _prom_name(name, namespace) + "_total"
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {_prom_num(snap['counters'][name])}")
+    for name in sorted(snap.get("gauges", {})):
+        pn = _prom_name(name, namespace)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {_prom_num(snap['gauges'][name])}")
+    for name in sorted(snap.get("histograms", {})):
+        h = snap["histograms"][name]
+        pn = _prom_name(name, namespace)
+        lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        for edge, c in zip(h["buckets"], h["counts"]):
+            cum += c
+            lines.append(f'{pn}_bucket{{le="{_prom_num(edge)}"}} {cum}')
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{pn}_sum {_prom_num(h['sum'])}")
+        lines.append(f"{pn}_count {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# the server
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the stdlib default logs every request to stderr; route to the
+    # package logger at debug so a scraper doesn't spam the console
+    def log_message(self, fmt, *args):
+        from tpu_syncbn.runtime import distributed as dist
+
+        dist.get_logger("tpu_syncbn.obs").debug(
+            "metrics-server: " + fmt, *args
+        )
+
+    def _send(self, code: int, body: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        self._send(code, json.dumps(payload, indent=1).encode(),
+                   "application/json; charset=utf-8")
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        mon: "MonitoringServer" = self.server.monitor  # type: ignore[attr-defined]
+        telemetry.count("obs.server.requests")
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            t0 = time.perf_counter()
+            body = render_prometheus(
+                mon.registry.snapshot(), namespace=mon.namespace
+            ).encode()
+            self._send(200, body,
+                       "text/plain; version=0.0.4; charset=utf-8")
+            telemetry.observe("obs.server.scrape_s",
+                              time.perf_counter() - t0)
+        elif path == "/healthz":
+            ok, payload = mon.liveness()
+            self._send_json(200 if ok else 503, payload)
+        elif path == "/readyz":
+            ok, checks = evaluate_readiness()
+            self._send_json(200 if ok else 503,
+                            {"ok": ok, "checks": checks})
+        else:
+            self._send_json(404, {"error": f"no route {path!r}",
+                                  "routes": ["/metrics", "/healthz",
+                                             "/readyz"]})
+
+
+class MonitoringServer:
+    """Background HTTP server exposing the monitoring endpoints.
+
+    ``port=0`` binds an ephemeral port (tests; read it back from
+    :attr:`port`). ``max_age_s`` is the liveness threshold for
+    registered heartbeats. Pass an existing
+    :class:`~tpu_syncbn.obs.timeseries.WindowedAggregator` to share one
+    sampler; otherwise the server owns (and closes) its own, so rolling
+    rates/quantiles are being collected whenever the server is up."""
+
+    def __init__(
+        self,
+        *,
+        port: int = 0,
+        host: str = "0.0.0.0",
+        registry: telemetry.Registry | None = None,
+        aggregator=None,
+        max_age_s: float = 60.0,
+        namespace: str = "tpu_syncbn",
+    ):
+        from tpu_syncbn.obs import timeseries
+
+        if max_age_s <= 0:
+            raise ValueError(f"max_age_s must be > 0, got {max_age_s}")
+        self.registry = registry if registry is not None else telemetry.REGISTRY
+        self.max_age_s = float(max_age_s)
+        self.namespace = namespace
+        # bind FIRST: a bind failure (port taken) must raise before any
+        # background thread exists — start_from_env retries on every
+        # producer construction, and each retry must leak nothing
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._owns_aggregator = aggregator is None
+        self.aggregator = (
+            timeseries.WindowedAggregator(self.registry).start()
+            if aggregator is None else aggregator
+        )
+        self._httpd.monitor = self  # type: ignore[attr-defined]
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="obs-metrics-server",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def liveness(self, now: float | None = None) -> tuple[bool, dict]:
+        """The /healthz evaluation: every registered heartbeat younger
+        than ``max_age_s``. With no heartbeats registered the answer
+        itself is the liveness claim (the process is serving HTTP)."""
+        ages = HEARTBEATS.ages(now)
+        stale = sorted(n for n, a in ages.items() if a > self.max_age_s)
+        ok = not stale
+        worst = max(ages.values()) if ages else 0.0
+        telemetry.set_gauge("monitor.heartbeat_age_s", round(worst, 3))
+        return ok, {
+            "ok": ok,
+            "max_age_s": self.max_age_s,
+            "heartbeat_age_s": {n: round(a, 3) for n, a in sorted(ages.items())},
+            "stale": stale,
+        }
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+        if self._owns_aggregator:
+            self.aggregator.close()
+
+    def __enter__(self) -> "MonitoringServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# env-gated process server
+
+
+_active_lock = threading.Lock()
+_active: MonitoringServer | None = None
+
+
+def start_from_env() -> MonitoringServer | None:
+    """Start (once) the process monitoring server if
+    ``TPU_SYNCBN_METRICS_PORT`` is set; return it (or the one already
+    running, or ``None`` when the env gate is off). Idempotent and
+    safe to call from every subsystem's constructor — the first caller
+    with the gate set pays the (small) startup; everyone else gets the
+    existing instance. A bind failure is logged, not raised: monitoring
+    must never take down the workload it monitors."""
+    import os
+
+    global _active
+    port_s = os.environ.get(_ENV_PORT, "").strip()
+    if not port_s:
+        return None
+    with _active_lock:
+        if _active is not None:
+            return _active
+        try:
+            _active = MonitoringServer(port=int(port_s))
+        except Exception as e:
+            from tpu_syncbn.runtime import distributed as dist
+
+            dist.get_logger("tpu_syncbn.obs").error(
+                "could not start the monitoring server on %s=%s: %s: %s",
+                _ENV_PORT, port_s, type(e).__name__, e,
+            )
+            return None
+        from tpu_syncbn.runtime import distributed as dist
+
+        dist.get_logger("tpu_syncbn.obs").info(
+            "monitoring server listening on port %d "
+            "(/metrics /healthz /readyz)", _active.port,
+        )
+        return _active
+
+
+def active_server() -> MonitoringServer | None:
+    return _active
+
+
+def stop_env_server() -> None:
+    """Stop the env-gated process server (tests / clean shutdown)."""
+    global _active
+    with _active_lock:
+        srv, _active = _active, None
+    if srv is not None:
+        srv.close()

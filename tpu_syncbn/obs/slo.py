@@ -1,0 +1,307 @@
+"""Declarative SLOs: objectives, multi-window burn rates, alert rules.
+
+An SLO turns a rolling metric (:mod:`tpu_syncbn.obs.timeseries`) into an
+operable yes/no: *is this process meeting its service objective right
+now, and how fast is it spending its error budget?* Two objective
+shapes cover the serving stack:
+
+* **latency quantile** — ``"serve.latency_s p99 < 0.25"``
+  (:func:`parse_objective`): the error budget is the quantile's
+  complement (p99 → 1% of requests may exceed the threshold), and the
+  observed error rate is the windowed fraction of observations above it
+  (:meth:`~tpu_syncbn.obs.timeseries.WindowedAggregator.fraction_above`).
+* **availability** — :class:`Availability`: error rate =
+  bad / (good + bad) from two counters (e.g. ``serve.rejected`` over
+  ``serve.requests``), budget = ``1 - target``.
+
+Either way, **burn rate** = observed error rate / budgeted error rate:
+1.0 spends the budget exactly on schedule, 10x empties a 30-day budget
+in 3 days. :class:`AlertRule` evaluates the burn over *multiple*
+windows (the standard fast+slow pair) and fires only when every window
+agrees — the short window gives fast detection, the long one keeps a
+transient spike from paging. Hysteresis on the way down: a firing rule
+resolves only after ``clear_for`` consecutive evaluations below
+``clear_threshold``, so an alert flapping around the boundary does not
+flap the readiness signal it feeds.
+
+:class:`SLOTracker` owns the rules: each :meth:`~SLOTracker.evaluate`
+bumps ``slo.evaluations``, publishes per-rule ``slo.<rule>.burn_rate``
+gauges, counts ``obs.alert.fired`` / ``obs.alert.resolved`` transitions
+with trace instant markers, and (once :meth:`~SLOTracker.attach`-ed)
+feeds ``/readyz`` — a firing alert flips the process not-ready before
+queue collapse does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+from typing import Sequence
+
+from tpu_syncbn.obs import telemetry, tracing
+
+_OBJECTIVE_RE = re.compile(
+    r"^\s*(?P<metric>[a-z0-9_]+(?:\.[a-z0-9_]+)+)\s+"
+    r"p(?P<q>\d{1,2}(?:\.\d+)?)\s*<\s*"
+    r"(?P<threshold>[0-9.eE+-]+)\s*$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LatencyObjective:
+    """``metric``'s ``quantile`` must stay below ``threshold`` (seconds
+    or whatever unit the histogram records). Error budget: ``1 - q``."""
+
+    metric: str
+    quantile: float  # e.g. 0.99
+    threshold: float
+
+    def __post_init__(self):
+        if not 0.0 < self.quantile < 1.0:
+            raise ValueError(
+                f"quantile must be in (0, 1), got {self.quantile}"
+            )
+        if self.threshold <= 0:
+            raise ValueError(
+                f"threshold must be > 0, got {self.threshold}"
+            )
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.quantile
+
+    def error_rate(self, agg, window_s: float, now=None) -> float | None:
+        return agg.fraction_above(
+            self.metric, self.threshold, window_s, now=now
+        )
+
+    def describe(self) -> str:
+        return f"{self.metric} p{self.quantile * 100:g} < {self.threshold:g}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Availability:
+    """Error rate = ``bad / (good + bad)`` from two counters; the
+    objective is ``1 - error_rate >= target`` (budget ``1 - target``)."""
+
+    good: str
+    bad: str
+    target: float  # e.g. 0.999
+
+    def __post_init__(self):
+        if not 0.0 < self.target < 1.0:
+            raise ValueError(f"target must be in (0, 1), got {self.target}")
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.target
+
+    def error_rate(self, agg, window_s: float, now=None) -> float | None:
+        good = agg.rate(self.good, window_s, now=now)
+        bad = agg.rate(self.bad, window_s, now=now)
+        if good is None and bad is None:
+            return None
+        total = (good or 0.0) + (bad or 0.0)
+        if total <= 0:
+            return None  # no traffic: no evidence either way
+        return (bad or 0.0) / total
+
+    def describe(self) -> str:
+        return (f"availability {self.good} vs {self.bad} "
+                f">= {self.target:g}")
+
+
+def parse_objective(spec: str) -> LatencyObjective:
+    """Parse the declarative latency form: ``"<metric> pQQ < X"``
+    (``"serve.latency_s p99 < 0.25"``). Availability objectives are
+    built directly (:class:`Availability` — they name two metrics, which
+    a one-line string would only obscure)."""
+    m = _OBJECTIVE_RE.match(spec)
+    if not m:
+        raise ValueError(
+            f"unparseable SLO objective {spec!r}; expected "
+            "'<dotted.metric> p<QQ> < <threshold>' "
+            "(e.g. 'serve.latency_s p99 < 0.25')"
+        )
+    q = float(m.group("q")) / 100.0
+    return LatencyObjective(
+        metric=m.group("metric"), quantile=q,
+        threshold=float(m.group("threshold")),
+    )
+
+
+@dataclasses.dataclass
+class AlertRule:
+    """Fire when the error-budget burn rate exceeds ``burn_threshold``
+    in EVERY window of ``windows_s`` (multi-window burn-rate alerting);
+    resolve after ``clear_for`` consecutive evaluations with every
+    window's burn below ``clear_threshold`` (hysteresis — default half
+    the firing threshold). ``objective`` is a :class:`LatencyObjective`,
+    an :class:`Availability`, or the declarative string form."""
+
+    name: str
+    objective: LatencyObjective | Availability | str
+    windows_s: Sequence[float] = (60.0, 300.0)
+    burn_threshold: float = 2.0
+    clear_threshold: float | None = None
+    clear_for: int = 2
+
+    def __post_init__(self):
+        if isinstance(self.objective, str):
+            self.objective = parse_objective(self.objective)
+        if not re.match(r"^[a-z0-9_]+$", self.name):
+            raise ValueError(
+                f"rule name {self.name!r} must be a single schema token "
+                "(it becomes the slo.<name>.burn_rate gauge)"
+            )
+        self.windows_s = tuple(float(w) for w in self.windows_s)
+        if not self.windows_s or any(w <= 0 for w in self.windows_s):
+            raise ValueError(f"windows_s must be positive, got {self.windows_s}")
+        if self.burn_threshold <= 0:
+            raise ValueError(
+                f"burn_threshold must be > 0, got {self.burn_threshold}"
+            )
+        if self.clear_threshold is None:
+            self.clear_threshold = self.burn_threshold / 2.0
+        if self.clear_for < 1:
+            raise ValueError(f"clear_for must be >= 1, got {self.clear_for}")
+
+
+class _RuleState:
+    __slots__ = ("firing", "clear_streak", "burns", "fired_count")
+
+    def __init__(self):
+        self.firing = False
+        self.clear_streak = 0
+        self.burns: dict[float, float | None] = {}
+        self.fired_count = 0
+
+
+class SLOTracker:
+    """Evaluate a rule set against a windowed aggregator and hold the
+    alert state machine. Drive :meth:`evaluate` on the sampling cadence
+    (or per ``/readyz`` probe via :meth:`attach` — evaluation is a few
+    dict walks over in-memory frames, cheap at probe rates)."""
+
+    def __init__(self, aggregator, rules: Sequence[AlertRule]):
+        names = [r.name for r in rules]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate rule names in {names}")
+        self._agg = aggregator
+        self.rules = tuple(rules)
+        self._lock = threading.Lock()
+        self._states = {r.name: _RuleState() for r in self.rules}
+        self._log = None
+
+    # -- evaluation --------------------------------------------------------
+
+    def _burn(self, rule: AlertRule, window_s: float, now) -> float | None:
+        err = rule.objective.error_rate(self._agg, window_s, now=now)
+        if err is None:
+            return None
+        return err / rule.objective.budget
+
+    def evaluate(self, now: float | None = None) -> dict[str, dict]:
+        """One evaluation pass; returns per-rule
+        ``{"firing", "burns", "objective"}``. Windows with no data
+        report burn ``None`` and (conservatively for firing, safely for
+        resolving) do NOT satisfy the fire condition — an idle process
+        is not in violation, and a rule can only fire on evidence."""
+        telemetry.count("slo.evaluations")
+        out: dict[str, dict] = {}
+        for rule in self.rules:
+            burns = {w: self._burn(rule, w, now) for w in rule.windows_s}
+            known = [b for b in burns.values() if b is not None]
+            all_hot = (len(known) == len(burns)
+                       and all(b > rule.burn_threshold for b in known))
+            all_cool = all(b <= rule.clear_threshold for b in known)
+            with self._lock:
+                st = self._states[rule.name]
+                st.burns = burns
+                worst = max(known) if known else 0.0
+                telemetry.set_gauge(f"slo.{rule.name}.burn_rate",
+                                    round(worst, 4))
+                if not st.firing and all_hot:
+                    st.firing = True
+                    st.clear_streak = 0
+                    st.fired_count += 1
+                    telemetry.count("obs.alert.fired")
+                    tracing.instant(
+                        "slo_alert_fired", rule=rule.name,
+                        objective=rule.objective.describe(),
+                        burn=round(worst, 4),
+                    )
+                    self._logger().warning(
+                        "SLO alert %r FIRED: %s burning at %.2fx budget "
+                        "(threshold %.2fx)", rule.name,
+                        rule.objective.describe(), worst,
+                        rule.burn_threshold,
+                    )
+                elif st.firing:
+                    if all_cool:
+                        st.clear_streak += 1
+                        if st.clear_streak >= rule.clear_for:
+                            st.firing = False
+                            st.clear_streak = 0
+                            telemetry.count("obs.alert.resolved")
+                            tracing.instant("slo_alert_resolved",
+                                            rule=rule.name)
+                            self._logger().warning(
+                                "SLO alert %r resolved", rule.name,
+                            )
+                    else:
+                        st.clear_streak = 0  # hysteresis: streak resets
+                firing = st.firing
+            out[rule.name] = {
+                "firing": firing,
+                "burns": {str(w): (round(b, 4) if b is not None else None)
+                          for w, b in burns.items()},
+                "objective": rule.objective.describe(),
+            }
+        return out
+
+    def _logger(self):
+        if self._log is None:
+            from tpu_syncbn.runtime import distributed as dist
+
+            self._log = dist.get_logger("tpu_syncbn.obs")
+        return self._log
+
+    # -- queries -----------------------------------------------------------
+
+    def firing(self) -> list[str]:
+        with self._lock:
+            return sorted(n for n, s in self._states.items() if s.firing)
+
+    def ready(self) -> bool:
+        """Readiness contribution: no rule currently firing."""
+        return not self.firing()
+
+    def state(self) -> dict[str, dict]:
+        with self._lock:
+            return {
+                name: {
+                    "firing": st.firing,
+                    "fired_count": st.fired_count,
+                    "burns": {str(w): b for w, b in st.burns.items()},
+                }
+                for name, st in self._states.items()
+            }
+
+    # -- readiness wiring --------------------------------------------------
+
+    def attach(self, name: str = "slo"):
+        """Register this tracker as a ``/readyz`` hook: each probe
+        re-evaluates the rules and reports firing alerts as not-ready.
+        Returns ``self``; detach with
+        :func:`tpu_syncbn.obs.server.unregister_readiness`."""
+        from tpu_syncbn.obs import server as obs_server
+
+        def hook() -> tuple[bool, dict]:
+            self.evaluate()
+            firing = self.firing()
+            return not firing, {"firing": firing}
+
+        obs_server.register_readiness(name, hook)
+        return self

@@ -119,6 +119,19 @@ class Gauge:
         with self._lock:
             self._value = float(value)
 
+    def inc(self, n: float = 1.0) -> float:
+        """Add ``n`` atomically; returns the new value. The level-gauge
+        API (in-flight requests, queue depth): producers on different
+        threads must NOT read-modify-write via :meth:`set` — two
+        concurrent ``set(value + 1)`` calls lose an increment."""
+        with self._lock:
+            self._value += float(n)
+            return self._value
+
+    def dec(self, n: float = 1.0) -> float:
+        """Subtract ``n`` atomically; returns the new value."""
+        return self.inc(-n)
+
     @property
     def value(self) -> float:
         with self._lock:
@@ -247,29 +260,41 @@ class Registry:
         line) — the per-host export half of the rank-0 merge contract
         (:func:`merge_exports`). ``host`` defaults to this process's
         index when the distributed runtime is up, else 0."""
-        if host is None:
-            host = _host_index()
-        parent = os.path.dirname(os.path.abspath(path))
-        os.makedirs(parent, exist_ok=True)
-        snap = self.snapshot()
-        with open(path, "w") as f:
+        return export_snapshot_jsonl(self.snapshot(), path, host=host)
+
+
+def export_snapshot_jsonl(
+    snap: dict, path: str, *, host: int | None = None
+) -> str:
+    """Write any snapshot-shaped dict (:meth:`Registry.snapshot`, or a
+    :meth:`~tpu_syncbn.obs.timeseries.WindowedAggregator.windowed_snapshot`)
+    as a per-host JSONL export that :func:`merge_exports` accepts — ONE
+    serialization for cumulative and windowed views, so rank-0
+    aggregation of rolling metrics reuses the existing merge/validation
+    path instead of growing a second schema."""
+    validate_snapshot(snap)
+    if host is None:
+        host = _host_index()
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "kind": "meta", "schema": SCHEMA_VERSION, "host": host,
+            "wall_time": round(time.time(), 3),
+        }) + "\n")
+        for name, v in snap["counters"].items():
             f.write(json.dumps({
-                "kind": "meta", "schema": SCHEMA_VERSION, "host": host,
-                "wall_time": round(time.time(), 3),
+                "kind": "counter", "name": name, "host": host, "value": v,
             }) + "\n")
-            for name, v in snap["counters"].items():
-                f.write(json.dumps({
-                    "kind": "counter", "name": name, "host": host, "value": v,
-                }) + "\n")
-            for name, v in snap["gauges"].items():
-                f.write(json.dumps({
-                    "kind": "gauge", "name": name, "host": host, "value": v,
-                }) + "\n")
-            for name, h in snap["histograms"].items():
-                f.write(json.dumps({
-                    "kind": "histogram", "name": name, "host": host, **h,
-                }) + "\n")
-        return path
+        for name, v in snap["gauges"].items():
+            f.write(json.dumps({
+                "kind": "gauge", "name": name, "host": host, "value": v,
+            }) + "\n")
+        for name, h in snap["histograms"].items():
+            f.write(json.dumps({
+                "kind": "histogram", "name": name, "host": host, **h,
+            }) + "\n")
+    return path
 
 
 def _host_index() -> int:
@@ -311,6 +336,15 @@ def set_gauge(name: str, value: float) -> None:
     if not enabled():
         return
     REGISTRY.gauge(name).set(value)
+
+
+def inc_gauge(name: str, n: float = 1.0) -> None:
+    """Atomically add ``n`` to gauge ``name`` (no-op when disabled) —
+    the level-gauge producer path (:meth:`Gauge.inc`): concurrent
+    producers must not ``set(read() + 1)``."""
+    if not enabled():
+        return
+    REGISTRY.gauge(name).inc(n)
 
 
 def observe(

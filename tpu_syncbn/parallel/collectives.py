@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import math
 import operator
+import threading
 from typing import Any
 
 import jax
@@ -41,6 +42,19 @@ from tpu_syncbn.obs import telemetry
 from tpu_syncbn.runtime.distributed import DATA_AXIS
 
 Pytree = Any
+
+#: Running total of trace-time collective payload bytes (every _tally
+#: adds here alongside the per-op counters) — the O(1) read that lets
+#: DispatchWireTally run on the step loop without snapshotting the
+#: registry per dispatch.
+_traced_bytes_lock = threading.Lock()
+_traced_bytes_total = 0
+
+
+def traced_bytes_total() -> int:
+    """Trace-time collective bytes tallied so far in this process."""
+    with _traced_bytes_lock:
+        return _traced_bytes_total
 
 
 def _tally(op: str, tree: Pytree) -> None:
@@ -68,6 +82,11 @@ def _tally(op: str, tree: Pytree) -> None:
             continue  # abstract/dynamic leaf: skip, keep the call count
     telemetry.count(f"collectives.{op}.calls")
     telemetry.count(f"collectives.{op}.bytes", nbytes)
+    # O(1) running total for DispatchWireTally — reading it per dispatch
+    # must not pay a full registry snapshot on the step loop's hot path
+    global _traced_bytes_total
+    with _traced_bytes_lock:
+        _traced_bytes_total += nbytes
 
 
 def axis_size(axis_name: str = DATA_AXIS) -> int:
@@ -490,3 +509,54 @@ def moments_from_stats(
     mean = s / safe
     var = jnp.maximum(sq / safe - mean * mean, 0.0)
     return mean, var
+
+
+# ---------------------------------------------------------------------------
+# live wire-traffic estimation
+
+
+class DispatchWireTally:
+    """Convert trace-time collective inventories into a live per-dispatch
+    byte counter (``collectives.dispatched_bytes``).
+
+    The ``collectives.<op>.bytes`` tallies count once per *compilation*
+    (:func:`_tally`): a steady-state loop re-executing one compiled
+    program moves real bytes every step while the tallies stand still —
+    so a rate window over them reads zero exactly when traffic is
+    highest. This tally closes the gap: when a dispatch grows the
+    trace-time total (a compile happened inside it), the delta is that
+    program's per-execution inventory; every dispatch then replays the
+    inventory into ``collectives.dispatched_bytes`` (× ``steps`` for
+    fused K-step programs — scan bodies tally once but execute K times,
+    the same K-invariance the program contracts pin). The windowed
+    aggregator (``obs.timeseries``) turns that counter into the live
+    bytes/s a network-bound diagnosis or an EQuARX-style compression
+    argument needs (PAPERS.md, arXiv:2007.03298 / 2506.17615).
+
+    An estimate, not an exact meter: a concurrent compile on another
+    thread (e.g. a serve bucket warming) lands in whichever dispatch
+    observes it first. Driven by ``ResilientLoop``; no-op while
+    telemetry is disabled."""
+
+    def __init__(self):
+        self._program_bytes = 0
+        self._last_total = self._traced_total()
+
+    @staticmethod
+    def _traced_total() -> int:
+        return traced_bytes_total()
+
+    def after_dispatch(self, steps: int = 1) -> None:
+        """Record one executed program dispatch covering ``steps``
+        optimizer steps."""
+        if not telemetry.enabled():
+            return
+        total = self._traced_total()
+        if total > self._last_total:
+            # a (re)trace happened inside this dispatch: its delta is
+            # the new program's per-execution collective inventory
+            self._program_bytes = total - self._last_total
+            self._last_total = total
+        if self._program_bytes:
+            telemetry.count("collectives.dispatched_bytes",
+                            self._program_bytes * max(1, int(steps)))

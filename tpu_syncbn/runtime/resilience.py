@@ -499,6 +499,12 @@ class ResilientLoop:
         self.scan_steps = scan_steps
         self.counters = counters if counters is not None else _default_counters()
         self.step = 0
+        #: True while a divergence rollback is in flight (restore issued,
+        #: no finite step completed since) — surfaced on /readyz via
+        #: :meth:`readiness` so a balancer stops routing to a host that
+        #: is busy recovering state.
+        self.recovering = False
+        self._guard: PreemptionGuard | None = None
         self._async = None
         if async_checkpoint:
             from tpu_syncbn.utils.checkpoint import AsyncCheckpointer
@@ -533,6 +539,22 @@ class ResilientLoop:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+    def readiness(self) -> tuple[bool, dict]:
+        """The loop's ``/readyz`` contribution (registered as the
+        ``train`` hook while :meth:`run` is active): not ready once
+        preemption has been signaled (the process is about to
+        checkpoint-and-exit) or while a divergence rollback is in
+        flight. The detail block carries the live step counter, so a
+        probe can also see *where* the loop is."""
+        guard = self._guard
+        preempted = bool(guard.preempted) if guard is not None else False
+        ok = not preempted and not self.recovering
+        return ok, {
+            "step": self.step,
+            "preempted": preempted,
+            "recovering": self.recovering,
+        }
 
     def resume(self) -> int:
         """Restore the newest verified checkpoint (if any); returns the
@@ -579,6 +601,9 @@ class ResilientLoop:
             return
         restored = resume_latest(self.trainer, self.ckpt_dir)
         self.counters.bump("divergence_restores")
+        # not-ready until a finite step lands on the restored state
+        # (cleared in run(); read by /readyz through readiness())
+        self.recovering = True
         # tag the rollback with the current trace span so the Perfetto
         # timeline and this log line correlate (same id in both)
         from tpu_syncbn.obs import tracing
@@ -614,12 +639,22 @@ class ResilientLoop:
         async checkpoint writes are flushed on every exit path."""
         import numpy as _np
 
+        from tpu_syncbn.obs import server as obs_server, telemetry
+        from tpu_syncbn.parallel.collectives import DispatchWireTally
+
         policy = getattr(self.trainer, "divergence_guard", None)
         scanned = self.scan_steps > 1
         preempted = False
+        # live monitoring (docs/OBSERVABILITY.md "Live monitoring"):
+        # with TPU_SYNCBN_METRICS_PORT set this run answers /metrics,
+        # /healthz (step heartbeat below), /readyz (the `train` hook)
+        obs_server.start_from_env()
+        obs_server.register_readiness("train", self.readiness)
+        wire_tally = DispatchWireTally()
         try:
             with contextlib.ExitStack() as stack:
                 guard = stack.enter_context(PreemptionGuard())
+                self._guard = guard
                 watchdog = None
                 if self.step_deadline_s is not None:
                     # armed at the first pat: the first step's XLA compile
@@ -658,12 +693,21 @@ class ResilientLoop:
                     steps_run += k
                     if watchdog is not None:
                         watchdog.pat()
+                    # step heartbeat: /healthz reads the age of this
+                    # beat; the gauge gives scrapers the live position
+                    obs_server.HEARTBEATS.beat("train")
+                    telemetry.set_gauge("train.step", self.step)
+                    wire_tally.after_dispatch(k)
                     if policy is not None:
                         # scalar for a single step, (K,)-stacked for a
                         # chunk: the sum is the count of skipped steps
                         nonfinite = int(_np.sum(_np.asarray(
                             out.metrics.get("nonfinite", 0.0)
                         )))
+                        if nonfinite == 0:
+                            # a finite step on (possibly restored) state:
+                            # the rollback, if any, is complete — ready
+                            self.recovering = False
                         if nonfinite > 0:
                             self.counters.bump("nonfinite_steps", nonfinite)
                             if policy == "restore_last_good":
@@ -720,6 +764,12 @@ class ResilientLoop:
                     "failure was already propagating"
                 )
             raise
+        finally:
+            # the hook must not outlive the loop run: a probe hitting a
+            # finished (or crashed) loop should see "no train check",
+            # not a stale ready/not-ready claim
+            obs_server.unregister_readiness("train")
+            self._guard = None
         # async writes become durable before control leaves the loop — on
         # the preemption path this runs inside the grace window, and a
         # flush error DOES raise here: returning {'preempted': True}

@@ -45,6 +45,7 @@ from typing import Any
 
 import numpy as np
 
+from tpu_syncbn.obs import server as obs_server
 from tpu_syncbn.obs import stepstats as obs_stepstats
 from tpu_syncbn.obs import telemetry
 from tpu_syncbn.runtime import distributed as dist
@@ -93,6 +94,8 @@ class DynamicBatcher:
         max_wait_ms: float = 5.0,
         max_queue: int = 64,
         guard: Any = None,
+        ready_depth: int | None = None,
+        health_name: str = "serve",
     ):
         if max_batch is None:
             max_batch = int(engine.max_bucket)
@@ -120,6 +123,27 @@ class DynamicBatcher:
         #: ``serve.*`` when telemetry is enabled (obs.CounterGroup)
         self.counters = telemetry.CounterGroup(prefix="serve")
         self._log = dist.get_logger("tpu_syncbn.serve")
+        # live monitoring (docs/OBSERVABILITY.md "Live monitoring"):
+        # with TPU_SYNCBN_METRICS_PORT set this process answers
+        # /metrics + /healthz (collector heartbeat) + /readyz (the
+        # ``health_name`` hook below — give each batcher in a
+        # multi-model process a distinct name: registration replaces,
+        # and close() clears, whatever holds that name).
+        # ready_depth defaults to 90% of
+        # the queue bound: readiness must flip BEFORE the queue-full
+        # rejection path starts shedding, so a balancer routes away
+        # while there is still headroom.
+        if ready_depth is None:
+            ready_depth = max(1, (9 * max_queue) // 10)
+        if not 1 <= ready_depth <= max_queue:
+            raise ValueError(
+                f"ready_depth must be in [1, max_queue={max_queue}], "
+                f"got {ready_depth}"
+            )
+        self.ready_depth = int(ready_depth)
+        self._health_name = str(health_name)
+        obs_server.start_from_env()
+        obs_server.register_readiness(self._health_name, self.readiness)
         self._thread = threading.Thread(
             target=self._run, name="dynamic-batcher", daemon=True
         )
@@ -147,6 +171,31 @@ class DynamicBatcher:
         if not slots:
             return None
         return self.counters.count("items") / slots
+
+    def readiness(self) -> tuple[bool, dict]:
+        """The batcher's ``/readyz`` contribution (registered as the
+        ``health_name`` hook, default ``serve``): ready while admission
+        is open (not draining/closed) AND the queue depth is below
+        ``ready_depth`` — overload flips the probe before backpressure
+        has to reject. The detail block carries the live queue state
+        plus the engine's health summary when it offers one."""
+        depth = self._q.qsize()
+        draining = self.draining
+        ok = not draining and not self._stopped.is_set() \
+            and depth < self.ready_depth
+        detail = {
+            "queue_depth": depth,
+            "ready_depth": self.ready_depth,
+            "max_queue": self._q.maxsize,
+            "draining": draining,
+        }
+        engine_health = getattr(self._engine, "health", None)
+        if callable(engine_health):
+            try:
+                detail["engine"] = engine_health()
+            except Exception as e:  # detail, never the verdict
+                detail["engine"] = {"error": f"{type(e).__name__}: {e}"}
+        return ok, detail
 
     def submit(self, item) -> Future:
         """Enqueue one request; returns its ``Future``. Raises
@@ -201,6 +250,12 @@ class DynamicBatcher:
         carry: _Request | None = None
         try:
             while True:
+                # collector liveness: a wedged engine call stops this
+                # beat, and /healthz goes stale — the "stuck mid-batch"
+                # signal a balancer can act on. Keyed by health_name so
+                # two batchers in one process (give the second a
+                # distinct name) cannot mask each other's stall.
+                obs_server.HEARTBEATS.beat(self._health_name)
                 if carry is not None:
                     first, carry = carry, None
                 else:
@@ -295,6 +350,10 @@ class DynamicBatcher:
         self._drain_on_close = self._drain_on_close and drain
         self._closing = True
         self._thread.join(timeout)
+        # a cleanly-closed batcher must not leave a stale heartbeat
+        # (false liveness failure) or a permanently not-ready hook
+        obs_server.HEARTBEATS.clear(self._health_name)
+        obs_server.unregister_readiness(self._health_name)
 
     def __enter__(self) -> "DynamicBatcher":
         return self

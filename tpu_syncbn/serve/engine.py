@@ -284,12 +284,25 @@ class InferenceEngine:
             "program_cache": self._programs.stats(),
         }
 
+    def health(self) -> dict:
+        """Compact JSON-ready health summary for readiness probes (the
+        batcher folds it into its ``/readyz`` detail): bucket coverage
+        and program-cache state — a climbing ``compiled`` with a capped
+        ``live`` under steady traffic means shape churn is recompiling
+        on the request path."""
+        return {
+            "buckets": list(self.buckets),
+            "programs_live": len(self._programs),
+            "programs_compiled": self._programs_compiled,
+        }
+
     # -- execution ---------------------------------------------------------
 
     def _run_one(self, batch, n: int):
         import jax
 
         from tpu_syncbn.obs import stepstats as obs_stepstats
+        from tpu_syncbn.obs import telemetry
 
         bucket = self.bucket_for(n)
         pad = bucket - n
@@ -304,14 +317,23 @@ class InferenceEngine:
 
         fn = self._program(bucket, batch)
         padded = jax.tree_util.tree_map(pad_leaf, batch)
-        with obs_stepstats.timed_span(
-            "serve.infer", "serve.infer_s", n=n, bucket=bucket
-        ):
-            dev = jax.device_put(padded, self.batch_sharding)
-            out = fn(self._params, self._rest, dev)
-            # gather: host numpy, padding sliced back off — the engine's
-            # callers (the batcher's response path) want settled bytes
-            return jax.tree_util.tree_map(lambda a: np.asarray(a)[:n], out)
+        # level gauge, not set(): concurrent callers each inc/dec their
+        # own contribution atomically (obs.telemetry.Gauge.inc)
+        telemetry.inc_gauge("serve.inflight")
+        try:
+            with obs_stepstats.timed_span(
+                "serve.infer", "serve.infer_s", n=n, bucket=bucket
+            ):
+                dev = jax.device_put(padded, self.batch_sharding)
+                out = fn(self._params, self._rest, dev)
+                # gather: host numpy, padding sliced back off — the
+                # engine's callers (the batcher's response path) want
+                # settled bytes
+                return jax.tree_util.tree_map(
+                    lambda a: np.asarray(a)[:n], out
+                )
+        finally:
+            telemetry.inc_gauge("serve.inflight", -1)
 
     def predict(self, batch):
         """Run the eval forward on a host batch pytree (leading axis =
