@@ -522,7 +522,19 @@ def measure_serve(dp, batch, *, n_chips: int) -> dict:
     sweep — compile time is reported separately (``warm_compile_s``),
     never inside a latency percentile. Headline fields are the
     saturating level's; the per-level breakdown rides in ``levels``.
-    Schema pinned by tests/test_bench_tooling.py."""
+
+    The closed-loop sweep cannot observe the stack *past* saturation
+    (every client waits for its answer, so offered load self-limits) —
+    the ``open_loop`` section (ISSUE 9 / ROADMAP item 4) can: an
+    open-loop Poisson generator (``serve.loadgen``) sweeps offered load
+    from half the measured closed-loop capacity to ~3x it against a
+    deadline-enabled batcher (EDF admission + predicted-completion
+    shedding + circuit breaker, ``serve.admission``). The acceptance
+    regime is *graceful degradation*: reported ``p99_bounded`` (client
+    p99 within the pinned per-request SLO) must hold at every level
+    while sheds/rejections rise with offered load
+    (``degradation_graceful``) — bounded tail + rising sheds instead of
+    queueing collapse. Schema pinned by tests/test_bench_tooling.py."""
     import threading
 
     import numpy as np
@@ -601,6 +613,15 @@ def measure_serve(dp, batch, *, n_chips: int) -> dict:
             f"p99 {levels_out[-1]['latency_p99_ms']} ms, "
             f"fill {levels_out[-1]['fill_ratio']}")
     sat = levels_out[-1]
+    try:
+        open_loop = measure_serve_open_loop(
+            engine, x, gb=gb, max_batch=max_batch, max_wait_ms=max_wait_ms,
+            capacity_rps=sat["throughput_rps"],
+            closed_loop_p50_ms=sat["latency_p50_ms"],
+        )
+    except Exception as e:  # null only this section, keep closed-loop
+        log(f"serve open-loop measurement failed: {type(e).__name__}: {e}")
+        open_loop = None
     stats = engine.stats()
     return {
         "buckets": stats["buckets"],
@@ -618,6 +639,101 @@ def measure_serve(dp, batch, *, n_chips: int) -> dict:
         "fill_ratio": sat["fill_ratio"],
         "buckets_compiled": stats["programs_compiled"],
         "drained": bat.drained,
+        "open_loop": open_loop,
+    }
+
+
+def measure_serve_open_loop(
+    engine, x, *, gb: int, max_batch: int, max_wait_ms: float,
+    capacity_rps: float, closed_loop_p50_ms: float,
+) -> dict:
+    """The ``open_loop`` section of the serve block: offered-load sweep
+    past saturation (see :func:`measure_serve`). Split out so a failure
+    here nulls only this section, never the closed-loop numbers."""
+    from tpu_syncbn import serve as serve_lib
+    from tpu_syncbn.obs import timeseries
+
+    # the pinned per-request SLO: generous on a CPU smoke (the absolute
+    # number is backend noise; the *shape* — bounded p99, rising sheds —
+    # is the contract). Scaled from the measured closed-loop p50 so the
+    # same code is meaningful on real hardware.
+    slo_ms = max(200.0, 6.0 * closed_loop_p50_ms)
+    # the closed-loop throughput badly understates a batching engine's
+    # true service rate (clients wait in lockstep), so the sweep is
+    # adaptive: start below the closed-loop number and escalate offered
+    # load 3x per level until the stack actually drops traffic (sheds +
+    # rejections > 5% of offered) — THAT is the past-saturation regime
+    # ROADMAP item 4 wants observed — or a level cap is hit.
+    rate = 0.5 * max(capacity_rps, 1.0)
+    max_levels = 7
+    drop_frac_target = 0.05
+    # the PR 7 windowed aggregator feeds the shed estimator: telemetry
+    # is force-enabled for the bench run, so serve.infer_s lands in the
+    # registry and the rolling quantile is live; the batcher's own EWMA
+    # covers the first level's cold start
+    agg = timeseries.WindowedAggregator(interval_s=0.25).start()
+    bat = serve_lib.DynamicBatcher(
+        engine, max_batch=max_batch, max_wait_ms=max_wait_ms,
+        max_queue=4 * max_batch, deadline_ms=slo_ms,
+        estimator=serve_lib.LatencyEstimator(aggregator=agg),
+        health_name="serve_open_loop",
+    )
+    try:
+        gen = serve_lib.OpenLoopLoadGen(
+            bat.submit,
+            make_request=lambda i: x[i % gb:i % gb + 1],
+            deadline_ms=slo_ms,
+        )
+        levels = []
+        for li in range(max_levels):
+            # bound the per-level request count so extreme escalation
+            # stays a smoke, not a soak
+            duration_s = max(0.25, min(1.5, 3000.0 / rate))
+            report = gen.run(serve_lib.poisson_arrivals(
+                rate, duration_s, seed=li,
+            ), collect_timeout_s=120.0)
+            lvl = report.summary()
+            lvl["p99_bounded"] = (
+                lvl["latency_p99_ms"] is not None
+                and lvl["latency_p99_ms"] <= slo_ms
+            )
+            levels.append(lvl)
+            log(f"serve open-loop {lvl['offered_rps']} rps offered: "
+                f"goodput {lvl['goodput_rps']} rps, "
+                f"p99 {lvl['latency_p99_ms']} ms, "
+                f"shed {lvl['shed']}, rejected {lvl['rejected']}")
+            dropped_frac = ((lvl["shed"] + lvl["rejected"])
+                            / max(1, lvl["offered"]))
+            if li >= 1 and dropped_frac > drop_frac_target:
+                break  # overload observed: sweep done
+            rate *= 3.0
+    finally:
+        bat.close(drain=True)
+        agg.close()
+    top, first = levels[-1], levels[0]
+    dropped = [lv["shed"] + lv["rejected"] for lv in levels]
+    return {
+        "slo_ms": round(slo_ms, 3),
+        "deadline_ms": round(slo_ms, 3),
+        "levels": levels,
+        # headline = the most-overloaded level
+        "offered_rps": top["offered_rps"],
+        "goodput_rps": top["goodput_rps"],
+        "latency_p99_ms": top["latency_p99_ms"],
+        "deadline_miss_rate": top["deadline_miss_rate"],
+        "shed_rate": top["shed_rate"],
+        "shed": top["shed"],
+        "rejected": top["rejected"],
+        # the ROADMAP item 4 acceptance shape: tail bounded at every
+        # level, and overload turned into sheds/rejections (monotone-ish:
+        # the top level drops at least as much as the first)
+        "p99_bounded": all(lv["p99_bounded"] for lv in levels),
+        "sheds_rise": dropped[-1] > dropped[0],
+        "degradation_graceful": (
+            all(lv["p99_bounded"] for lv in levels)
+            and dropped[-1] > dropped[0]
+            and first["goodput_rps"] > 0
+        ),
     }
 
 

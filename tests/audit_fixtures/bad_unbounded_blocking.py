@@ -1,0 +1,45 @@
+"""Planted violations for the ``unbounded_blocking`` rule: blocking
+queue/thread waits with no timeout inside thread-owning scopes (the
+serve-hardening incident class: a wedged peer thread turns every one of
+these into a silent forever-hang). Lint input only — never imported."""
+
+import queue
+import threading
+
+
+class WedgeableWorker:
+    """Owns a collector thread — every unbounded wait here can hang the
+    whole subsystem when the peer dies."""
+
+    def __init__(self):
+        self._q = queue.Queue(maxsize=4)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._q.get()  # BAD: blocks forever if producer died
+            if item is None:
+                return
+
+    def submit(self, item):
+        self._q.put(item)  # BAD: full queue + dead consumer = forever
+
+    def close(self):
+        self._thread.join()  # BAD: no timeout, no is_alive() check
+
+
+def consumer_loop(source):
+    out_q = queue.Queue(maxsize=2)
+
+    def produce():
+        for item in source:
+            out_q.put(item, timeout=0.1)  # ok: bounded
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    while True:
+        item = out_q.get()  # BAD: producer may die without a sentinel
+        if item is None:
+            break
+    t.join()  # BAD: unbounded join on a possibly-wedged thread

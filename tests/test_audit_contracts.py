@@ -398,6 +398,8 @@ class TestProgramCacheStats:
                 "hits": 1,
                 "misses": scan_driver.MAX_CACHED_PROGRAMS + 3,
                 "evictions": 3,
+                "bytes_live": 0,   # no size_of hook: entries unsized
+                "max_bytes": None,
             }
             counters = telemetry.snapshot()["counters"]
             assert counters["serve.program_cache.hits"] == 1
@@ -412,6 +414,95 @@ class TestProgramCacheStats:
         cache: dict = {}
         assert scan_driver.cached_program(cache, 1, lambda: "x") == "x"
         assert scan_driver.cached_program(cache, 1, lambda: "y") == "x"
+
+    def test_lru_eviction_spares_the_recently_hit_entry(self):
+        """ISSUE 9 satellite: the cache is LRU, not FIFO — a hit
+        refreshes an entry's eviction priority, so steady traffic over
+        a hot program survives cold shape churn (the exact case FIFO-4
+        got wrong: the oldest-inserted entry is often the hottest)."""
+        from tpu_syncbn.parallel import scan_driver
+
+        cache = scan_driver.ProgramCache()
+        for key in "abcd":  # fill to the bound (4)
+            scan_driver.cached_program(cache, key, lambda k=key: k)
+        scan_driver.cached_program(cache, "a", lambda: "a")  # hit: refresh
+        scan_driver.cached_program(cache, "e", lambda: "e")  # evicts...
+        assert "a" in cache          # ...NOT the hit entry (FIFO would)
+        assert "b" not in cache      # ...but the least recently used
+        assert set(cache) == {"a", "c", "d", "e"}
+        assert cache.evictions == 1
+
+    def test_size_aware_byte_budget_evicts_lru_first(self):
+        from tpu_syncbn.parallel import scan_driver
+
+        cache = scan_driver.ProgramCache(max_entries=10, max_bytes=100)
+        sizes = {"a": 40, "b": 40, "c": 40}
+        for key in "abc":
+            scan_driver.cached_program(
+                cache, key, lambda k=key: k,
+                size_of=lambda fn: sizes[fn],
+            )
+        # 120 bytes > 100: the least-recently-used entry went
+        assert set(cache) == {"b", "c"}
+        assert cache.bytes_live == 80
+        assert cache.stats()["max_bytes"] == 100
+        # an oversized single program still runs: never evict the
+        # just-built entry down to an empty cache
+        big = scan_driver.ProgramCache(max_entries=10, max_bytes=10)
+        scan_driver.cached_program(big, "huge", lambda: "huge",
+                                   size_of=lambda fn: 500)
+        assert set(big) == {"huge"}
+
+    def test_stored_none_counts_as_miss_and_rebuilds(self):
+        """The historical contract (PR 6), kept through the LRU
+        rewrite: a None in the cache is never a hit — it rebuilds."""
+        from tpu_syncbn.parallel import scan_driver
+
+        cache = scan_driver.ProgramCache()
+        dict.__setitem__(cache, "k", None)
+        assert scan_driver.cached_program(cache, "k", lambda: "prog") \
+            == "prog"
+        assert cache.misses == 1 and cache.hits == 0
+        assert scan_driver.cached_program(cache, "k", lambda: "other") \
+            == "prog"
+        assert cache.hits == 1
+
+    def test_unsized_entries_fall_back_to_the_entry_bound(self):
+        from tpu_syncbn.parallel import scan_driver
+
+        cache = scan_driver.ProgramCache(max_bytes=100)
+        for key in range(6):  # size_of returns None: byte budget blind
+            scan_driver.cached_program(cache, key, lambda k=key: k,
+                                       size_of=lambda fn: None)
+        assert len(cache) == scan_driver.MAX_CACHED_PROGRAMS
+        assert cache.bytes_live == 0
+
+    def test_engine_programs_carry_memory_analysis_sizes(self):
+        """The serve engine feeds XLA's memory_analysis into the cache:
+        live programs are really sized (nonzero bytes on this backend),
+        so program_cache_bytes is an enforceable budget."""
+        import numpy as np
+        import optax
+        from flax import nnx
+
+        from tpu_syncbn import nn as tnn
+        from tpu_syncbn.serve.engine import InferenceEngine
+
+        class Net(nnx.Module):
+            def __init__(self, rngs):
+                self.fc = nnx.Linear(4, 4, rngs=rngs)
+                self.bn = tnn.BatchNorm1d(4)
+
+            def __call__(self, x):
+                return self.bn(self.fc(x))
+
+        eng = InferenceEngine(
+            tnn.convert_sync_batchnorm(Net(nnx.Rngs(0))), buckets=(8, 16)
+        )
+        eng.warm(np.zeros((1, 4), np.float32))
+        stats = eng.stats()["program_cache"]
+        assert stats["live"] == 2
+        assert stats["bytes_live"] > 0
 
     def test_engine_stats_exposes_cache_accounting(self):
         import numpy as np

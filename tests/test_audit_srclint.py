@@ -29,6 +29,7 @@ RULE_FIXTURES = {
     "telemetry_name_schema": ("bad_telemetry_name_schema.py", 6),
     "unpaired_trace_span": ("bad_unpaired_trace_span.py", 3),
     "wallclock_duration": ("bad_wallclock_duration.py", 3),
+    "unbounded_blocking": ("bad_unbounded_blocking.py", 5),
 }
 
 
@@ -304,6 +305,45 @@ class TestRuleEdges:
             f"telemetry.count({name!r})\n" for name in MONITOR_METRICS
         )
         assert lint_source(src, "x.py") == []
+
+    def test_unbounded_blocking_requires_a_thread_owning_scope(self):
+        """ISSUE 9 satellite: the rule only bites where a wedged peer
+        thread can hang the subsystem — plain (non-thread-owning) code
+        with the same calls is out of scope."""
+        src = (
+            "import queue\n"
+            "q = queue.Queue()\n"
+            "def plain_consumer():\n"
+            "    return q.get()\n"
+            "def plain_join(t):\n"
+            "    t.join()\n"
+        )
+        assert lint_source(src, "x.py", rules=["unbounded_blocking"]) == []
+
+    def test_unbounded_blocking_bounded_and_lookup_forms_clean(self):
+        """Timeouts, *_nowait, and the arg-carrying lookalikes
+        (dict.get(key), str.join(xs), os.path.join(...)) never fire
+        even inside a thread-owning class."""
+        src = (
+            "import os\n"
+            "import queue\n"
+            "import threading\n"
+            "class Bounded:\n"
+            "    def __init__(self):\n"
+            "        self._q = queue.Queue(maxsize=2)\n"
+            "        self._t = threading.Thread(target=self._run)\n"
+            "    def _run(self):\n"
+            "        self._q.get(timeout=1.0)\n"
+            "        self._q.get_nowait()\n"
+            "        self._q.put(1, timeout=0.5)\n"
+            "        self._q.put_nowait(2)\n"
+            "    def close(self, cfg, parts):\n"
+            "        self._t.join(5.0)\n"
+            "        self._t.join(timeout=5.0)\n"
+            "        cfg.get('key')\n"
+            "        return os.path.join(*parts), ', '.join(parts)\n"
+        )
+        assert lint_source(src, "x.py", rules=["unbounded_blocking"]) == []
 
     def test_syntax_error_reports_parse_error(self):
         vs = lint_source("def broken(:\n", "x.py")

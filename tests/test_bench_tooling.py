@@ -676,7 +676,7 @@ class TestServeBlock:
             "buckets", "max_batch", "max_wait_ms", "warm_compile_s",
             "levels", "clients", "requests", "rejected",
             "throughput_rps", "latency_p50_ms", "latency_p99_ms",
-            "fill_ratio", "buckets_compiled", "drained",
+            "fill_ratio", "buckets_compiled", "drained", "open_loop",
         }
         assert isinstance(block["buckets"], list) and block["buckets"]
         assert all(isinstance(b, int) and b >= 1 for b in block["buckets"])
@@ -698,6 +698,38 @@ class TestServeBlock:
         assert 1 <= block["buckets_compiled"] <= 4
         assert block["rejected"] >= 0
         assert block["drained"] is True
+        # ISSUE 9: the open-loop overload section (null only if that
+        # sub-measurement failed — which is itself a failure here)
+        ol = block["open_loop"]
+        assert ol is not None
+        assert set(ol) == {
+            "slo_ms", "deadline_ms", "levels", "offered_rps",
+            "goodput_rps", "latency_p99_ms", "deadline_miss_rate",
+            "shed_rate", "shed", "rejected", "p99_bounded",
+            "sheds_rise", "degradation_graceful",
+        }
+        assert ol["slo_ms"] > 0
+        assert isinstance(ol["levels"], list) and len(ol["levels"]) >= 2
+        for lvl in ol["levels"]:
+            assert set(lvl) == {
+                "offered", "offered_rps", "duration_s", "answered",
+                "goodput_rps", "latency_p50_ms", "latency_p99_ms",
+                "deadline_miss_rate", "shed_rate", "reject_rate",
+                "late", "shed", "rejected", "errored", "lost",
+                "p99_bounded",
+            }
+            assert lvl["offered"] >= 1
+            assert lvl["lost"] == 0  # every request resolved
+        # offered load really swept past saturation...
+        assert ol["levels"][-1]["offered_rps"] > \
+            ol["levels"][0]["offered_rps"] * 2
+        # ...and degradation was graceful: the client-visible p99 stays
+        # within the pinned SLO at EVERY level while the overloaded
+        # levels shed/reject instead of queueing without bound (the
+        # ROADMAP item 4 acceptance regime)
+        assert ol["p99_bounded"] is True
+        assert ol["sheds_rise"] is True
+        assert ol["degradation_graceful"] is True
 
     def test_serve_flag_emits_block_and_line_stays_last(
         self, tmp_path, monkeypatch, capsys

@@ -820,6 +820,89 @@ def check_wallclock_duration(
 
 
 # ---------------------------------------------------------------------------
+# rule: unbounded_blocking
+
+def _constructs_thread(scope: ast.AST) -> bool:
+    """Does this class/function body construct a ``threading.Thread``
+    anywhere? Those are the scopes whose blocking calls can deadlock a
+    whole subsystem instead of one caller."""
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func) or ""
+        if d == "threading.Thread" or d == "Thread" \
+                or d.endswith(".Thread"):
+            return True
+    return False
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def check_unbounded_blocking(
+    tree: ast.AST, path: str, src_lines: Sequence[str]
+) -> list[Violation]:
+    """``unbounded_blocking``: a blocking queue ``get()``/``put(item)``
+    or thread ``join()`` with no timeout, inside a thread-owning scope
+    (a class or function that constructs ``threading.Thread``). The
+    incident class: the serving batcher's ``close()`` joined its
+    collector with a caller timeout but never checked ``is_alive()``
+    after — a wedged engine masqueraded as a clean shutdown — and any
+    no-timeout ``get``/``put``/``join`` in the same position blocks
+    *forever* when the peer thread has died (no error, no log, just a
+    stuck subsystem). Bound the wait and handle expiry, or suppress
+    with a comment explaining why the peer provably always answers
+    (e.g. a sentinel protocol that enqueues from a ``finally``).
+
+    Detected forms (timeouts make each one clean): ``x.get()`` with no
+    arguments, ``x.put(item)`` with a single argument, and ``x.join()``
+    with no arguments — the exact spellings whose stdlib semantics are
+    "wait forever". ``get_nowait``/``put_nowait``/positional timeouts
+    are fine; ``dict.get(k)``/``str.join(xs)``/``os.path.join(...)``
+    all carry arguments, so they never match."""
+    out: list[Violation] = []
+    scopes = [
+        node for node in ast.walk(tree)
+        if isinstance(node, (ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef))
+        and _constructs_thread(node)
+    ]
+    seen: set[int] = set()
+    for scope in scopes:
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            attr = func.attr
+            if _has_timeout(node):
+                continue
+            hit = None
+            if attr == "get" and not node.args and not node.keywords:
+                hit = ("queue-style .get() with no timeout blocks "
+                       "forever if the producer thread died")
+            elif attr == "put" and len(node.args) == 1 \
+                    and not node.keywords:
+                hit = ("bounded-queue .put(item) with no timeout blocks "
+                       "forever if the consumer thread died")
+            elif attr == "join" and not node.args and not node.keywords:
+                hit = (".join() with no timeout blocks forever if the "
+                       "thread is wedged — bound it and check "
+                       "is_alive() after")
+            if hit is None:
+                continue
+            seen.add(id(node))
+            out.append(Violation(
+                rule="unbounded_blocking", path=path,
+                line=node.lineno, col=node.col_offset,
+                message=f"{_dotted(func) or attr}: {hit}",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # driver
 
 RULES: dict[str, Callable] = {
@@ -830,6 +913,7 @@ RULES: dict[str, Callable] = {
     "telemetry_name_schema": check_telemetry_name_schema,
     "unpaired_trace_span": check_unpaired_trace_span,
     "wallclock_duration": check_wallclock_duration,
+    "unbounded_blocking": check_unbounded_blocking,
 }
 
 

@@ -565,7 +565,29 @@ def staged_iter(iterator, *, slots: int = 3, slot_mb: int = 64):
     t.start()
     try:
         while True:
-            kind, payload = meta_q.get()
+            # bounded wait + producer-liveness check: the producer's
+            # except/sentinel protocol *should* always enqueue a final
+            # item, but a thread torn down without one (interpreter
+            # shutdown, native crash) must surface as an error here,
+            # never as a consumer blocked forever (srclint
+            # unbounded_blocking — the PR 9 serving-hardening sweep)
+            while True:
+                try:
+                    kind, payload = meta_q.get(timeout=1.0)
+                    break
+                except queue.Empty:
+                    if not t.is_alive():
+                        # the producer can enqueue its final item and
+                        # exit between our timeout and this liveness
+                        # check — drain once before declaring it dead
+                        try:
+                            kind, payload = meta_q.get_nowait()
+                            break
+                        except queue.Empty:
+                            raise RuntimeError(
+                                "staging producer thread died without "
+                                "enqueuing a sentinel or error"
+                            ) from None
             if kind is SENTINEL:
                 break
             if kind is ERROR:

@@ -112,6 +112,41 @@ class Availability:
                 f">= {self.target:g}")
 
 
+@dataclasses.dataclass(frozen=True)
+class SubsetRate:
+    """Error rate = ``bad / total`` where ``bad`` counts a *subset* of
+    the events ``total`` counts (e.g. ``serve.deadline_miss_total`` out
+    of ``serve.requests`` — every miss was an admitted request).
+    :class:`Availability` is the disjoint-counters form
+    (``bad / (good + bad)``); feeding it a subset counter understates
+    the error rate (at a real 100% miss rate it reports 50%), which
+    halves the burn the alert acts on — hence this objective."""
+
+    total: str
+    bad: str
+    target: float  # e.g. 0.999 -> at most 0.1% of total may be bad
+
+    def __post_init__(self):
+        if not 0.0 < self.target < 1.0:
+            raise ValueError(f"target must be in (0, 1), got {self.target}")
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.target
+
+    def error_rate(self, agg, window_s: float, now=None) -> float | None:
+        total = agg.rate(self.total, window_s, now=now)
+        bad = agg.rate(self.bad, window_s, now=now)
+        if total is None and bad is None:
+            return None
+        if not total:
+            return None  # no traffic: no evidence either way
+        return min(1.0, (bad or 0.0) / total)
+
+    def describe(self) -> str:
+        return f"{self.bad} / {self.total} <= {1.0 - self.target:g}"
+
+
 def parse_objective(spec: str) -> LatencyObjective:
     """Parse the declarative latency form: ``"<metric> pQQ < X"``
     (``"serve.latency_s p99 < 0.25"``). Availability objectives are
@@ -131,6 +166,41 @@ def parse_objective(spec: str) -> LatencyObjective:
     )
 
 
+def serve_overload_rules(
+    *,
+    latency_slo: str = "serve.latency_s p99 < 0.25",
+    miss_target: float = 0.999,
+    windows_s: Sequence[float] = (60.0, 300.0),
+    burn_threshold: float = 2.0,
+) -> list["AlertRule"]:
+    """The serving stack's standard overload rule pair (ISSUE 9 —
+    docs/RESILIENCE.md "Serving failure modes"):
+
+    * ``serve_latency`` — the client-visible latency quantile objective
+      (``latency_slo``, declarative form);
+    * ``serve_overload`` — deadline misses (sheds + late answers,
+      ``serve.deadline_miss_total``) as a fraction of admitted requests
+      (``serve.requests``; :class:`SubsetRate` — misses are a subset of
+      requests, so the disjoint-counters :class:`Availability` form
+      would understate the rate): burning more than
+      ``burn_threshold``x a ``miss_target`` budget in every window
+      means graceful degradation stopped being graceful.
+
+    Attach to a tracker over the process aggregator::
+
+        SLOTracker(agg, serve_overload_rules()).attach()
+    """
+    return [
+        AlertRule("serve_latency", latency_slo,
+                  windows_s=windows_s, burn_threshold=burn_threshold),
+        AlertRule("serve_overload",
+                  SubsetRate(total="serve.requests",
+                             bad="serve.deadline_miss_total",
+                             target=miss_target),
+                  windows_s=windows_s, burn_threshold=burn_threshold),
+    ]
+
+
 @dataclasses.dataclass
 class AlertRule:
     """Fire when the error-budget burn rate exceeds ``burn_threshold``
@@ -138,10 +208,11 @@ class AlertRule:
     resolve after ``clear_for`` consecutive evaluations with every
     window's burn below ``clear_threshold`` (hysteresis — default half
     the firing threshold). ``objective`` is a :class:`LatencyObjective`,
-    an :class:`Availability`, or the declarative string form."""
+    an :class:`Availability`, a :class:`SubsetRate`, or the declarative
+    string form."""
 
     name: str
-    objective: LatencyObjective | Availability | str
+    objective: LatencyObjective | Availability | SubsetRate | str
     windows_s: Sequence[float] = (60.0, 300.0)
     burn_threshold: float = 2.0
     clear_threshold: float | None = None

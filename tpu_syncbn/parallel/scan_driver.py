@@ -143,22 +143,49 @@ def build_scan_steps(
 
 
 class ProgramCache(dict):
-    """A compiled-program cache dict with hit/miss/eviction accounting.
+    """A size-aware LRU compiled-program cache with hit/miss/eviction
+    accounting.
 
     Plain ``dict`` semantics (the historical cache shape — existing
-    pickling/inspection keeps working), plus counters that make the
-    FIFO-4 policy measurable: ROADMAP item 4's "cache smarter than
-    FIFO-4" needs a hit rate to argue from. When ``name`` is given,
-    every event also lands in the telemetry registry as
+    pickling/inspection keeps working) with two retention bounds applied
+    by :func:`cached_program`:
+
+    * ``max_entries`` — at most this many programs live (default
+      :data:`MAX_CACHED_PROGRAMS`, the historical bound);
+    * ``max_bytes`` — optional device-memory budget: when the summed
+      per-program sizes (``size_of`` hook on :func:`cached_program` —
+      the serve engine feeds XLA's ``memory_analysis``) exceed it, the
+      least-recently-used programs are evicted first. Entries whose size
+      is unknowable count ``0`` toward the budget (the entry bound still
+      covers them).
+
+    Eviction order is **LRU**, not FIFO: a hit moves the program to the
+    back of the eviction order, so steady traffic over a hot bucket set
+    never recompiles it no matter how much cold shape churn passes
+    through (ROADMAP item 4's "smarter than FIFO-4"). The counters make
+    the policy measurable; when ``name`` is given every event also lands
+    in the telemetry registry as
     ``<name>.program_cache.{hits,misses,evictions}``
     (docs/OBSERVABILITY.md)."""
 
-    def __init__(self, name: str | None = None):
+    def __init__(self, name: str | None = None, *,
+                 max_entries: int | None = None,
+                 max_bytes: int | None = None):
         super().__init__()
         self.name = name
+        self.max_entries = (MAX_CACHED_PROGRAMS if max_entries is None
+                            else int(max_entries))
+        if self.max_entries < 1:
+            raise ValueError(
+                f"max_entries must be >= 1, got {self.max_entries}"
+            )
+        if max_bytes is not None and max_bytes < 1:
+            raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
+        self.max_bytes = max_bytes
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self._sizes: dict = {}  # key -> known size in bytes
 
     def _record(self, event: str) -> None:
         setattr(self, event, getattr(self, event) + 1)
@@ -167,33 +194,88 @@ class ProgramCache(dict):
 
             telemetry.count(f"{self.name}.program_cache.{event}")
 
+    @property
+    def bytes_live(self) -> int:
+        """Summed known sizes of live programs (0-sized entries are the
+        ones no size hook could measure)."""
+        return sum(self._sizes.get(k, 0) for k in self)
+
+    def _touch(self, key) -> None:
+        """LRU bump: move ``key`` to the back of the eviction order."""
+        value = super().pop(key)
+        super().__setitem__(key, value)
+
+    def _evict_over_budget(self) -> None:
+        while len(self) > 1 and (
+            len(self) > self.max_entries
+            or (self.max_bytes is not None
+                and self.bytes_live > self.max_bytes)
+        ):
+            oldest = next(iter(self))
+            super().pop(oldest)
+            self._sizes.pop(oldest, None)
+            self._record("evictions")
+
     def stats(self) -> dict:
         """Accounting snapshot: programs currently live plus lifetime
-        hits/misses/evictions (hit rate = hits / (hits + misses))."""
+        hits/misses/evictions (hit rate = hits / (hits + misses)) and
+        the summed known program sizes vs the optional byte budget."""
         return {
             "live": len(self),
             "hits": self.hits,
             "misses": self.misses,
             "evictions": self.evictions,
+            "bytes_live": self.bytes_live,
+            "max_bytes": self.max_bytes,
         }
 
 
-def cached_program(cache: dict, key, build: Callable[[], Any]):
-    """FIFO-bounded compiled-program retention shared by the trainers'
-    fused-step caches: at most :data:`MAX_CACHED_PROGRAMS` distinct
-    programs stay live; beyond that the oldest is evicted (a varying K
-    pays a fresh compile every call — call with a FIXED chunk size).
-    ``cache`` is ideally a :class:`ProgramCache` (hit/miss/eviction
-    accounting); a plain dict still works."""
-    record = cache._record if isinstance(cache, ProgramCache) \
-        else lambda event: None
+def cached_program(cache: dict, key, build: Callable[[], Any],
+                   *, size_of: Callable[[Any], int | None] | None = None):
+    """Bounded compiled-program retention shared by the trainers' and
+    the serve engine's program caches.
+
+    With a :class:`ProgramCache`: size-aware LRU — a hit refreshes the
+    entry's eviction priority, a miss builds and then evicts
+    least-recently-used entries past ``max_entries`` or (when sizes are
+    known via ``size_of``) past ``max_bytes``. The just-built program is
+    never evicted: an oversized single program still runs, the budget
+    then squeezes everything else. With a plain ``dict`` (historical
+    callers): FIFO at :data:`MAX_CACHED_PROGRAMS`, exactly the old
+    behavior. Either way a varying key set pays fresh compiles — call
+    with a FIXED chunk size / bucket set.
+
+    ``size_of(program) -> bytes | None`` is consulted once per build;
+    ``None`` (or a raising hook) leaves the entry unsized (counts 0
+    toward ``max_bytes``; the entry bound still applies).
+
+    A stored ``None`` counts as a miss and is rebuilt (the historical
+    contract, both branches): a ``None`` program is never a servable
+    executable, and returning it forever would turn one bad build into
+    a permanent "NoneType is not callable" with no recompile."""
+    if isinstance(cache, ProgramCache):
+        if cache.get(key) is not None:
+            cache._record("hits")
+            cache._touch(key)
+            return dict.__getitem__(cache, key)
+        cache._record("misses")
+        fn = build()
+        if key in cache:  # stale stored-None: rebuilt entry goes to
+            dict.pop(cache, key)  # the back of the eviction order
+            cache._sizes.pop(key, None)
+        dict.__setitem__(cache, key, fn)
+        if size_of is not None:
+            try:
+                size = size_of(fn)
+            except Exception:
+                size = None
+            if size is not None and size > 0:
+                cache._sizes[key] = int(size)
+        cache._evict_over_budget()
+        return fn
     fn = cache.get(key)
     if fn is None:
-        record("misses")
         while len(cache) >= MAX_CACHED_PROGRAMS:
             cache.pop(next(iter(cache)))
-            record("evictions")
         fn = cache[key] = build()
-    else:
-        record("hits")
     return fn

@@ -14,6 +14,16 @@ The first non-training subsystem in the codebase (ROADMAP north star:
   pad-to-bucket coalescing, queue-full rejection (backpressure), and
   graceful drain wired to the resilience layer's
   :class:`~tpu_syncbn.runtime.resilience.PreemptionGuard`.
+* :mod:`tpu_syncbn.serve.admission` — the overload-robustness layer:
+  request deadlines with earliest-deadline-first dispatch and
+  predicted-completion load shedding (:class:`AdmissionController`,
+  :class:`LatencyEstimator`), plus a consecutive-failure
+  :class:`CircuitBreaker` with PR 1 deterministic-jitter backoff
+  half-open probes (docs/RESILIENCE.md "Serving failure modes").
+* :mod:`tpu_syncbn.serve.loadgen` — open-loop Poisson/trace-driven
+  load generation (:class:`OpenLoopLoadGen`): the offered-load-sweep
+  harness ``bench --serve`` uses to prove graceful degradation past
+  saturation (bounded p99, rising sheds — never queueing collapse).
 
 Quickstart::
 
@@ -33,12 +43,35 @@ docs/OBSERVABILITY.md for the ``serve.*`` metric schemas).
 """
 
 from tpu_syncbn.parallel.zero import unshard_params  # noqa: F401
-from tpu_syncbn.serve.batcher import DynamicBatcher, RejectedError  # noqa: F401
+from tpu_syncbn.serve.admission import (  # noqa: F401
+    AdmissionController,
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceededError,
+    LatencyEstimator,
+    RejectedError,
+)
+from tpu_syncbn.serve.batcher import DynamicBatcher  # noqa: F401
 from tpu_syncbn.serve.engine import InferenceEngine  # noqa: F401
+from tpu_syncbn.serve.loadgen import (  # noqa: F401
+    LoadReport,
+    OpenLoopLoadGen,
+    poisson_arrivals,
+    trace_arrivals,
+)
 
 __all__ = [
     "InferenceEngine",
     "DynamicBatcher",
     "RejectedError",
+    "DeadlineExceededError",
+    "CircuitOpenError",
+    "CircuitBreaker",
+    "AdmissionController",
+    "LatencyEstimator",
+    "OpenLoopLoadGen",
+    "LoadReport",
+    "poisson_arrivals",
+    "trace_arrivals",
     "unshard_params",
 ]

@@ -14,6 +14,20 @@ collector thread) rebuilt on this codebase's seams:
   full queue *rejects* the submit (:class:`RejectedError`) instead of
   growing latency without bound — load shedding at the edge, where the
   client can retry against another replica;
+* **deadlines + shedding** — with ``deadline_ms`` set (or per-request
+  via ``submit(..., deadline_ms=)``) the queue becomes
+  earliest-deadline-first (:class:`~tpu_syncbn.serve.admission.
+  AdmissionController`), and requests whose predicted completion
+  already misses their deadline are shed
+  (:class:`~tpu_syncbn.serve.admission.DeadlineExceededError`,
+  ``serve.shed`` / ``serve.deadline_miss_total``) before the engine
+  does dead work — bounded p99 past saturation instead of queueing
+  collapse (ROADMAP item 4);
+* **circuit breaking** — consecutive engine failures open a
+  :class:`~tpu_syncbn.serve.admission.CircuitBreaker`: submits
+  fast-fail with a retry-after hint, PR 1's deterministic-jitter
+  backoff schedules half-open probes, circuit state feeds ``/readyz``
+  and the ``serve.circuit_state`` gauge;
 * **graceful drain** — wired to PR 1's preemption contract: give the
   batcher a :class:`~tpu_syncbn.runtime.resilience.PreemptionGuard`
   (anything with a truthy ``preempted`` property works) and the first
@@ -49,26 +63,32 @@ from tpu_syncbn.obs import server as obs_server
 from tpu_syncbn.obs import stepstats as obs_stepstats
 from tpu_syncbn.obs import telemetry
 from tpu_syncbn.runtime import distributed as dist
+from tpu_syncbn.serve.admission import (  # noqa: F401  (re-exported API)
+    AdmissionController,
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceededError,
+    LatencyEstimator,
+    RejectedError,
+)
 
-__all__ = ["DynamicBatcher", "RejectedError"]
+__all__ = ["DynamicBatcher", "RejectedError", "DeadlineExceededError",
+           "CircuitOpenError"]
 
 #: Fill-ratio histogram boundaries (a ratio in (0, 1], not a duration).
 FILL_BUCKETS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0)
 
 
-class RejectedError(RuntimeError):
-    """The batcher refused a request: queue full (backpressure), or the
-    batcher is draining/closed. Clients should retry elsewhere."""
-
-
 class _Request:
-    __slots__ = ("payload", "n", "future", "t0")
+    __slots__ = ("payload", "n", "future", "t0", "deadline")
 
-    def __init__(self, payload, n: int):
+    def __init__(self, payload, n: int, deadline: float | None = None):
         self.payload = payload
         self.n = n
         self.future: Future = Future()
         self.t0 = time.perf_counter()
+        #: absolute completion deadline on time.monotonic, or None
+        self.deadline = deadline
 
 
 class DynamicBatcher:
@@ -84,6 +104,22 @@ class DynamicBatcher:
     ``submit(item)`` takes a host batch pytree with a leading axis of
     ``n >= 1`` (a single example is ``x[i:i+1]``) and returns a
     ``Future`` resolving to that request's output slice.
+
+    Overload policy knobs (docs/RESILIENCE.md "Serving failure modes"):
+
+    * ``deadline_ms`` — default completion deadline per request
+      (``submit(..., deadline_ms=)`` overrides per call; ``None``
+      disables deadlines entirely, which is exactly the historical FIFO
+      batcher). Deadlined requests dispatch earliest-deadline-first and
+      are shed once their predicted completion misses the deadline.
+    * ``estimator`` — the :class:`~tpu_syncbn.serve.admission.
+      LatencyEstimator` feeding shed decisions; by default one is built
+      that EWMAs this batcher's own observed engine calls (hand it one
+      wrapping a :class:`~tpu_syncbn.obs.timeseries.WindowedAggregator`
+      to use the rolling windowed ``serve.infer_s`` quantile instead).
+    * ``breaker`` — the engine :class:`~tpu_syncbn.serve.admission.
+      CircuitBreaker`; default-constructed (5 consecutive failures
+      open). Pass a configured instance, or ``False`` to disable.
     """
 
     def __init__(
@@ -96,6 +132,9 @@ class DynamicBatcher:
         guard: Any = None,
         ready_depth: int | None = None,
         health_name: str = "serve",
+        deadline_ms: float | None = None,
+        estimator: LatencyEstimator | None = None,
+        breaker: CircuitBreaker | bool | None = None,
     ):
         if max_batch is None:
             max_batch = int(engine.max_bucket)
@@ -111,11 +150,22 @@ class DynamicBatcher:
             raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
         self._engine = engine
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) / 1e3
         self._guard = guard
-        self._q: queue.Queue = queue.Queue(maxsize=max_queue)
+        self.default_deadline_ms = deadline_ms
+        self.estimator = (estimator if estimator is not None
+                          else LatencyEstimator())
+        if breaker is None:
+            breaker = CircuitBreaker(key=health_name)
+        self._breaker: CircuitBreaker | None = breaker or None
+        self._q = AdmissionController(
+            max_queue=max_queue, estimator=self.estimator,
+            on_shed=self._shed,
+        )
         self._closing = False
         self._drain_on_close = True
         self._stopped = threading.Event()
@@ -175,20 +225,28 @@ class DynamicBatcher:
     def readiness(self) -> tuple[bool, dict]:
         """The batcher's ``/readyz`` contribution (registered as the
         ``health_name`` hook, default ``serve``): ready while admission
-        is open (not draining/closed) AND the queue depth is below
+        is open (not draining/closed), the queue depth is below
         ``ready_depth`` — overload flips the probe before backpressure
-        has to reject. The detail block carries the live queue state
-        plus the engine's health summary when it offers one."""
+        has to reject — AND the engine circuit is not open (a broken
+        engine flips the probe before clients pay fast-rejections;
+        half-open reads ready again, since probe traffic has to come
+        from somewhere). The detail block carries the live queue +
+        circuit state plus the engine's health summary when it offers
+        one."""
         depth = self._q.qsize()
         draining = self.draining
+        circuit_open = (self._breaker is not None
+                        and self._breaker.state == CircuitBreaker.OPEN)
         ok = not draining and not self._stopped.is_set() \
-            and depth < self.ready_depth
+            and depth < self.ready_depth and not circuit_open
         detail = {
             "queue_depth": depth,
             "ready_depth": self.ready_depth,
             "max_queue": self._q.maxsize,
             "draining": draining,
         }
+        if self._breaker is not None:
+            detail["circuit"] = self._breaker.stats()
         engine_health = getattr(self._engine, "health", None)
         if callable(engine_health):
             try:
@@ -197,10 +255,24 @@ class DynamicBatcher:
                 detail["engine"] = {"error": f"{type(e).__name__}: {e}"}
         return ok, detail
 
-    def submit(self, item) -> Future:
+    def _shed(self, req: _Request) -> None:
+        """Fail one deadline-doomed request (the admission controller's
+        ``on_shed``): the engine never sees it — shedding dead work is
+        the point. Counts ``serve.shed`` and ``serve.deadline_miss_total``."""
+        if req.future.set_running_or_notify_cancel():
+            req.future.set_exception(DeadlineExceededError(
+                "shed: predicted completion misses the request deadline"
+            ))
+        self.counters.bump("shed")
+        self.counters.bump("deadline_miss_total")
+
+    def submit(self, item, *, deadline_ms: float | None = None) -> Future:
         """Enqueue one request; returns its ``Future``. Raises
-        :class:`RejectedError` on backpressure (queue full) or once the
-        batcher is draining/closed."""
+        :class:`RejectedError` on backpressure (queue full), once the
+        batcher is draining/closed, or — fast, without queueing — while
+        the engine circuit is open (:class:`CircuitOpenError`, with a
+        ``retry_after_s`` hint). ``deadline_ms`` overrides the
+        batcher's default completion deadline for this request."""
         n = _leading(item)
         if n > self.max_batch:
             raise RejectedError(
@@ -210,7 +282,22 @@ class DynamicBatcher:
         if self.draining or self._stopped.is_set():
             self.counters.bump("rejected")
             raise RejectedError("batcher is draining — not admitting")
-        req = _Request(item, n)
+        if self._breaker is not None:
+            admit, retry_after = self._breaker.allow()
+            if not admit:
+                self.counters.bump("rejected")
+                raise CircuitOpenError(
+                    "engine circuit open after consecutive failures — "
+                    f"retry in {retry_after:.2f}s",
+                    retry_after_s=retry_after,
+                )
+        dl_ms = (deadline_ms if deadline_ms is not None
+                 else self.default_deadline_ms)
+        if dl_ms is not None and dl_ms <= 0:
+            raise ValueError(f"deadline_ms must be > 0, got {dl_ms}")
+        deadline = (None if dl_ms is None
+                    else time.monotonic() + float(dl_ms) / 1e3)
+        req = _Request(item, n, deadline)
         try:
             self._q.put_nowait(req)
         except queue.Full:
@@ -271,6 +358,20 @@ class DynamicBatcher:
                             RejectedError("batcher closed without drain")
                         )
                     continue
+                if self._breaker is not None:
+                    admit, retry_after = self._breaker.allow()
+                    if not admit:
+                        # open circuit: already-queued work fast-fails
+                        # too — dispatching it into a known-broken
+                        # engine would only delay the client's retry
+                        self.counters.bump("rejected")
+                        if first.future.set_running_or_notify_cancel():
+                            first.future.set_exception(CircuitOpenError(
+                                "engine circuit open — retry in "
+                                f"{retry_after:.2f}s",
+                                retry_after_s=retry_after,
+                            ))
+                        continue
                 reqs, n = [first], first.n
                 deadline = first.t0 + self.max_wait_s
                 while n < self.max_batch:
@@ -309,28 +410,55 @@ class DynamicBatcher:
                 ),
                 *[r.payload for r in live],
             )
+        except Exception as e:
+            # coalescing failures (e.g. requests whose trailing shapes
+            # disagree reach np.concatenate) are *request* errors: fail
+            # the batch, never the collector thread — and never the
+            # circuit breaker, which guards the ENGINE
+            self.counters.bump("errors")
+            self._log.exception("serve coalesce failed (%d requests)",
+                                len(live))
+            for r in live:
+                r.future.set_exception(e)
+            return
+        t_call = time.perf_counter()
+        try:
             with obs_stepstats.timed_span(
                 "serve.batch", "serve.batch_s", n=n, bucket=bucket,
                 requests=len(live),
             ):
                 out = self._engine.predict(payload)
-        except Exception as e:  # answer everyone; keep serving —
-            # coalescing itself can fail too (e.g. requests whose
-            # trailing shapes disagree reach np.concatenate), and that
-            # must fail the batch, never the collector thread
+        except Exception as e:  # answer everyone; keep serving
             self.counters.bump("errors")
             self._log.exception("serve batch failed (%d requests)",
                                 len(live))
+            if self._breaker is not None \
+                    and self._breaker.record_failure():
+                self._log.error(
+                    "engine circuit OPENED after %d consecutive "
+                    "failures — fast-rejecting with retry-after %.2fs",
+                    self._breaker.failure_threshold,
+                    self._breaker.retry_after_s(),
+                )
             for r in live:
                 r.future.set_exception(e)
             return
+        self.estimator.observe(time.perf_counter() - t_call)
+        if self._breaker is not None:
+            self._breaker.record_success()
         reqs = live
         now = time.perf_counter()
+        mono = time.monotonic()
         off = 0
         for r in reqs:
             lo = off
             off += r.n
             telemetry.observe("serve.latency_s", now - r.t0)
+            if r.deadline is not None and mono > r.deadline:
+                # answered, but late: the client may already have given
+                # up — count it so the miss rate covers late answers,
+                # not just sheds
+                self.counters.bump("deadline_miss_total")
             r.future.set_result(jax.tree_util.tree_map(
                 lambda a: a[lo:lo + r.n], out
             ))
@@ -346,10 +474,27 @@ class DynamicBatcher:
         """Stop the batcher. ``drain=True`` (default) answers every
         already-admitted request first — the preemption-exit path;
         ``drain=False`` fails pending requests with
-        :class:`RejectedError`. Idempotent."""
+        :class:`RejectedError`. Idempotent.
+
+        With a ``timeout``, a collector thread that fails to join —
+        an engine call wedged inside :meth:`_execute` — is **surfaced**
+        (logged and raised as :class:`TimeoutError`), never reported as
+        a clean shutdown; the heartbeat and readiness hook are left
+        registered so ``/healthz`` keeps naming the stall."""
         self._drain_on_close = self._drain_on_close and drain
         self._closing = True
         self._thread.join(timeout)
+        if self._thread.is_alive():
+            self.counters.bump("close_timeouts")
+            self._log.error(
+                "batcher close(timeout=%s) did NOT stop the collector — "
+                "the engine call is wedged; /healthz heartbeat %r stays "
+                "registered to flag the stall", timeout, self._health_name,
+            )
+            raise TimeoutError(
+                f"DynamicBatcher collector failed to join within "
+                f"{timeout}s — engine call wedged; not a clean shutdown"
+            )
         # a cleanly-closed batcher must not leave a stale heartbeat
         # (false liveness failure) or a permanently not-ready hook
         obs_server.HEARTBEATS.clear(self._health_name)

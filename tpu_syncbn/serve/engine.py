@@ -24,11 +24,15 @@ makes eval embarrassingly parallel over the ``data`` axis), and a small,
   compiled ahead of its first request (``jit.lower(...).compile()``);
   the compiled executable is what requests run, so the request path
   never traces;
-* **FIFO-bounded program retention** — compiled programs are cached
-  through :func:`tpu_syncbn.parallel.scan_driver.cached_program`, the
-  same :data:`~tpu_syncbn.parallel.scan_driver.MAX_CACHED_PROGRAMS`
-  bound the fused-training caches use, so a client sending pathological
-  shape traffic cannot grow device memory without bound;
+* **size-aware LRU program retention** — compiled programs are cached
+  through :func:`tpu_syncbn.parallel.scan_driver.cached_program` into a
+  :class:`~tpu_syncbn.parallel.scan_driver.ProgramCache`: at most
+  :data:`~tpu_syncbn.parallel.scan_driver.MAX_CACHED_PROGRAMS` live
+  (optionally also a byte budget via ``program_cache_bytes``, sized
+  from XLA's per-program ``memory_analysis``), least-recently-used
+  evicted first — so a client sending pathological shape traffic cannot
+  grow device memory without bound, while the hot bucket set stays
+  compiled;
 * **sharded eval** — the padded global batch is split over the data
   axis (``P('data')`` in / ``P('data')`` out), each replica runs the
   collective-free eval forward on its shard, and results are gathered
@@ -102,6 +106,7 @@ class InferenceEngine:
         axis_name: str = DATA_AXIS,
         apply_fn: Callable[[Any, Any], Any] | None = None,
         buckets: Sequence[int] = (8, 32, 128),
+        program_cache_bytes: int | None = None,
     ):
         import jax
         from flax import nnx
@@ -149,9 +154,12 @@ class InferenceEngine:
 
         from tpu_syncbn.parallel import scan_driver
 
-        # FIFO-bounded via scan_driver; hit/miss/eviction accounted so
-        # the bucket-program cache hit rate is measurable (ROADMAP 4)
-        self._programs = scan_driver.ProgramCache(name="serve")
+        # size-aware LRU via scan_driver (ROADMAP 4: smarter than
+        # FIFO-4); hit/miss/eviction accounted so the bucket-program
+        # cache hit rate is measurable
+        self._programs = scan_driver.ProgramCache(
+            name="serve", max_bytes=program_cache_bytes
+        )
         self._programs_compiled = 0
 
     # -- construction ------------------------------------------------------
@@ -237,12 +245,35 @@ class InferenceEngine:
             for shape, dtype in leafspecs
         ])
 
+    @staticmethod
+    def _program_nbytes(compiled) -> int | None:
+        """Best-effort compiled-program footprint from XLA's
+        ``memory_analysis`` (temp + output + code size — the parts that
+        scale with the bucket; arguments are the shared replicated
+        params). ``None`` on backends that don't report one — the
+        cache's entry bound still applies."""
+        try:
+            mem = compiled.memory_analysis()
+        except Exception:
+            return None
+        if mem is None:
+            return None
+        total = 0
+        for attr in ("temp_size_in_bytes", "output_size_in_bytes",
+                     "generated_code_size_in_bytes"):
+            v = getattr(mem, attr, None)
+            if isinstance(v, int) and v > 0:
+                total += v
+        return total or None
+
     def _program(self, bucket: int, batch):
         """The AOT-compiled eval executable for ``bucket`` and this
         batch's structure (leaf shapes beyond the batch axis + dtypes).
-        Cached through ``scan_driver.cached_program`` — at most
-        ``MAX_CACHED_PROGRAMS`` distinct programs stay live, FIFO
-        beyond."""
+        Cached through ``scan_driver.cached_program`` — size-aware LRU:
+        at most ``MAX_CACHED_PROGRAMS`` distinct programs (and, when
+        the engine was built with ``program_cache_bytes``, at most that
+        many measured bytes) stay live; least-recently-used evicted
+        first."""
         import jax
 
         from tpu_syncbn.obs import telemetry
@@ -262,7 +293,9 @@ class InferenceEngine:
             self._programs_compiled += 1
             return compiled
 
-        return scan_driver.cached_program(self._programs, key, build)
+        return scan_driver.cached_program(
+            self._programs, key, build, size_of=self._program_nbytes
+        )
 
     def warm(self, example_batch) -> None:
         """AOT-compile every bucket's program for ``example_batch``'s

@@ -1,25 +1,36 @@
-"""Deterministic fault injection for exercising the resilience layer."""
+"""Deterministic fault injection for exercising the resilience layer
+(training recovery paths AND the serving chaos matrix)."""
 
 from tpu_syncbn.testing.faults import (
     FaultInjector,
+    PoisonedRequestError,
     fault_seed,
     bitflip_file,
     truncate_file,
     corrupt_checkpoint,
+    crash_engine_at_batch,
     kill_loader_worker,
     poison_nan,
+    poison_request,
+    poison_sensitive_engine,
     delay_batch,
     signal_at,
+    slow_engine,
 )
 
 __all__ = [
     "FaultInjector",
+    "PoisonedRequestError",
     "fault_seed",
     "bitflip_file",
     "truncate_file",
     "corrupt_checkpoint",
+    "crash_engine_at_batch",
     "kill_loader_worker",
     "poison_nan",
+    "poison_request",
+    "poison_sensitive_engine",
     "delay_batch",
     "signal_at",
+    "slow_engine",
 ]

@@ -12,7 +12,15 @@ Every recovery path in the resilience layer (``runtime.resilience``,
 * stalled input pipeline — :func:`delay_batch` (trips
   ``resilience.stall_guard``);
 * preemption — :func:`signal_at` (SIGTERM delivered at an exact step
-  boundary).
+  boundary);
+* serving faults (the ``tpu_syncbn.serve`` chaos matrix,
+  tests/test_serve_chaos.py) — :func:`slow_engine` (engine calls
+  deterministically slower than a request deadline → drives the
+  admission layer's shed path), :func:`crash_engine_at_batch` (engine
+  raises for an exact window of batch indices → drives circuit-breaker
+  open/half-open/recovery), and :func:`poison_request` +
+  :func:`poison_sensitive_engine` (one request whose payload crashes
+  any batch containing it → proves batch-scoped failure isolation).
 
 Determinism contract: **no wall-clock randomness**. Anything pseudo-random
 (the bit to flip, the byte range to truncate) derives from an explicit
@@ -119,27 +127,33 @@ def sigterm_self() -> None:
 # iterator-level faults (deterministic by step index)
 
 
+def _nanify_tree(tree):
+    """Every float leaf of ``tree`` replaced with NaN (non-float leaves
+    pass through) — the ONE poisoning transform both the training fault
+    (:func:`poison_nan`) and the serving fault (:func:`poison_request`)
+    apply, so the two paths can never silently diverge."""
+    import numpy as np
+    import jax
+
+    def nanify(x):
+        arr = np.asarray(x)
+        if np.issubdtype(arr.dtype, np.floating):
+            return np.full_like(arr, np.nan)
+        return x
+
+    return jax.tree_util.tree_map(nanify, tree)
+
+
 def poison_nan(batches: Iterable, at_step: int, *,
                leaf_selector: Callable[[Any], Any] | None = None) -> Iterator:
     """Yield ``batches`` unchanged except batch ``at_step`` (0-based),
     whose every float leaf is replaced with NaN — upstream of the model,
     this deterministically drives the trainer's non-finite loss/grad
     guard. ``leaf_selector`` may instead transform the batch itself."""
-    import numpy as np
-    import jax
-
     for i, batch in enumerate(batches):
         if i == at_step:
-            if leaf_selector is not None:
-                batch = leaf_selector(batch)
-            else:
-                def nanify(x):
-                    arr = np.asarray(x)
-                    if np.issubdtype(arr.dtype, np.floating):
-                        return np.full_like(arr, np.nan)
-                    return x
-
-                batch = jax.tree_util.tree_map(nanify, batch)
+            batch = (leaf_selector(batch) if leaf_selector is not None
+                     else _nanify_tree(batch))
         yield batch
 
 
@@ -163,6 +177,132 @@ def signal_at(batches: Iterable, at_step: int,
         if i == at_step:
             os.kill(os.getpid(), sig)
         yield batch
+
+
+# ---------------------------------------------------------------------------
+# serving faults (deterministic by engine-call index)
+
+
+class PoisonedRequestError(RuntimeError):
+    """Raised by :func:`poison_sensitive_engine` when a batch contains a
+    poisoned payload — the stand-in for a malformed request crashing the
+    program call it was coalesced into."""
+
+
+class _EngineProxy:
+    """Duck-typed engine wrapper: forwards the batcher-facing surface
+    (``bucket_for`` / ``max_bucket`` / ``predict`` / ``warm`` /
+    ``stats`` / ``health``) and lets a subclass intervene around
+    ``predict``. ``self.calls`` counts predict invocations — the
+    deterministic index every serving fault keys off (no wall clock)."""
+
+    def __init__(self, engine):
+        self._engine = engine
+        self.calls = 0
+
+    @property
+    def max_bucket(self):
+        return self._engine.max_bucket
+
+    def bucket_for(self, n):
+        return self._engine.bucket_for(n)
+
+    def warm(self, batch):
+        return self._engine.warm(batch)
+
+    def stats(self):
+        return self._engine.stats()
+
+    def health(self):
+        inner = getattr(self._engine, "health", None)
+        return inner() if callable(inner) else {}
+
+    def _before_predict(self, call_index: int, batch) -> None:
+        """Hook: raise or sleep to inject the fault."""
+
+    def predict(self, batch):
+        i = self.calls
+        self.calls += 1
+        self._before_predict(i, batch)
+        return self._engine.predict(batch)
+
+
+def slow_engine(engine, delay_s: float, *,
+                at_calls: Iterable[int] | None = None):
+    """Wrap ``engine`` so ``predict`` sleeps ``delay_s`` before running —
+    on every call, or only on the 0-based call indices in ``at_calls``.
+    A delay sized past a request deadline deterministically drives the
+    admission layer's predicted-completion shedding (the estimator
+    observes the slow calls, then sheds what cannot finish in time)."""
+    if delay_s < 0:
+        raise ValueError(f"delay_s must be >= 0, got {delay_s}")
+    at = None if at_calls is None else frozenset(int(i) for i in at_calls)
+
+    class _Slow(_EngineProxy):
+        def _before_predict(self, i, batch):
+            if at is None or i in at:
+                time.sleep(delay_s)
+
+    return _Slow(engine)
+
+
+def crash_engine_at_batch(engine, at_batch: int, *,
+                          n_batches: int | None = 1,
+                          exc_factory=None):
+    """Wrap ``engine`` so ``predict`` raises for call indices in
+    ``[at_batch, at_batch + n_batches)`` (``n_batches=None`` = forever) —
+    the deterministic engine-crash window that opens the circuit
+    breaker; a finite window lets the half-open probe find a recovered
+    engine. ``exc_factory()`` builds the exception (default
+    ``RuntimeError``)."""
+    if at_batch < 0:
+        raise ValueError(f"at_batch must be >= 0, got {at_batch}")
+    if n_batches is not None and n_batches < 1:
+        raise ValueError(f"n_batches must be >= 1 or None, got {n_batches}")
+    make_exc = exc_factory if exc_factory is not None else (
+        lambda: RuntimeError("injected engine crash")
+    )
+
+    class _Crash(_EngineProxy):
+        def _before_predict(self, i, batch):
+            if i >= at_batch and (n_batches is None
+                                  or i < at_batch + n_batches):
+                raise make_exc()
+
+    return _Crash(engine)
+
+
+def poison_request(item):
+    """A poisoned copy of request payload ``item``: every float leaf
+    replaced with NaN (:func:`_nanify_tree` — the exact transform
+    :func:`poison_nan` applies to training batches) — shape- and
+    dtype-compatible with its batchmates, so it coalesces cleanly and
+    the failure happens where it does in production: inside the engine
+    call."""
+    return _nanify_tree(item)
+
+
+def poison_sensitive_engine(engine):
+    """Wrap ``engine`` so ``predict`` raises
+    :class:`PoisonedRequestError` when the batch contains any non-finite
+    float value — the sensitivity that turns a :func:`poison_request`
+    payload into a crashed batch. The isolation contract under test:
+    ONLY the batch the poison was coalesced into fails; the batcher
+    keeps serving and the circuit stays closed."""
+    import numpy as np
+    import jax
+
+    class _PoisonSensitive(_EngineProxy):
+        def _before_predict(self, i, batch):
+            for leaf in jax.tree_util.tree_leaves(batch):
+                arr = np.asarray(leaf)
+                if np.issubdtype(arr.dtype, np.floating) \
+                        and not np.all(np.isfinite(arr)):
+                    raise PoisonedRequestError(
+                        f"poisoned payload in engine call {i}"
+                    )
+
+    return _PoisonSensitive(engine)
 
 
 class FaultInjector:

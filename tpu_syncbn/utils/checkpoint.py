@@ -552,7 +552,9 @@ class AsyncCheckpointer:
 
     def _run(self) -> None:
         while True:
-            item = self._queue.get()
+            # idle-wait for work by design: close() always enqueues the
+            # None sentinel, so this get provably terminates
+            item = self._queue.get()  # audit: ok[unbounded_blocking]
             if item is None:
                 return
             directory, step, host_tree, keep = item
@@ -609,8 +611,11 @@ class AsyncCheckpointer:
         with self._cond:
             self._pending += 1
         # enqueue OUTSIDE the condition: a bounded-queue put may block on
-        # backpressure, and the worker needs the condition to drain
-        self._queue.put((directory, int(step), host_tree,
+        # backpressure, and the worker needs the condition to drain —
+        # blocking here IS the documented max_pending backpressure, and
+        # the single worker can only stop via close()'s sentinel (its
+        # loop catches BaseException per item), so the put always drains
+        self._queue.put((directory, int(step), host_tree,  # audit: ok[unbounded_blocking]
                          self.keep if keep is None else keep))
 
     def flush(self, timeout: float | None = None) -> bool:
