@@ -818,6 +818,54 @@ def measure_monitor(agg) -> dict:
     }
 
 
+def measure_audit(dp, batch) -> dict:
+    """The ``audit`` block of the bench line: the static-analysis layer
+    (docs/STATIC_ANALYSIS.md) run against THIS process — the package
+    source lint (layer 2) plus the layer-3 sharding-flow pass over the
+    exact train-step program the throughput number above was measured
+    on. Cheap by construction: pure ``ast`` + one abstract trace;
+    nothing compiles, nothing executes.
+
+    The sharding figures are the live counterpart of the pinned
+    contracts: ``implicit_reshards``/``replicated_intermediates`` must
+    read 0 on a healthy run (a nonzero value here is the same hazard the
+    ``sharding.*`` audit rules fail CI for, measured on the *bench's*
+    program and mesh rather than the tiny registry fixtures), and
+    ``peak_mb_per_device`` tracks the propagated per-device footprint
+    of the real workload across rounds. Schema pinned by
+    tests/test_bench_tooling.py."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_syncbn import audit as audit_mod
+    from tpu_syncbn.audit import sharding_audit
+
+    t0 = time.perf_counter()
+    lint = audit_mod.run_audit(contracts=False)
+    flow = sharding_audit.analyze_program(
+        dp._train_step,
+        (dp._param_store, dp.rest, dp.opt_state, batch),
+        mesh=dp.mesh,
+        in_specs=(dp._pspec, dp._rest_spec, dp._opt_spec,
+                  P(dp.axis_name)),
+    )
+    return {
+        "files_linted": lint.files_linted,
+        "lint_violations": len(lint.violations),
+        "sharding": {
+            "collectives_explained": flow.collectives_explained,
+            "implicit_reshards": flow.implicit_reshards,
+            "replicated_intermediates": flow.replicated_intermediates,
+            "max_replicated_mb": round(
+                flow.max_replicated_bytes / 1e6, 3
+            ),
+            "peak_mb_per_device": round(
+                flow.peak_bytes_per_device / 1e6, 3
+            ),
+        },
+        "audit_s": round(time.perf_counter() - t0, 3),
+    }
+
+
 def check_regression(
     line: dict, *, baseline_path: str = _BASELINE_PATH,
     tolerance: float = 0.1,
@@ -1108,6 +1156,21 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
         log(f"monitor measurement failed: {type(e).__name__}: {e}")
         monitor_info = None
 
+    # static-analysis layer measured on the run's own program
+    # (docs/STATIC_ANALYSIS.md) — an annotation, never fatal to the
+    # metric
+    try:
+        with stepstats.timed_span("audit_bench", "bench.audit_s"):
+            audit_info = measure_audit(dp, batch)
+        log(f"audit: {audit_info['files_linted']} files linted "
+            f"({audit_info['lint_violations']} violations), sharding "
+            f"reshards={audit_info['sharding']['implicit_reshards']} "
+            f"peak={audit_info['sharding']['peak_mb_per_device']} "
+            "MB/device")
+    except Exception as e:
+        log(f"audit measurement failed: {type(e).__name__}: {e}")
+        audit_info = None
+
     mfu = None
     peak, peak_source = (_peak_flops(jax.devices()[0], backend)
                          if on_accel else (None, None))
@@ -1162,6 +1225,12 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
         # rolling step stats + one SLO evaluation; schema pinned by
         # tests/test_bench_tooling.py
         "monitor": monitor_info,
+        # docs/STATIC_ANALYSIS.md: package lint + layer-3 sharding flow
+        # of the benched train-step program (implicit reshards and
+        # replicated intermediates must read 0 on a healthy run; the
+        # per-device peak tracks the real workload's footprint); schema
+        # pinned by tests/test_bench_tooling.py
+        "audit": audit_info,
         # a fallback line is a liveness smoke signal, not a measurement
         # of anything the project tracks — cross-round diffs of it are
         # meaningless and tagged as such

@@ -1,0 +1,49 @@
+"""Planted hardcoded_mesh_axis violations — a mesh-axis name spelled as
+a string literal in every position the rule covers. Lint input only;
+never imported."""
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from jax import lax
+
+
+def build_mesh(devices):
+    # literal axis name in a Mesh constructor (tuple form)
+    return Mesh(np.array(devices), ("data",))  # VIOLATION
+
+
+def batch_spec():
+    # literal axis name in a PartitionSpec
+    return P("data")  # VIOLATION
+
+
+def shard(mesh, x):
+    # literal axis in a NamedSharding spec call chain
+    return NamedSharding(mesh, P(None, "model"))  # VIOLATION
+
+
+def reduce_grads(g):
+    # literal axis handed to a collective
+    return lax.psum(g, "data")  # VIOLATION
+
+
+def gather(x, axis_name="fsdp"):  # VIOLATION (default of axis_name)
+    return lax.all_gather(x, axis_name, tiled=True)
+
+
+# literal bound to a private *_AXIS constant outside mesh_axes.py
+SHARD_AXIS = "fsdp"  # VIOLATION
+
+
+def spelled_keyword(x):
+    # axis_name= keyword carrying the literal
+    return lax.pmean(x, axis_name="model")  # VIOLATION
+
+
+def clean(mesh, x, axis):
+    # non-axis uses of the same words stay clean: dict keys, metric
+    # families, byte strings, and literals outside axis positions
+    table = {"data": 1, "model": 2}
+    _ = x[b"data"] if isinstance(x, dict) else None
+    return table, lax.psum(x, axis)

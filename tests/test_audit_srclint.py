@@ -30,6 +30,7 @@ RULE_FIXTURES = {
     "unpaired_trace_span": ("bad_unpaired_trace_span.py", 3),
     "wallclock_duration": ("bad_wallclock_duration.py", 3),
     "unbounded_blocking": ("bad_unbounded_blocking.py", 5),
+    "hardcoded_mesh_axis": ("bad_hardcoded_mesh_axis.py", 6),
 }
 
 
@@ -344,6 +345,52 @@ class TestRuleEdges:
             "        return os.path.join(*parts), ', '.join(parts)\n"
         )
         assert lint_source(src, "x.py", rules=["unbounded_blocking"]) == []
+
+    def test_mesh_axis_constant_import_is_clean(self):
+        """ISSUE 10 satellite: the sanctioned spelling — import the
+        constant from mesh_axes — never fires, and non-axis uses of the
+        same words (dict keys, metric families) stay clean."""
+        src = (
+            "from jax.sharding import PartitionSpec as P\n"
+            "from tpu_syncbn.mesh_axes import DATA_AXIS\n"
+            "def spec():\n"
+            "    return P(DATA_AXIS)\n"
+            "def stats():\n"
+            "    return {'data': 1, 'model': 2}\n"
+        )
+        assert lint_source(src, "x.py",
+                           rules=["hardcoded_mesh_axis"]) == []
+
+    def test_mesh_axis_literal_in_constants_module_is_allowed(self):
+        src = "DATA_AXIS = 'data'\nMODEL_AXIS = 'model'\n"
+        assert lint_source(
+            src, "tpu_syncbn/mesh_axes.py",
+            rules=["hardcoded_mesh_axis"],
+        ) == []
+        vs = lint_source(src, "tpu_syncbn/parallel/other.py",
+                         rules=["hardcoded_mesh_axis"])
+        assert len(vs) == 2
+
+    def test_mesh_axis_default_pairing_handles_posonly_args(self):
+        """Review finding: defaults align with the tail of
+        posonly+positional args — a positional-only default must not
+        shift the pairing in either direction."""
+        # 'data' is x's default (not an axis kwarg): clean
+        clean = "def f(x='data', /, axis_name=None):\n    return x\n"
+        assert lint_source(clean, "x.py",
+                           rules=["hardcoded_mesh_axis"]) == []
+        # the literal really is axis_name's default: flagged
+        bad = "def g(x=1, /, axis_name='data'):\n    return x\n"
+        vs = lint_source(bad, "x.py", rules=["hardcoded_mesh_axis"])
+        assert len(vs) == 1 and "axis_name" in vs[0].message
+
+    def test_non_policed_axis_names_stay_clean(self):
+        # "pipe"/"expert"/"seq" are centralized too, but the rule only
+        # polices the item-1 composition axes the ISSUE names
+        src = "from jax.sharding import PartitionSpec as P\n" \
+              "s = P('pipe')\n"
+        assert lint_source(src, "x.py",
+                           rules=["hardcoded_mesh_axis"]) == []
 
     def test_syntax_error_reports_parse_error(self):
         vs = lint_source("def broken(:\n", "x.py")

@@ -552,6 +552,9 @@ class TestTelemetryBlock:
         # the monitor block is always present (the live-monitoring
         # layer is measured on every run — ISSUE 8)
         self._validate_monitor_block(line["monitor"], steps=3)
+        # the audit block is always present (the static-analysis layer
+        # measured on the run's own program — ISSUE 10)
+        self._validate_audit_block(line["audit"])
         # the serve block is null unless --serve ran the sweep
         assert line["serve"] is None
         # the --trace file is valid Chrome trace JSON with the three
@@ -605,6 +608,30 @@ class TestTelemetryBlock:
         # the liveness-grade SLO (p99 < 60s) holds on a healthy run
         assert block["slo_firing"] is False
         assert block["slo_burn_rate"] is not None
+
+    @staticmethod
+    def _validate_audit_block(block):
+        """The schema-pinned `audit` block (ISSUE 10): the static-
+        analysis layer run against the bench's own train-step program.
+        A healthy run lints clean and propagates with zero implicit
+        reshards / zero over-threshold replication."""
+        assert set(block) == {
+            "files_linted", "lint_violations", "sharding", "audit_s",
+        }
+        assert block["files_linted"] >= 50
+        assert block["lint_violations"] == 0
+        assert block["audit_s"] > 0
+        sh = block["sharding"]
+        assert set(sh) == {
+            "collectives_explained", "implicit_reshards",
+            "replicated_intermediates", "max_replicated_mb",
+            "peak_mb_per_device",
+        }
+        # the paper's program: at least the BN-stat/grad psums explained
+        assert sh["collectives_explained"] >= 1
+        assert sh["implicit_reshards"] == 0
+        assert sh["replicated_intermediates"] == 0
+        assert sh["peak_mb_per_device"] > 0
 
     def test_scan_flag_emits_fused_block(self, tmp_path, monkeypatch, capsys):
         """--scan K: the fused K-step loop runs and the scan block

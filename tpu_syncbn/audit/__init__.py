@@ -2,23 +2,32 @@
 compiled programs and source text honor the invariants the paper (and
 PRs 1–5) promised.
 
-Two layers, one driver:
+Three layers, one driver:
 
 * :mod:`tpu_syncbn.audit.jaxpr_audit` — abstractly traces every
   compiled program the stack builds (DataParallel plain/zero, GANTrainer,
-  fused scan at K=1/4, serve eval buckets) and extracts a
+  fused scan at K=1/4, serve eval buckets, and the
+  tensor/pipeline/expert/sequence strategy programs) and extracts a
   :class:`~tpu_syncbn.audit.contracts.ProgramContract` (collectives +
   bytes-on-wire, effective donation, host callbacks, BN-stat upcasts),
   checked against cross-program invariants and goldens pinned under
   ``tests/contracts/``.
+* :mod:`tpu_syncbn.audit.sharding_audit` — layer 3: per-value
+  named-sharding propagation over the same traces (elementwise /
+  reduce / collective / scan / ``shard_map`` boundaries), detecting
+  accidental full replication, implicit resharding no declared
+  collective explains, and per-device peak memory (cross-checked
+  against XLA ``memory_analysis`` under ``--shardings``); pinned as the
+  ``sharding`` block of each golden.
 * :mod:`tpu_syncbn.audit.srclint` — stdlib-only AST lint enforcing the
   repo's hazard rules (donate-after-use, compat bypass, host sync in
-  step builders, lock discipline, telemetry schema, unpaired spans).
+  step builders, lock discipline, telemetry schema, unpaired spans,
+  hardcoded mesh axes).
 
-Run both with ``python -m tpu_syncbn.audit [--strict] [--json]`` or via
-:func:`run_audit`; the rule catalog and re-pin workflow live in
-docs/STATIC_ANALYSIS.md. Results feed the ``audit.*`` telemetry
-counters (docs/OBSERVABILITY.md).
+Run all with ``python -m tpu_syncbn.audit [--strict] [--json]
+[--shardings] [--mem-budget N]`` or via :func:`run_audit`; the rule
+catalog and re-pin workflow live in docs/STATIC_ANALYSIS.md. Results
+feed the ``audit.*`` telemetry counters (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -27,8 +36,11 @@ import dataclasses
 
 from tpu_syncbn.audit.contracts import (  # noqa: F401
     CONTRACT_SCHEMA,
+    SHARDING_SCHEMA,
     ProgramContract,
+    ShardingContract,
     compare_contracts,
+    compare_sharding,
     extract_contract,
     load_contract,
     save_contract,
@@ -91,22 +103,38 @@ def run_audit(
     golden_dir: str | None = None,
     pkg_root: str | None = None,
     rules=None,
+    shardings: bool = False,
+    mem_budget: int | None = None,
+    lint_paths=None,
 ) -> AuditResult:
-    """Run both audit layers and fold the outcome into the ``audit.*``
+    """Run the audit layers and fold the outcome into the ``audit.*``
     telemetry counters. ``contracts=False`` skips program tracing
     entirely — no mesh, no trainer construction; the lint rules
-    themselves are pure ``ast``."""
+    themselves are pure ``ast``. This function touches no environment
+    variables — the CLI (``__main__``) forces and *restores* the pinned
+    CPU mesh around it, so calling in-process (tests, bench) leaks no
+    config into the caller.
+
+    ``lint_paths`` restricts the source lint to an explicit file list
+    (the ``--changed-only`` fast mode). ``shardings=True`` compiles each
+    traced program once so the layer-3 block carries the XLA
+    ``memory_analysis`` cross-check; the sharding *propagation* itself
+    always runs with the contract layer. ``mem_budget`` (bytes) arms the
+    per-device peak-memory contract (``sharding.mem_budget``)."""
     from tpu_syncbn.obs import telemetry
 
     violations: list[Violation] = []
     unpinned: list[str] = []
     files_linted = 0
     programs_checked = 0
+    sharding_programs = 0
+    sharding_violations = 0
 
     if lint:
         from tpu_syncbn.audit import srclint
 
-        files = srclint.package_files(pkg_root)
+        files = (list(lint_paths) if lint_paths is not None
+                 else srclint.package_files(pkg_root))
         files_linted = len(files)
         for path in files:
             violations.extend(srclint.lint_file(path, rules=rules))
@@ -114,9 +142,17 @@ def run_audit(
     if contracts:
         from tpu_syncbn.audit import jaxpr_audit
 
-        live = jaxpr_audit.build_contracts()
+        live = jaxpr_audit.build_contracts(memory=shardings)
         programs_checked = len(live)
+        sharding_programs = sum(
+            1 for c in live.values() if c.sharding is not None
+        )
         violations.extend(jaxpr_audit.check_invariants(live))
+        sharding_found = jaxpr_audit.check_sharding(
+            live, mem_budget=mem_budget
+        )
+        sharding_violations = len(sharding_found)
+        violations.extend(sharding_found)
         gdir = golden_dir or jaxpr_audit.default_golden_dir()
         golden_violations, unpinned = jaxpr_audit.check_goldens(live, gdir)
         violations.extend(golden_violations)
@@ -133,6 +169,12 @@ def run_audit(
         telemetry.count("audit.files_linted", files_linted)
     if programs_checked:
         telemetry.count("audit.programs_checked", programs_checked)
+    if sharding_programs:
+        telemetry.count("audit.sharding.programs", sharding_programs)
+    if contracts:
+        # counted even at 0 — but only when the layer actually ran,
+        # so a lint-only run never minted a "sharding ran clean" signal
+        telemetry.count("audit.sharding.violations", sharding_violations)
     telemetry.count("audit.violations", len(violations))
     for rule, n in result.rule_counts.items():
         telemetry.count(f"audit.rule.{rule}", n)
@@ -142,8 +184,10 @@ def run_audit(
 __all__ = [
     "REPORT_SCHEMA",
     "CONTRACT_SCHEMA",
+    "SHARDING_SCHEMA",
     "AuditResult",
     "ProgramContract",
+    "ShardingContract",
     "Violation",
     "RULES",
     "run_audit",
@@ -151,6 +195,7 @@ __all__ = [
     "lint_package",
     "lint_source",
     "compare_contracts",
+    "compare_sharding",
     "extract_contract",
     "load_contract",
     "save_contract",

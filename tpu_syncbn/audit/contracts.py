@@ -44,6 +44,16 @@ from typing import Any, Callable, Iterable, Sequence
 #: Bump when the contract JSON shape changes incompatibly.
 CONTRACT_SCHEMA = 1
 
+#: Bump when the layer-3 sharding block's shape changes incompatibly
+#: (the block is optional inside the contract JSON, so adding it did not
+#: bump CONTRACT_SCHEMA).
+SHARDING_SCHEMA = 1
+
+#: Relative tolerance when comparing the XLA ``memory_analysis`` figure
+#: against a golden: buffer assignment is deterministic for one backend
+#: build, but the figure is a cross-check, not a number we control.
+XLA_PEAK_RTOL = 0.10
+
 #: Named-axis collective primitives (jax 0.4 names plus newer aliases —
 #: an unknown collective should fail the contract, not slip past it).
 COLLECTIVE_PRIMS = frozenset({
@@ -59,11 +69,87 @@ HOST_CALLBACK_PRIMS = frozenset({
 
 
 @dataclasses.dataclass
+class ShardingContract:
+    """The layer-3 sharding-flow contract of one compiled program
+    (docs/STATIC_ANALYSIS.md "Layer 3"): declared entry layouts, the
+    propagated output layouts, every layout change accounted to a
+    declared collective, and the memory story — how big the biggest
+    fully-replicated intermediate is, how many exceed the replication
+    threshold, and the per-device peak estimate cross-checked against
+    XLA's ``memory_analysis`` when the extractor compiled.
+
+    ``in_specs`` maps each top-level argument label to the *distinct*
+    canonical spec strings of its leaves (one entry for a uniformly
+    sharded arg); ``out_specs`` is the distinct specs over all outputs.
+    Detail lists are capped, human-readable, and deterministic — they
+    make golden diffs reviewable."""
+
+    name: str
+    mesh_axes: dict[str, int]
+    in_specs: dict[str, list[str]]
+    out_specs: list[str]
+    collectives_explained: int
+    implicit_reshards: int
+    reshard_detail: list[str]
+    replicated_intermediates: int
+    replication_detail: list[str]
+    max_replicated_bytes: int
+    peak_bytes_per_device: int
+    replication_threshold: int
+    xla_peak_bytes: int | None = None
+
+    def to_json(self) -> dict:
+        return {
+            "schema": SHARDING_SCHEMA,
+            "mesh_axes": dict(sorted(self.mesh_axes.items())),
+            "in_specs": {k: list(v) for k, v in sorted(
+                self.in_specs.items())},
+            "out_specs": list(self.out_specs),
+            "collectives_explained": self.collectives_explained,
+            "implicit_reshards": self.implicit_reshards,
+            "reshard_detail": list(self.reshard_detail),
+            "replicated_intermediates": self.replicated_intermediates,
+            "replication_detail": list(self.replication_detail),
+            "max_replicated_bytes": self.max_replicated_bytes,
+            "peak_bytes_per_device": self.peak_bytes_per_device,
+            "replication_threshold": self.replication_threshold,
+            "xla_peak_bytes": self.xla_peak_bytes,
+        }
+
+    @classmethod
+    def from_json(cls, name: str, blob: dict) -> "ShardingContract":
+        if blob.get("schema") != SHARDING_SCHEMA:
+            raise ValueError(
+                f"sharding schema {blob.get('schema')!r} != "
+                f"{SHARDING_SCHEMA} — re-pin the golden "
+                "(docs/STATIC_ANALYSIS.md)"
+            )
+        xla = blob.get("xla_peak_bytes")
+        return cls(
+            name=name,
+            mesh_axes={k: int(v) for k, v in blob["mesh_axes"].items()},
+            in_specs={k: list(v) for k, v in blob["in_specs"].items()},
+            out_specs=list(blob["out_specs"]),
+            collectives_explained=int(blob["collectives_explained"]),
+            implicit_reshards=int(blob["implicit_reshards"]),
+            reshard_detail=list(blob["reshard_detail"]),
+            replicated_intermediates=int(blob["replicated_intermediates"]),
+            replication_detail=list(blob["replication_detail"]),
+            max_replicated_bytes=int(blob["max_replicated_bytes"]),
+            peak_bytes_per_device=int(blob["peak_bytes_per_device"]),
+            replication_threshold=int(blob["replication_threshold"]),
+            xla_peak_bytes=int(xla) if xla is not None else None,
+        )
+
+
+@dataclasses.dataclass
 class ProgramContract:
     """The statically-verifiable communication/memory contract of one
     compiled program. ``donated_declared`` is per top-level argument
     label; ``donated_aliased`` maps each label to how many of its leaves
-    the lowering actually marked donatable."""
+    the lowering actually marked donatable. ``sharding`` carries the
+    optional layer-3 flow block (:class:`ShardingContract`) when the
+    extractor was given the program's mesh and entry specs."""
 
     name: str
     world: int
@@ -73,9 +159,10 @@ class ProgramContract:
     donated_aliased: dict[str, int]
     host_callbacks: dict[str, int]
     upcasts: dict[str, int]
+    sharding: ShardingContract | None = None
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "schema": CONTRACT_SCHEMA,
             "name": self.name,
             "world": self.world,
@@ -86,6 +173,9 @@ class ProgramContract:
             "host_callbacks": dict(sorted(self.host_callbacks.items())),
             "upcasts": dict(sorted(self.upcasts.items())),
         }
+        if self.sharding is not None:
+            out["sharding"] = self.sharding.to_json()
+        return out
 
     @classmethod
     def from_json(cls, blob: dict) -> "ProgramContract":
@@ -93,6 +183,11 @@ class ProgramContract:
             raise ValueError(
                 f"contract schema {blob.get('schema')!r} != {CONTRACT_SCHEMA}"
                 " — re-pin the golden (docs/STATIC_ANALYSIS.md)"
+            )
+        sharding = None
+        if blob.get("sharding") is not None:
+            sharding = ShardingContract.from_json(
+                blob["name"], blob["sharding"]
             )
         return cls(
             name=blob["name"],
@@ -109,6 +204,7 @@ class ProgramContract:
                 k: int(v) for k, v in blob["host_callbacks"].items()
             },
             upcasts={k: int(v) for k, v in blob["upcasts"].items()},
+            sharding=sharding,
         )
 
     @property
@@ -266,16 +362,65 @@ def extract_contract(
     world: int,
     arg_labels: Sequence[str],
     declared_donated: Sequence[str] = (),
+    mesh: Any | None = None,
+    in_specs: Sequence[Any] | None = None,
+    memory: bool = False,
+    replication_threshold: int | None = None,
 ) -> ProgramContract:
     """Abstractly trace ``fn`` (a jitted callable) on ``example_args``
     (arrays or ShapeDtypeStructs) and assemble its contract. Nothing is
     compiled or executed — ``jax.make_jaxpr`` for the program text,
-    ``fn.lower(...)`` for the donation attributes."""
+    ``fn.lower(...)`` for the donation attributes.
+
+    With ``mesh`` and ``in_specs`` (one prefix spec tree per argument —
+    the same shapes the trainers hand to ``shard_map``), the layer-3
+    sharding-flow pass (:mod:`tpu_syncbn.audit.sharding_audit`) runs
+    over the same trace and its :class:`ShardingContract` is attached.
+    ``memory=True`` additionally compiles the program once to record
+    XLA's ``memory_analysis`` figure as the peak-memory cross-check —
+    the only path here that compiles anything."""
     import jax
 
-    summary = summarize_jaxpr(jax.make_jaxpr(fn)(*example_args))
+    closed = jax.make_jaxpr(fn)(*example_args)
+    summary = summarize_jaxpr(closed)
     lowered = fn.lower(*example_args)
     aliased = donation_by_arg(lowered.as_text(), arg_labels, example_args)
+    sharding = None
+    if mesh is not None and in_specs is not None:
+        from tpu_syncbn.audit import sharding_audit
+
+        kwargs: dict = {}
+        if replication_threshold is not None:
+            kwargs["replication_threshold"] = replication_threshold
+        flow = sharding_audit.analyze_program(
+            fn, example_args, mesh=mesh, in_specs=in_specs,
+            closed_jaxpr=closed, **kwargs,
+        )
+        leaf_specs: dict[str, list[str]] = {}
+        for label, arg, spec in zip(arg_labels, example_args, in_specs):
+            strs = sorted({
+                sharding_audit.spec_leaf_str(s)
+                for s in sharding_audit.broadcast_spec(spec, arg)
+            })
+            leaf_specs[label] = strs
+        sharding = ShardingContract(
+            name=name,
+            mesh_axes=flow.mesh_axes,
+            in_specs=leaf_specs,
+            out_specs=flow.out_spec_strs(),
+            collectives_explained=flow.collectives_explained,
+            implicit_reshards=flow.implicit_reshards,
+            reshard_detail=flow.reshard_detail,
+            replicated_intermediates=flow.replicated_intermediates,
+            replication_detail=flow.replication_detail,
+            max_replicated_bytes=flow.max_replicated_bytes,
+            peak_bytes_per_device=flow.peak_bytes_per_device,
+            replication_threshold=flow.replication_threshold,
+            xla_peak_bytes=(
+                sharding_audit.xla_peak_bytes(fn, example_args)
+                if memory else None
+            ),
+        )
     return ProgramContract(
         name=name,
         world=world,
@@ -285,7 +430,58 @@ def extract_contract(
         donated_aliased=aliased,
         host_callbacks=summary["host_callbacks"],
         upcasts=summary["upcasts"],
+        sharding=sharding,
     )
+
+
+def compare_sharding(
+    actual: ShardingContract, golden: ShardingContract, name: str
+) -> list[str]:
+    """Field-by-field diff of two layer-3 blocks. ``xla_peak_bytes`` is
+    compared with :data:`XLA_PEAK_RTOL` relative tolerance and skipped
+    when either side did not compile (None); everything else is exact —
+    the pass is deterministic arithmetic over the program text."""
+    diffs: list[str] = []
+
+    def _ne(field: str, a, g) -> None:
+        if a != g:
+            diffs.append(
+                f"{name}: sharding.{field} = {a!r}, golden pins {g!r}"
+            )
+
+    _ne("mesh_axes", dict(sorted(actual.mesh_axes.items())),
+        dict(sorted(golden.mesh_axes.items())))
+    for label in sorted(set(actual.in_specs) | set(golden.in_specs)):
+        _ne(f"in_specs[{label}]", actual.in_specs.get(label, []),
+            golden.in_specs.get(label, []))
+    _ne("out_specs", list(actual.out_specs), list(golden.out_specs))
+    _ne("collectives_explained", actual.collectives_explained,
+        golden.collectives_explained)
+    _ne("implicit_reshards", actual.implicit_reshards,
+        golden.implicit_reshards)
+    _ne("reshard_detail", list(actual.reshard_detail),
+        list(golden.reshard_detail))
+    _ne("replicated_intermediates", actual.replicated_intermediates,
+        golden.replicated_intermediates)
+    _ne("replication_detail", list(actual.replication_detail),
+        list(golden.replication_detail))
+    _ne("max_replicated_bytes", actual.max_replicated_bytes,
+        golden.max_replicated_bytes)
+    _ne("peak_bytes_per_device", actual.peak_bytes_per_device,
+        golden.peak_bytes_per_device)
+    _ne("replication_threshold", actual.replication_threshold,
+        golden.replication_threshold)
+    if actual.xla_peak_bytes is not None \
+            and golden.xla_peak_bytes is not None:
+        hi = max(actual.xla_peak_bytes, golden.xla_peak_bytes)
+        if hi and abs(actual.xla_peak_bytes - golden.xla_peak_bytes) \
+                > XLA_PEAK_RTOL * hi:
+            diffs.append(
+                f"{name}: sharding.xla_peak_bytes = "
+                f"{actual.xla_peak_bytes}, golden pins "
+                f"{golden.xla_peak_bytes} (>±{XLA_PEAK_RTOL:.0%})"
+            )
+    return diffs
 
 
 def compare_contracts(
@@ -324,6 +520,26 @@ def compare_contracts(
         )
     _dict_diff("donated_aliased", actual.donated_aliased,
                golden.donated_aliased)
+    if actual.sharding is not None and golden.sharding is not None:
+        diffs.extend(compare_sharding(
+            actual.sharding, golden.sharding, actual.name
+        ))
+    elif actual.sharding is not None:
+        diffs.append(
+            f"{actual.name}: program has a layer-3 sharding block "
+            "but the golden pins none — re-pin with --write-goldens "
+            "(docs/STATIC_ANALYSIS.md 'Layer 3')"
+        )
+    elif golden.sharding is not None:
+        # the inverse is just as dangerous: a registry edit that stops
+        # supplying mesh/in_specs would otherwise silently disable
+        # every pinned layer-3 invariant for this program
+        diffs.append(
+            f"{actual.name}: golden pins a layer-3 sharding block but "
+            "the program was traced without one — the extractor lost "
+            "its mesh/in_specs (registry regression), or re-pin "
+            "deliberately with --write-goldens"
+        )
     return diffs
 
 

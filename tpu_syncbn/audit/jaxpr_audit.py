@@ -1,11 +1,15 @@
-"""Layer 1 of the program auditor: trace every compiled program the
-stack builds and hold it to its pinned :class:`ProgramContract`.
+"""Layer 1 + layer 3 of the program auditor: trace every compiled
+program the stack builds and hold it to its pinned
+:class:`ProgramContract` (collectives/donation/callbacks/upcasts) and
+the attached :class:`~tpu_syncbn.audit.contracts.ShardingContract`
+(layout flow, replication, per-device memory).
 
 The registry below builds each program the way the trainers/engine
 actually build it — same step factories, same shard_map specs, same
-donation — on tiny deterministic models over the standard data-parallel
-mesh, then extracts contracts **abstractly** (``jax.make_jaxpr`` +
-``.lower()``; nothing compiles, nothing executes). Audited programs:
+donation — on tiny deterministic models over the standard meshes, then
+extracts contracts **abstractly** (``jax.make_jaxpr`` + ``.lower()``;
+nothing compiles or executes unless the caller asks for the
+``memory_analysis`` cross-check). Audited programs:
 
 * ``dataparallel.train_step`` — the paper's program: BN-stat psum +
   grad pmean + loss/metric reductions, full state donated.
@@ -16,25 +20,38 @@ mesh, then extracts contracts **abstractly** (``jax.make_jaxpr`` +
   updates, both networks' BN stats, replica-0 buffer broadcasts).
 * ``dataparallel.scan_k{1,4}.train_steps`` — the fused K-step scan
   program at K=1 and K=4. Collectives live in the scan *body*, so the
-  contract is K-invariant by construction — pinned as an explicit
-  cross-program invariant, turning "fusing steps adds no communication"
-  into a regression test.
+  contract is K-invariant by construction.
 * ``serve.eval_bucket8`` — the InferenceEngine bucket program: **zero
-  collectives** (PR 5's collective-free eval claim) and **no donation**
-  (batch inputs are never donated; the staging/batcher may still own
-  them).
+  collectives**, **no donation**, batch in and out ``P('data')``.
+* ``tensor.tp_mlp`` — the Megatron MLP pairing (column → gelu → row):
+  exactly ONE ``psum`` over the ``model`` axis, weights arriving
+  pre-sharded ``P(None,'model')`` / ``P('model',None)``.
+* ``pipeline.gpipe`` — the GPipe microbatch schedule: one ``ppermute``
+  in the scan body (the ring hand-off) plus the last-stage ``psum``
+  mask, stage params ``P('pipe')``.
+* ``expert.switch_moe`` — Switch MoE over the ``expert`` axis: exactly
+  two ``all_to_all``s (dispatch + return) and the aux-loss ``pmean``.
+* ``sequence.ring_attention`` — the KV ring: one ``ppermute`` in the
+  scan body, sequence sharded ``P(None,'seq')`` end to end.
+
+The last four are the previously-siloed strategies' first pinned ground
+truth — the regression floor the ROADMAP item-1 SpecLayout refactor
+must preserve.
 
 Contracts are compared against goldens in ``tests/contracts/``
 (re-pin with ``python -m tpu_syncbn.audit --write-goldens`` after an
-*intentional* change — docs/STATIC_ANALYSIS.md). Golden byte estimates
-depend on the mesh world, so contracts record the world they were pinned
-on (the CLI forces the 8-device CPU mesh the test suite uses).
+*intentional* change — the CLI prints the old→new field diff and
+refuses to overwrite a mismatching golden without ``--force``). Golden
+byte estimates depend on the mesh world, so contracts record the world
+they were pinned on (the CLI forces the 8-device CPU mesh the test
+suite uses).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import os
-from typing import Callable, Sequence
+from typing import Any, Callable, Sequence
 
 from tpu_syncbn.audit.contracts import (
     ProgramContract,
@@ -63,6 +80,23 @@ def default_golden_dir() -> str:
 
 def golden_path(golden_dir: str, name: str) -> str:
     return os.path.join(golden_dir, f"{name}.json")
+
+
+@dataclasses.dataclass
+class ProgramSpec:
+    """Everything the extractor needs about one registered program:
+    the jitted callable, abstract example arguments, the per-argument
+    labels/donation, and the mesh + per-argument prefix specs the
+    layer-3 sharding pass propagates from."""
+
+    name: str
+    fn: Callable
+    example_args: tuple
+    arg_labels: tuple[str, ...]
+    world: int
+    mesh: Any
+    in_specs: tuple
+    declared_donated: tuple[str, ...] = ()
 
 
 # ---------------------------------------------------------------------------
@@ -121,30 +155,46 @@ def _batch_struct(*lead):
     return jax.ShapeDtypeStruct((*lead, _FEATURES), jnp.float32)
 
 
+def _axis_mesh(axis_name: str):
+    """All devices on one named axis — how each strategy module builds
+    its own mesh today (the siloing item 1 will fold into one layout)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()), (axis_name,))
+
+
 # ---------------------------------------------------------------------------
 # program registry
 
 
-def _dp_train_step() -> ProgramContract:
+def _dp_train_step() -> ProgramSpec:
     import optax
+    from jax.sharding import PartitionSpec as P
 
     from tpu_syncbn import parallel
 
     dp = parallel.DataParallel(
         _tiny_model(), optax.sgd(0.1, momentum=0.9), _mse
     )
-    return extract_contract(
-        dp._train_step,
-        (dp._param_store, dp.rest, dp.opt_state, _batch_struct(_GLOBAL_BATCH)),
+    return ProgramSpec(
         name="dataparallel.train_step",
-        world=dp.world,
+        fn=dp._train_step,
+        example_args=(dp._param_store, dp.rest, dp.opt_state,
+                      _batch_struct(_GLOBAL_BATCH)),
         arg_labels=("params", "rest", "opt_state", "batch"),
         declared_donated=("params", "rest", "opt_state"),
+        world=dp.world,
+        mesh=dp.mesh,
+        in_specs=(dp._pspec, dp._rest_spec, dp._opt_spec,
+                  P(dp.axis_name)),
     )
 
 
-def _dp_zero_guard_train_step() -> ProgramContract:
+def _dp_zero_guard_train_step() -> ProgramSpec:
     import optax
+    from jax.sharding import PartitionSpec as P
 
     from tpu_syncbn import parallel
 
@@ -152,40 +202,50 @@ def _dp_zero_guard_train_step() -> ProgramContract:
         _tiny_model(), optax.adam(1e-3), _mse,
         zero=True, divergence_guard="skip_step",
     )
-    return extract_contract(
-        dp._train_step,
-        (dp._param_store, dp.rest, dp.opt_state, _batch_struct(_GLOBAL_BATCH)),
+    return ProgramSpec(
         name="dataparallel.zero_guard.train_step",
-        world=dp.world,
+        fn=dp._train_step,
+        example_args=(dp._param_store, dp.rest, dp.opt_state,
+                      _batch_struct(_GLOBAL_BATCH)),
         arg_labels=("params", "rest", "opt_state", "batch"),
         declared_donated=("params", "rest", "opt_state"),
+        world=dp.world,
+        mesh=dp.mesh,
+        in_specs=(dp._pspec, dp._rest_spec, dp._opt_spec,
+                  P(dp.axis_name)),
     )
 
 
-def _dp_scan(k: int) -> ProgramContract:
+def _dp_scan(k: int) -> ProgramSpec:
     import optax
+    from jax.sharding import PartitionSpec as P
 
     from tpu_syncbn import parallel
+    from tpu_syncbn.parallel import scan_driver
 
     dp = parallel.DataParallel(
         _tiny_model(), optax.sgd(0.1, momentum=0.9), _mse
     )
     fn = dp._build_train_steps(k, stacked=True)
-    return extract_contract(
-        fn,
-        (dp._param_store, dp.rest, dp.opt_state,
-         _batch_struct(k, _GLOBAL_BATCH)),
+    return ProgramSpec(
         name=f"dataparallel.scan_k{k}.train_steps",
-        world=dp.world,
+        fn=fn,
+        example_args=(dp._param_store, dp.rest, dp.opt_state,
+                      _batch_struct(k, _GLOBAL_BATCH)),
         arg_labels=("params", "rest", "opt_state", "batches"),
         declared_donated=("params", "rest", "opt_state"),
+        world=dp.world,
+        mesh=dp.mesh,
+        in_specs=(dp._pspec, dp._rest_spec, dp._opt_spec,
+                  scan_driver.stack_batch_spec(P(dp.axis_name))),
     )
 
 
-def _gan_train_step() -> ProgramContract:
+def _gan_train_step() -> ProgramSpec:
     import jax
     import jax.numpy as jnp
     import optax
+    from jax.sharding import PartitionSpec as P
 
     from tpu_syncbn import parallel
 
@@ -193,22 +253,26 @@ def _gan_train_step() -> ProgramContract:
     gan = parallel.GANTrainer(g, d, optax.adam(1e-4), optax.adam(1e-4))
     real = _batch_struct(_GLOBAL_BATCH)
     z = jax.ShapeDtypeStruct((_GLOBAL_BATCH, _LATENT), jnp.float32)
-    return extract_contract(
-        gan._step,
-        (gan.g_params, gan.g_rest, gan.d_params, gan.d_rest,
-         gan.g_opt_state, gan.d_opt_state, real, z, z),
+    return ProgramSpec(
         name="gan.train_step",
-        world=int(gan.mesh.shape[gan.axis_name]),
+        fn=gan._step,
+        example_args=(gan.g_params, gan.g_rest, gan.d_params, gan.d_rest,
+                      gan.g_opt_state, gan.d_opt_state, real, z, z),
         arg_labels=("g_params", "g_rest", "d_params", "d_rest",
                     "g_opt_state", "d_opt_state", "real", "z_d", "z_g"),
         declared_donated=("g_params", "g_rest", "d_params", "d_rest",
                           "g_opt_state", "d_opt_state"),
+        world=int(gan.mesh.shape[gan.axis_name]),
+        mesh=gan.mesh,
+        in_specs=(P(), P(), P(), P(), P(), P(),
+                  P(gan.axis_name), P(gan.axis_name), P(gan.axis_name)),
     )
 
 
-def _serve_eval_bucket() -> ProgramContract:
+def _serve_eval_bucket() -> ProgramSpec:
     import jax
     import numpy as np
+    from jax.sharding import PartitionSpec as P
 
     from tpu_syncbn.serve.engine import InferenceEngine
 
@@ -217,35 +281,187 @@ def _serve_eval_bucket() -> ProgramContract:
     example = np.zeros((bucket, _FEATURES), np.float32)
     treedef, leafspecs = eng._struct_key(example)
     fn = jax.jit(eng._sharded_fwd())
-    return extract_contract(
-        fn,
-        (eng._params, eng._rest,
-         eng._bucket_struct(bucket, treedef, leafspecs)),
+    return ProgramSpec(
         name="serve.eval_bucket8",
-        world=eng.world,
+        fn=fn,
+        example_args=(eng._params, eng._rest,
+                      eng._bucket_struct(bucket, treedef, leafspecs)),
         arg_labels=("params", "rest", "batch"),
         declared_donated=(),
+        world=eng.world,
+        mesh=eng.mesh,
+        in_specs=(P(), P(), P(eng.axis_name)),
     )
 
 
-PROGRAM_BUILDERS: dict[str, Callable[[], ProgramContract]] = {
+def _tensor_tp_mlp() -> ProgramSpec:
+    """The Megatron MLP (tensor.py): column → gelu → row, ONE psum."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_syncbn.compat import shard_map
+    from tpu_syncbn.mesh_axes import MODEL_AXIS
+    from tpu_syncbn.parallel import tensor
+
+    mesh = _axis_mesh(MODEL_AXIS)
+    world = int(mesh.shape[MODEL_AXIS])
+    d, h = _FEATURES, 2 * world  # H divides by world
+    in_specs = (P(), P(None, MODEL_AXIS), P(MODEL_AXIS),
+                P(MODEL_AXIS, None), P())
+    fn = jax.jit(shard_map(
+        tensor.tp_mlp, mesh=mesh, in_specs=in_specs, out_specs=P(),
+    ))
+    sds = jax.ShapeDtypeStruct
+    args = (
+        sds((_GLOBAL_BATCH, d), jnp.float32),   # x replicated
+        sds((d, h), jnp.float32),               # w1 sharded on H
+        sds((h,), jnp.float32),                 # b1 sharded on H
+        sds((h, d), jnp.float32),               # w2 sharded on H (input)
+        sds((d,), jnp.float32),                 # b2 replicated
+    )
+    return ProgramSpec(
+        name="tensor.tp_mlp", fn=fn, example_args=args,
+        arg_labels=("x", "w1", "b1", "w2", "b2"),
+        world=world, mesh=mesh, in_specs=in_specs,
+    )
+
+
+def _pipeline_gpipe() -> ProgramSpec:
+    """The GPipe schedule (pipeline.py): M microbatches through
+    world stages — one ppermute hand-off per tick (scan body) plus the
+    last-stage psum mask."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_syncbn.mesh_axes import PIPE_AXIS
+    from tpu_syncbn.parallel import pipeline
+
+    mesh = _axis_mesh(PIPE_AXIS)
+    world = int(mesh.shape[PIPE_AXIS])
+    d, m, mb = _FEATURES, 4, 2
+
+    def stage_fn(params, x):
+        return jnp.tanh(x @ params["w"] + params["b"])
+
+    fn = jax.jit(pipeline.pipeline_parallel(stage_fn, mesh))
+    sds = jax.ShapeDtypeStruct
+    args = (
+        {"w": sds((world, d, d), jnp.float32),
+         "b": sds((world, d), jnp.float32)},    # stacked stage params
+        sds((m, mb, d), jnp.float32),           # microbatches
+    )
+    return ProgramSpec(
+        name="pipeline.gpipe", fn=fn, example_args=args,
+        arg_labels=("stage_params", "microbatches"),
+        world=world, mesh=mesh,
+        in_specs=(P(PIPE_AXIS), P()),
+    )
+
+
+def _expert_switch_moe() -> ProgramSpec:
+    """Switch MoE (expert.py): two all_to_alls move capacity slots to
+    their expert's device and back; the aux loss is pmean'd."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_syncbn.compat import shard_map
+    from tpu_syncbn.mesh_axes import EXPERT_AXIS
+    from tpu_syncbn.parallel import expert
+
+    mesh = _axis_mesh(EXPERT_AXIS)
+    world = int(mesh.shape[EXPERT_AXIS])
+    d, h = _FEATURES, 4
+    e = world          # one expert per device
+    t_global = 8 * world
+    in_specs = (P(EXPERT_AXIS), P(), P(EXPERT_AXIS), P(EXPERT_AXIS))
+    fn = jax.jit(shard_map(
+        expert.expert_parallel_moe, mesh=mesh,
+        in_specs=in_specs, out_specs=(P(EXPERT_AXIS), P()),
+    ))
+    sds = jax.ShapeDtypeStruct
+    args = (
+        sds((t_global, d), jnp.float32),        # tokens sharded
+        sds((d, e), jnp.float32),               # router replicated
+        sds((e, d, h), jnp.float32),            # w_in sharded on E
+        sds((e, h, d), jnp.float32),            # w_out sharded on E
+    )
+    return ProgramSpec(
+        name="expert.switch_moe", fn=fn, example_args=args,
+        arg_labels=("x", "router_w", "w_in", "w_out"),
+        world=world, mesh=mesh, in_specs=in_specs,
+    )
+
+
+def _sequence_ring_attention() -> ProgramSpec:
+    """Ring attention (sequence.py): the KV pair rotates with one
+    ppermute in the scan body; sequence stays sharded end to end."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_syncbn import compat
+    from tpu_syncbn.compat import shard_map
+    from tpu_syncbn.mesh_axes import SEQ_AXIS
+    from tpu_syncbn.parallel import sequence
+
+    mesh = _axis_mesh(SEQ_AXIS)
+    world = int(mesh.shape[SEQ_AXIS])
+    b, l, h, dh = 2, 4 * world, 2, 4
+    spec = P(None, SEQ_AXIS, None, None)
+    fn = jax.jit(shard_map(
+        sequence.ring_attention, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=compat.HAS_VMA,
+    ))
+    sds = jax.ShapeDtypeStruct
+    qkv = sds((b, l, h, dh), jnp.float32)
+    return ProgramSpec(
+        name="sequence.ring_attention", fn=fn,
+        example_args=(qkv, qkv, qkv),
+        arg_labels=("q", "k", "v"),
+        world=world, mesh=mesh, in_specs=(spec, spec, spec),
+    )
+
+
+PROGRAM_BUILDERS: dict[str, Callable[[], ProgramSpec]] = {
     "dataparallel.train_step": _dp_train_step,
     "dataparallel.zero_guard.train_step": _dp_zero_guard_train_step,
     "dataparallel.scan_k1.train_steps": lambda: _dp_scan(1),
     "dataparallel.scan_k4.train_steps": lambda: _dp_scan(4),
     "gan.train_step": _gan_train_step,
     "serve.eval_bucket8": _serve_eval_bucket,
+    "tensor.tp_mlp": _tensor_tp_mlp,
+    "pipeline.gpipe": _pipeline_gpipe,
+    "expert.switch_moe": _expert_switch_moe,
+    "sequence.ring_attention": _sequence_ring_attention,
 }
 
 
 def build_contracts(
     names: Sequence[str] | None = None,
+    *,
+    memory: bool = False,
 ) -> dict[str, ProgramContract]:
-    """Trace the registered programs and return their live contracts."""
+    """Trace the registered programs and return their live contracts
+    (layer-1 fields + the layer-3 sharding block). ``memory=True``
+    additionally compiles each program once so the sharding block
+    carries the XLA ``memory_analysis`` cross-check — the ``--shardings``
+    CLI mode."""
     picked = list(PROGRAM_BUILDERS) if names is None else list(names)
     out: dict[str, ProgramContract] = {}
     for name in picked:
-        out[name] = PROGRAM_BUILDERS[name]()
+        spec = PROGRAM_BUILDERS[name]()
+        out[name] = extract_contract(
+            spec.fn, spec.example_args,
+            name=spec.name, world=spec.world,
+            arg_labels=spec.arg_labels,
+            declared_donated=spec.declared_donated,
+            mesh=spec.mesh, in_specs=spec.in_specs,
+            memory=memory,
+        )
     return out
 
 
@@ -287,6 +503,18 @@ def check_invariants(
           f"(per logical step): K=1 {k1.collectives} vs K=4 "
           f"{k4.collectives}")
 
+    tp = contracts.get("tensor.tp_mlp")
+    if tp is not None and tp.collectives != {"psum": 1}:
+        v("contract.tp_one_psum",
+          "the Megatron column->row pairing costs exactly ONE psum "
+          f"(tensor.py's whole point), found {tp.collectives}")
+
+    moe = contracts.get("expert.switch_moe")
+    if moe is not None and moe.collectives.get("all_to_all", 0) != 2:
+        v("contract.moe_two_all_to_all",
+          "expert-parallel MoE relocates compute with exactly TWO "
+          f"all_to_alls (dispatch + return), found {moe.collectives}")
+
     for name, c in contracts.items():
         for label in c.donated_declared:
             if not c.donated_aliased.get(label):
@@ -298,6 +526,46 @@ def check_invariants(
             v("contract.host_callback",
               f"{name}: host callback(s) {c.host_callbacks} inside a hot "
               "program — every execution pays a device→host round trip")
+    return out
+
+
+def check_sharding(
+    contracts: dict[str, ProgramContract],
+    *,
+    mem_budget: int | None = None,
+) -> list[Violation]:
+    """Layer-3 detectors, independent of the goldens: accidental
+    replication above the threshold, implicit resharding anywhere, and
+    (when a budget is given) the per-device peak-memory contract. The
+    golden comparison additionally pins the numeric fields, so drift
+    *below* these detectors' bars is still caught."""
+    out: list[Violation] = []
+
+    def v(rule: str, msg: str) -> None:
+        out.append(Violation(rule=rule, message=msg, path="<jaxpr>", line=0))
+
+    for name, c in contracts.items():
+        s = c.sharding
+        if s is None:
+            continue
+        for detail in s.replication_detail:
+            v("sharding.replication",
+              f"{name}: intermediate materialized fully replicated on "
+              f"every device above the {s.replication_threshold}-byte "
+              f"threshold — {detail}. Shard it, or gather closer to its "
+              "use site")
+        for detail in s.reshard_detail:
+            v("sharding.implicit_reshard",
+              f"{name}: layout change not explained by a declared "
+              f"collective — {detail}")
+        if mem_budget is not None:
+            peak = max(s.peak_bytes_per_device, s.xla_peak_bytes or 0)
+            if peak > mem_budget:
+                v("sharding.mem_budget",
+                  f"{name}: per-device peak estimate {peak} B exceeds "
+                  f"the --mem-budget contract of {mem_budget} B "
+                  f"(flow estimate {s.peak_bytes_per_device} B, XLA "
+                  f"{s.xla_peak_bytes} B)")
     return out
 
 
@@ -325,12 +593,47 @@ def check_goldens(
     return violations, unpinned
 
 
+def golden_diffs(
+    contracts: dict[str, ProgramContract], golden_dir: str
+) -> dict[str, list[str]]:
+    """Per-contract field-level old→new summary against the pinned
+    goldens — what ``--write-goldens`` prints so a re-pin is reviewed,
+    not rubber-stamped. New (unpinned) programs map to a single
+    ``<new golden>`` marker."""
+    out: dict[str, list[str]] = {}
+    for name, contract in contracts.items():
+        path = golden_path(golden_dir, name)
+        if not os.path.exists(path):
+            out[name] = ["<new golden — no previous pin>"]
+            continue
+        golden = load_contract(path)
+        diffs = compare_contracts(contract, golden)
+        # compare_contracts deliberately skips xla_peak_bytes when one
+        # side did not compile (strict runs without --shardings must
+        # stay quiet) — but a RE-PIN that would erase a previously
+        # pinned cross-check is a reviewable change, not a silent one
+        if golden.sharding is not None \
+                and golden.sharding.xla_peak_bytes is not None \
+                and contract.sharding is not None \
+                and contract.sharding.xla_peak_bytes is None:
+            diffs.append(
+                f"{name}: sharding.xla_peak_bytes = None, golden pins "
+                f"{golden.sharding.xla_peak_bytes} — re-pinning without "
+                "--shardings would erase the memory cross-check (add "
+                "--shardings, or --force to drop it deliberately)"
+            )
+        if diffs:
+            out[name] = diffs
+    return out
+
+
 def write_goldens(
     contracts: dict[str, ProgramContract], golden_dir: str
 ) -> list[str]:
     """Pin (or re-pin) every contract as a golden JSON file. Returns the
     written paths. Only do this after an *intentional* program change —
-    the diff review IS the contract review (docs/STATIC_ANALYSIS.md)."""
+    the diff review IS the contract review (docs/STATIC_ANALYSIS.md);
+    the CLI wraps this with :func:`golden_diffs` + ``--force``."""
     os.makedirs(golden_dir, exist_ok=True)
     written = []
     for name, contract in contracts.items():

@@ -903,6 +903,108 @@ def check_unbounded_blocking(
 
 
 # ---------------------------------------------------------------------------
+# rule: hardcoded_mesh_axis
+
+#: Axis-name literals the rule polices (pre-work for the ROADMAP item-1
+#: SpecLayout: a mesh refactor can only rename/compose axes mechanically
+#: if no call site spells its own). The canonical constants live in
+#: tpu_syncbn/mesh_axes.py — the ONE module allowed to contain these.
+MESH_AXIS_LITERALS = frozenset({"data", "model", "fsdp"})
+
+#: Call targets whose string arguments are mesh-axis names: sharding
+#: constructors and the named-axis collective surface.
+_AXIS_CALL_NAMES = frozenset({
+    "PartitionSpec", "P", "Mesh", "AbstractMesh", "NamedSharding",
+    "make_mesh",
+    "psum", "pmean", "pmin", "pmax", "all_gather", "all_to_all",
+    "reduce_scatter", "psum_scatter", "ppermute", "pgather",
+    "axis_index", "axis_size", "pcast_varying", "broadcast",
+})
+
+#: Keyword names that carry axis names in any call (shard_map specs are
+#: P(...) calls and covered above; these catch axis_name="data" forms).
+_AXIS_KWARGS = frozenset({"axis_name", "axis_names", "axis"})
+
+#: File suffixes allowed to contain the literals: the constants module
+#: itself.
+_MESH_AXIS_ALLOW = ("tpu_syncbn/mesh_axes.py",)
+
+
+def _axis_literals_under(node: ast.AST) -> Iterable[ast.Constant]:
+    """String constants in the policed set, looking through tuples/lists
+    (``Mesh(devs, ("data",))`` / ``axis_names=["data"]``)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.Tuple, ast.List)):
+            stack.extend(n.elts)
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and n.value in MESH_AXIS_LITERALS:
+            yield n
+
+
+def check_hardcoded_mesh_axis(
+    tree: ast.AST, path: str, src_lines: Sequence[str]
+) -> list[Violation]:
+    """``hardcoded_mesh_axis``: a mesh-axis name (``"data"`` /
+    ``"model"`` / ``"fsdp"``) spelled as a string literal in an
+    axis-naming position — a sharding/mesh constructor argument, a
+    collective's axis argument, an ``axis_name=`` keyword or default, or
+    an ``*_AXIS`` constant assignment — anywhere outside
+    ``tpu_syncbn/mesh_axes.py``. Import the constant instead: the
+    item-1 SpecLayout refactor renames/composes axes centrally, and a
+    private literal is the coupling that breaks it silently."""
+    norm = path.replace(os.sep, "/")
+    if any(norm.endswith(suffix) for suffix in _MESH_AXIS_ALLOW):
+        return []
+    out: list[Violation] = []
+
+    def hit(lit: ast.Constant, where: str) -> None:
+        out.append(Violation(
+            rule="hardcoded_mesh_axis", path=path, line=lit.lineno,
+            col=lit.col_offset,
+            message=f"mesh-axis literal {lit.value!r} {where} — import "
+                    "the constant from tpu_syncbn.mesh_axes (the one "
+                    "module allowed to spell axis names)",
+        ))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            func = node.func
+            fname = func.id if isinstance(func, ast.Name) else (
+                func.attr if isinstance(func, ast.Attribute) else None
+            )
+            if fname in _AXIS_CALL_NAMES:
+                for arg in node.args:
+                    for lit in _axis_literals_under(arg):
+                        hit(lit, f"as a {fname}(...) argument")
+            for kw in node.keywords:
+                if kw.arg in _AXIS_KWARGS:
+                    for lit in _axis_literals_under(kw.value):
+                        hit(lit, f"as the {kw.arg}= keyword")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # defaults align with the TAIL of posonly+positional args
+            pos = list(node.args.posonlyargs) + list(node.args.args)
+            pairs = list(zip(
+                pos[len(pos) - len(node.args.defaults):],
+                node.args.defaults,
+            )) + list(zip(node.args.kwonlyargs, node.args.kw_defaults))
+            for arg, default in pairs:
+                if arg.arg in _AXIS_KWARGS and default is not None:
+                    for lit in _axis_literals_under(default):
+                        hit(lit, f"as the default of {arg.arg!r}")
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if any(isinstance(t, ast.Name) and t.id.endswith("_AXIS")
+                   for t in targets) and node.value is not None:
+                for lit in _axis_literals_under(node.value):
+                    hit(lit, "bound to an *_AXIS constant outside the "
+                             "constants module")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # driver
 
 RULES: dict[str, Callable] = {
@@ -914,6 +1016,7 @@ RULES: dict[str, Callable] = {
     "unpaired_trace_span": check_unpaired_trace_span,
     "wallclock_duration": check_wallclock_duration,
     "unbounded_blocking": check_unbounded_blocking,
+    "hardcoded_mesh_axis": check_hardcoded_mesh_axis,
 }
 
 

@@ -1,0 +1,47 @@
+"""Canonical mesh-axis names — the ONE module allowed to spell them as
+string literals.
+
+Every parallelism strategy names its mesh axis here and imports the
+constant; the ``hardcoded_mesh_axis`` srclint rule
+(:mod:`tpu_syncbn.audit.srclint`) fails the build on a string literal
+axis name anywhere else in the package. Why this is worth a lint: the
+ROADMAP item-1 unification folds DP × FSDP × TP onto one multi-axis
+mesh, and a layout object can only rename/compose axes mechanically if
+no call site has its own private ``"data"`` — a stray literal is
+exactly the kind of silent coupling that turns a mesh refactor into a
+week of grepping.
+
+This module must stay import-free (stdlib only, no jax): it is imported
+by :mod:`tpu_syncbn.runtime.distributed` while the package ``__init__``
+is still executing, so any dependency here would recreate the circular
+import it exists to avoid.
+"""
+
+#: Data-parallel axis: the reference recipe's "process group" of N
+#: single-GPU workers as one named axis spanning every chip.
+DATA_AXIS = "data"
+
+#: Tensor (model) parallel axis — Megatron-style sharded linears
+#: (:mod:`tpu_syncbn.parallel.tensor`).
+MODEL_AXIS = "model"
+
+#: Fully-sharded-data-parallel axis, reserved for the ROADMAP item-1
+#: ``P(('data','fsdp'))`` composed layout (ZeRO today shards along
+#: :data:`DATA_AXIS`; the SpecLayout refactor gives the shard dimension
+#: its own name so DP and FSDP can coexist on a 2-D mesh).
+FSDP_AXIS = "fsdp"
+
+#: Pipeline-parallel stage axis (:mod:`tpu_syncbn.parallel.pipeline`).
+PIPE_AXIS = "pipe"
+
+#: Expert-parallel axis (:mod:`tpu_syncbn.parallel.expert`).
+EXPERT_AXIS = "expert"
+
+#: Sequence/context-parallel axis (:mod:`tpu_syncbn.parallel.sequence`).
+SEQ_AXIS = "seq"
+
+#: Every axis name the framework may put on a mesh, in layout order
+#: (data-like outermost). The item-1 SpecLayout will validate its mesh
+#: axes against this tuple.
+ALL_AXES = (DATA_AXIS, FSDP_AXIS, MODEL_AXIS, PIPE_AXIS, EXPERT_AXIS,
+            SEQ_AXIS)

@@ -29,7 +29,8 @@ from tpu_syncbn.compat import axis_size as _compat_axis_size
 import jax.numpy as jnp
 from jax import lax
 
-EXPERT_AXIS = "expert"
+# canonical home: tpu_syncbn.mesh_axes (srclint hardcoded_mesh_axis)
+from tpu_syncbn.mesh_axes import EXPERT_AXIS  # noqa: E402
 
 
 def switch_route(

@@ -38,7 +38,8 @@ from jax import lax
 from tpu_syncbn.compat import axis_size as _compat_axis_size
 from tpu_syncbn.parallel.collectives import pcast_varying
 
-PIPE_AXIS = "pipe"
+# canonical home: tpu_syncbn.mesh_axes (srclint hardcoded_mesh_axis)
+from tpu_syncbn.mesh_axes import PIPE_AXIS  # noqa: E402
 
 Pytree = Any
 

@@ -53,7 +53,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpu_syncbn.compat import axis_size as _compat_axis_size
 from tpu_syncbn.parallel.collectives import pcast_varying
 
-SEQ_AXIS = "seq"
+# canonical home: tpu_syncbn.mesh_axes (srclint hardcoded_mesh_axis)
+from tpu_syncbn.mesh_axes import SEQ_AXIS  # noqa: E402
 
 # finite stand-in for -inf in masked logits: keeps the online-softmax
 # running max finite when an entire KV block is masked out (exp(-inf+inf)

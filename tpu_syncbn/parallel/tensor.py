@@ -31,7 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-MODEL_AXIS = "model"
+# canonical home: tpu_syncbn.mesh_axes (srclint hardcoded_mesh_axis)
+from tpu_syncbn.mesh_axes import MODEL_AXIS  # noqa: E402
 
 
 def column_parallel(

@@ -40,7 +40,11 @@ _jax_distributed_active: bool = False
 #: Name of the data-parallel mesh axis used throughout the framework. The
 #: reference's "process group" of N single-GPU processes (README.md:5)
 #: becomes this one named axis spanning every chip in the slice.
-DATA_AXIS = "data"
+#: Canonically defined in :mod:`tpu_syncbn.mesh_axes` (the one module
+#: allowed to spell axis names as literals — srclint
+#: ``hardcoded_mesh_axis``); re-exported here for the historical import
+#: path every trainer uses.
+from tpu_syncbn.mesh_axes import DATA_AXIS  # noqa: E402
 
 
 @dataclasses.dataclass(frozen=True)
