@@ -818,6 +818,93 @@ def measure_monitor(agg) -> dict:
     }
 
 
+def measure_incident(recorder, *, steps: int, wall_s: float,
+                     flops_per_step: float | None) -> dict:
+    """The ``incident`` block of the bench line: the flight recorder +
+    incident-bundle path (docs/OBSERVABILITY.md "Incidents & flight
+    recorder"), forced on the run's own state.
+
+    The recorder rode the timed loop (one ``record_step`` per step —
+    the always-on steady-state cost, bounded below), so its rings hold
+    the loop's steps and the shared aggregator's windows. This forces
+    the manual trigger and reports what an incident costs and carries:
+
+    * ``dump_s`` / ``bundle_bytes`` — bundle write latency and size
+      (both anchored in BASELINE.json for ``--check-regression``);
+    * ``ring_steps`` / ``ring_seconds`` — how far back the step ring
+      reaches (the pre-trigger evidence window);
+    * ``record_step_cost_s`` / ``record_overhead_frac`` — the per-step
+      recording cost, micro-measured, as a fraction of the measured
+      average step time (the ≤2% steady-state acceptance bound);
+    * ``attribution`` — the explained-step-time report over the bundle
+      (data-wait / host-dispatch / compute / collective shares, joined
+      with the static contract: ``cost_analysis`` flops and the
+      trace-time collective bytes-on-wire), whose shares sum to 1.0 by
+      construction.
+
+    Schema pinned by tests/test_bench_tooling.py."""
+    import shutil
+    import tempfile
+
+    from tpu_syncbn.obs import incident as incident_mod, stepstats
+
+    # static contract: flops from HLO cost analysis, bytes-on-wire from
+    # the trace-time collective inventory (per compiled program = per
+    # step), contract identity from the pinned goldens
+    tallies = stepstats.collective_tallies()
+    bytes_per_step = sum(
+        v for k, v in tallies.items() if k.endswith(".bytes")
+    ) or None
+    recorder.set_contract(
+        name="resnet50_syncbn_dp.train_step",
+        flops_per_step=flops_per_step,
+        collective_bytes_per_step=bytes_per_step,
+        fingerprint=incident_mod.contract_fingerprint(),
+    )
+    coverage = recorder.ring_coverage()
+    bundle_dir = tempfile.mkdtemp(prefix="bench_incident_")
+    prev_dir = recorder.incident_dir
+    recorder.incident_dir = bundle_dir
+    try:
+        t0 = time.perf_counter()
+        path = recorder.trigger("manual", {"source": "bench"}, force=True)
+        dump_s = time.perf_counter() - t0
+        if path is None:
+            raise RuntimeError("forced manual trigger produced no bundle")
+        bundle_bytes = os.path.getsize(path)
+        bundle = incident_mod.load_bundle(path)  # schema-validates
+        attr = incident_mod.attribution(bundle)
+    finally:
+        recorder.incident_dir = prev_dir
+        shutil.rmtree(bundle_dir, ignore_errors=True)
+    # the steady-state cost of riding the loop: one record_step call,
+    # micro-measured against the loop's average step time
+    t0 = time.perf_counter()
+    for i in range(1000):
+        recorder.record_step(i, metrics={"loss": 0.0})
+    record_cost_s = (time.perf_counter() - t0) / 1000
+    avg_step_s = wall_s / steps if steps else None
+    return {
+        "dump_s": round(dump_s, 4),
+        "bundle_bytes": bundle_bytes,
+        "incident_id": bundle["incident_id"],
+        "trigger": bundle["trigger"]["kind"],
+        "ring_steps": coverage["steps"],
+        "ring_seconds": coverage["seconds"],
+        "trace_events": len(bundle["trace"]["traceEvents"]),
+        "record_step_cost_s": round(record_cost_s, 9),
+        "record_overhead_frac": (
+            round(record_cost_s / avg_step_s, 6) if avg_step_s else None
+        ),
+        "attribution": None if attr is None else {
+            "steps": attr["steps"],
+            "shares": attr["shares"],
+            "share_sum": attr["share_sum"],
+            "bytes_source": attr["inputs"]["bytes_source"],
+        },
+    }
+
+
 def measure_audit(dp, batch) -> dict:
     """The ``audit`` block of the bench line: the static-analysis layer
     (docs/STATIC_ANALYSIS.md) run against THIS process — the package
@@ -959,7 +1046,7 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
     ``serve`` (the ``--serve`` flag) additionally runs the
     dynamic-batching inference sweep (:func:`measure_serve`) on the
     trained state and attaches the schema-pinned ``serve`` block."""
-    from tpu_syncbn.obs import stepstats, telemetry, tracing
+    from tpu_syncbn.obs import flightrec, stepstats, telemetry, tracing
 
     telemetry.set_enabled(True)
     tracer = tracing.install() if trace_path else None
@@ -1021,15 +1108,36 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
     agg = timeseries.WindowedAggregator()
     agg.tick()
 
+    # flight recorder force-armed for the run (like telemetry): shares
+    # the run's aggregator (no second sampler), rides the timed loop
+    # via one record_step per step, and the incident block below forces
+    # a manual bundle dump on the run's own state. With --trace the
+    # recorder taps bench's tracer; otherwise it installs a bounded
+    # RingTracer, so the bundle always carries a trace slice. Bundles
+    # (including any spontaneous trigger mid-run) land under a temp
+    # dir, never the working directory of a benchmark.
+    import tempfile
+
+    incident_tmp = tempfile.mkdtemp(prefix="bench_incidents_")
+    recorder = flightrec.install(flightrec.FlightRecorder(
+        aggregator=agg, incident_dir=incident_tmp,
+    ))
+
     # instrumented loop: per-step "data_wait"/"step" spans + the
     # step.time_s histogram (host DISPATCH time per step — jax dispatch
     # is async, the final fetch_sync settles the chain). perf_counter
     # pairs per step are noise relative to a step; the timing math below
     # is unchanged.
     t0 = time.perf_counter()
-    for b in stepstats.instrumented_batches(itertools.repeat(batch, steps)):
+    for si, b in enumerate(
+        stepstats.instrumented_batches(itertools.repeat(batch, steps))
+    ):
         with stepstats.timed_span("step", "step.time_s"):
             out = dp.train_step(b)
+        # step ring: async device scalars recorded as-is (no host sync;
+        # the incident block bounds this call's cost at ≤2% of a step)
+        flightrec.record_step(si + 1, metrics=out.metrics,
+                              monitors=out.monitors)
     fetch_sync(out.loss)  # the final loss value transitively forces
     # every step in the donated-state chain
     dt = time.perf_counter() - t0
@@ -1156,6 +1264,24 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
         log(f"monitor measurement failed: {type(e).__name__}: {e}")
         monitor_info = None
 
+    # flight recorder + incident bundle measured on the run's own state
+    # (docs/OBSERVABILITY.md "Incidents & flight recorder") — an
+    # annotation, never fatal to the metric
+    try:
+        with stepstats.timed_span("incident_bench", "bench.incident_s"):
+            incident_info = measure_incident(
+                recorder, steps=steps, wall_s=dt,
+                flops_per_step=flops_per_step,
+            )
+        log(f"incident: bundle {incident_info['bundle_bytes']} bytes in "
+            f"{incident_info['dump_s'] * 1e3:.1f} ms, ring "
+            f"{incident_info['ring_steps']} steps / "
+            f"{incident_info['ring_seconds']:.2f}s, record overhead "
+            f"{incident_info['record_overhead_frac']}")
+    except Exception as e:
+        log(f"incident measurement failed: {type(e).__name__}: {e}")
+        incident_info = None
+
     # static-analysis layer measured on the run's own program
     # (docs/STATIC_ANALYSIS.md) — an annotation, never fatal to the
     # metric
@@ -1231,6 +1357,12 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
         # per-device peak tracks the real workload's footprint); schema
         # pinned by tests/test_bench_tooling.py
         "audit": audit_info,
+        # docs/OBSERVABILITY.md "Incidents & flight recorder": forced-
+        # trigger bundle cost (dump_s / bundle_bytes — both BASELINE
+        # anchors), pre-trigger ring coverage, per-step recording
+        # overhead, and the explained-step-time attribution (shares sum
+        # to 1.0); schema pinned by tests/test_bench_tooling.py
+        "incident": incident_info,
         # a fallback line is a liveness smoke signal, not a measurement
         # of anything the project tracks — cross-round diffs of it are
         # meaningless and tagged as such
@@ -1241,6 +1373,17 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
         # tests/test_bench_tooling.py so output drift fails tier-1
         "telemetry": telemetry.snapshot(),
     }
+    # the recorder's job is done: uninstall it (so in-process callers —
+    # the tooling tests — don't inherit a live recorder) and drop the
+    # temp bundle dir, including any spontaneous mid-run bundle. The
+    # tests' finally blocks remain the exception-path belt.
+    import shutil
+
+    rec = flightrec.uninstall()
+    if rec is not None:
+        rec.close()
+    shutil.rmtree(incident_tmp, ignore_errors=True)
+
     if tracer is not None:
         # written BEFORE the JSON line so a driver parsing stdout can
         # rely on the trace already existing

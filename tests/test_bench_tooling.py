@@ -518,7 +518,7 @@ class TestTelemetryBlock:
     def test_bench_line_telemetry_and_trace_validate(
         self, tmp_path, monkeypatch, capsys
     ):
-        from tpu_syncbn.obs import telemetry, tracing
+        from tpu_syncbn.obs import flightrec, telemetry, tracing
 
         bench = _load_bench()
         monkeypatch.setenv("TPU_SYNCBN_FORCE_CPU", "1")
@@ -529,10 +529,13 @@ class TestTelemetryBlock:
         try:
             bench.main(trace_path=trace)
         finally:
-            # main() force-enables telemetry and installs a tracer;
-            # restore the suite's ambient state
+            # main() force-enables telemetry, installs a tracer, and
+            # arms a flight recorder; restore the suite's ambient state
             telemetry.set_enabled(None)
             telemetry.REGISTRY.reset()
+            rec = flightrec.uninstall()
+            if rec is not None:
+                rec.close()
             tracing.uninstall()
         line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
         # the block validates against the pinned schema...
@@ -555,6 +558,9 @@ class TestTelemetryBlock:
         # the audit block is always present (the static-analysis layer
         # measured on the run's own program — ISSUE 10)
         self._validate_audit_block(line["audit"])
+        # the incident block is always present (the flight recorder is
+        # armed on every run and a manual bundle is forced — ISSUE 11)
+        self._validate_incident_block(line["incident"], steps=3)
         # the serve block is null unless --serve ran the sweep
         assert line["serve"] is None
         # the --trace file is valid Chrome trace JSON with the three
@@ -610,6 +616,38 @@ class TestTelemetryBlock:
         assert block["slo_burn_rate"] is not None
 
     @staticmethod
+    def _validate_incident_block(block, *, steps):
+        """The schema-pinned `incident` block (ISSUE 11): the flight
+        recorder's forced-trigger bundle — write latency and size are
+        BASELINE anchors, the ring must cover the timed loop, the
+        per-step recording cost must stay within the 2% steady-state
+        bound, and the attribution shares must sum to ~1.0."""
+        assert set(block) == {
+            "dump_s", "bundle_bytes", "incident_id", "trigger",
+            "ring_steps", "ring_seconds", "trace_events",
+            "record_step_cost_s", "record_overhead_frac", "attribution",
+        }
+        assert 0 < block["dump_s"] < 30
+        assert block["bundle_bytes"] > 1000
+        assert block["trigger"] == "manual"
+        assert block["incident_id"].endswith("-manual")
+        # the ring held every step of the timed loop (pre-trigger data)
+        assert block["ring_steps"] == steps
+        assert block["ring_seconds"] >= 0
+        assert block["trace_events"] > 0
+        # the ≤2% steady-state recorder-overhead acceptance bound
+        assert block["record_overhead_frac"] is not None
+        assert 0 <= block["record_overhead_frac"] <= 0.02
+        attr = block["attribution"]
+        assert attr is not None
+        assert attr["steps"] >= 1
+        assert set(attr["shares"]) == {
+            "data_wait", "host_dispatch", "compute", "collective",
+        }
+        # the attribution acceptance bound: shares sum to 1.0 ± 0.05
+        assert abs(attr["share_sum"] - 1.0) <= 0.05
+
+    @staticmethod
     def _validate_audit_block(block):
         """The schema-pinned `audit` block (ISSUE 10): the static-
         analysis layer run against the bench's own train-step program.
@@ -637,7 +675,7 @@ class TestTelemetryBlock:
         """--scan K: the fused K-step loop runs and the scan block
         carries both gap fractions (its own scan-1 baseline rides the
         same line, so the win is a tracked number)."""
-        from tpu_syncbn.obs import telemetry, tracing
+        from tpu_syncbn.obs import flightrec, telemetry, tracing
 
         bench = _load_bench()
         monkeypatch.setenv("TPU_SYNCBN_FORCE_CPU", "1")
@@ -649,6 +687,9 @@ class TestTelemetryBlock:
         finally:
             telemetry.set_enabled(None)
             telemetry.REGISTRY.reset()
+            rec = flightrec.uninstall()
+            if rec is not None:
+                rec.close()
             tracing.uninstall()
         line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
         self._validate_scan_block(line["scan"], k=2)
@@ -761,7 +802,7 @@ class TestServeBlock:
     def test_serve_flag_emits_block_and_line_stays_last(
         self, tmp_path, monkeypatch, capsys
     ):
-        from tpu_syncbn.obs import telemetry, tracing
+        from tpu_syncbn.obs import flightrec, telemetry, tracing
 
         bench = _load_bench()
         monkeypatch.setenv("TPU_SYNCBN_FORCE_CPU", "1")
@@ -773,6 +814,9 @@ class TestServeBlock:
         finally:
             telemetry.set_enabled(None)
             telemetry.REGISTRY.reset()
+            rec = flightrec.uninstall()
+            if rec is not None:
+                rec.close()
             tracing.uninstall()
         out_lines = capsys.readouterr().out.strip().splitlines()
         # the JSON result line remains the last stdout line (drivers

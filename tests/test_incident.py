@@ -1,0 +1,857 @@
+"""Flight recorder + incident bundles (ISSUE 11).
+
+The acceptance regime: every wired trigger — SLO alert, divergence
+restore, watchdog stall, circuit open, manual ``POST /incidentz`` —
+yields exactly ONE schema-valid bundle holding pre-trigger ring data
+and a Perfetto-loadable trace slice; rings evict under sustained load;
+two-host bundles merge through the existing ``merge_exports`` path;
+the disabled path (no recorder installed) allocates nothing; the
+``/statusz`` page is golden-text-pinned like ``/metrics``; the
+batcher's Perfetto flow events pair enqueue spans with batch spans;
+and the metric-name drift gate keeps runtime, docs, and srclint
+vocabulary from silently diverging.
+"""
+
+import glob
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpu_syncbn.obs import (
+    flightrec,
+    incident,
+    server as obs_server,
+    slo as obs_slo,
+    telemetry,
+    timeseries,
+    tracing,
+)
+from tpu_syncbn.runtime import resilience
+
+pytestmark = pytest.mark.incident
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_incident_state():
+    """Every test starts and ends with no recorder, no tracer, an empty
+    registry, and no attached SLO trackers / readiness hooks."""
+    def reset():
+        telemetry.set_enabled(None)
+        telemetry.REGISTRY.reset()
+        rec = flightrec.uninstall()
+        if rec is not None:
+            rec.close()
+        tracing.uninstall()
+        obs_server.HEARTBEATS.clear()
+        with obs_server._readiness_lock:
+            obs_server._readiness.clear()
+        with obs_slo._attached_lock:
+            obs_slo._attached.clear()
+        obs_server.stop_env_server()
+
+    reset()
+    yield
+    reset()
+
+
+def _install(tmp_path, **kw) -> flightrec.FlightRecorder:
+    kw.setdefault("incident_dir", str(tmp_path / "incidents"))
+    kw.setdefault("cooldown_s", 0.0)
+    return flightrec.install(flightrec.FlightRecorder(**kw))
+
+
+def _bundles(rec) -> list[str]:
+    return sorted(glob.glob(os.path.join(rec.incident_dir,
+                                         "incident_*.json")))
+
+
+def _assert_one_valid_bundle(rec, kind, *, min_ring_steps=0):
+    """The trigger-matrix contract: exactly one bundle, schema-valid,
+    with a loadable trace slice and the pre-trigger ring data."""
+    paths = _bundles(rec)
+    assert len(paths) == 1, f"expected 1 bundle for {kind}, got {paths}"
+    bundle = incident.load_bundle(paths[0])  # schema gate
+    assert bundle["trigger"]["kind"] == kind
+    tracing.validate_trace(bundle["trace"]["traceEvents"])
+    assert len(bundle["rings"]["steps"]) >= min_ring_steps
+    telemetry.validate_snapshot(bundle["registry"])
+    telemetry.validate_snapshot(bundle["windows"])
+    return bundle
+
+
+# ------------------------------------------------------------------ rings
+
+
+class TestRings:
+    def test_step_ring_evicts_under_sustained_load(self, tmp_path):
+        rec = _install(tmp_path, step_capacity=4)
+        for i in range(10):
+            flightrec.record_step(i, metrics={"loss": float(i)})
+        rings = rec.rings_snapshot()
+        assert len(rings["steps"]) == 4
+        assert [e["step"] for e in rings["steps"]] == [6, 7, 8, 9]
+
+    def test_serve_ring_evicts_and_keeps_kind(self, tmp_path):
+        rec = _install(tmp_path, serve_capacity=3)
+        for i in range(7):
+            flightrec.record_serve("shed", rid=i)
+        rings = rec.rings_snapshot()
+        assert len(rings["serve"]) == 3
+        assert all(e["kind"] == "shed" for e in rings["serve"])
+        assert [e["rid"] for e in rings["serve"]] == [4, 5, 6]
+
+    def test_device_scalars_stay_async_until_dump(self, tmp_path):
+        """record_step keeps the raw (possibly device) values; the dump
+        converts to JSON-safe floats and stringifies non-finites."""
+        import jax.numpy as jnp
+
+        rec = _install(tmp_path)
+        flightrec.record_step(1, metrics={"loss": jnp.float32(0.25)},
+                              monitors={"grad_norm": jnp.float32(jnp.inf),
+                                        "bad": object()})
+        entry = rec.rings_snapshot()["steps"][0]
+        assert entry["metrics"]["loss"] == 0.25
+        assert entry["monitors"]["grad_norm"] == "inf"
+        assert "bad" not in entry["monitors"]  # unconvertible: dropped
+        json.dumps(entry)  # strict-JSON safe
+
+    def test_span_ring_is_bounded(self):
+        t = tracing.RingTracer(capacity=5)
+        for i in range(12):
+            with t.span(f"s{i}"):
+                pass
+        events = t.recent_events()
+        assert len(events) == 5
+        assert events[-1]["name"] == "s11"
+
+    def test_recorder_taps_existing_tracer_instead_of_replacing(
+        self, tmp_path
+    ):
+        mine = tracing.install()
+        rec = _install(tmp_path)
+        assert tracing.get() is mine
+        rec.close()
+        assert tracing.get() is mine  # close only removes its OWN tracer
+
+
+# --------------------------------------------------------- disabled path
+
+
+class TestDisabledPath:
+    def test_helpers_no_op_without_recorder(self):
+        assert flightrec.get() is None
+        flightrec.record_step(1, metrics={"loss": 1.0})
+        flightrec.record_serve("shed")
+        assert flightrec.trigger("manual", force=True) is None
+        assert len(telemetry.REGISTRY) == 0
+
+    def test_disabled_zero_allocation_guard(self):
+        """The hot-path contract (the telemetry discipline): with no
+        recorder installed, record_step is one global load + a None
+        test — bounded here at 200k no-op calls well under a second
+        (a regression that allocates or locks is an order of magnitude
+        slower)."""
+        assert flightrec.get() is None
+        t0 = time.perf_counter()
+        for _ in range(200_000):
+            flightrec.record_step(1)
+            flightrec.record_serve("shed")
+        dt = time.perf_counter() - t0
+        assert len(telemetry.REGISTRY) == 0
+        assert dt < 2.0, f"disabled-path record took {dt:.2f}s for 200k"
+
+    def test_env_gate_off_means_no_install(self, monkeypatch):
+        monkeypatch.delenv("TPU_SYNCBN_FLIGHTREC", raising=False)
+        assert flightrec.install_from_env() is None
+
+    def test_env_gate_on_installs_once(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("TPU_SYNCBN_FLIGHTREC", "1")
+        monkeypatch.setenv("TPU_SYNCBN_INCIDENT_DIR",
+                           str(tmp_path / "inc"))
+        rec = flightrec.install_from_env()
+        assert rec is not None
+        assert flightrec.install_from_env() is rec  # idempotent
+        assert rec.incident_dir == str(tmp_path / "inc")
+
+
+# -------------------------------------------------------- trigger matrix
+
+
+class _StubTrainer:
+    """Minimal state_dict/load_state_dict surface for the divergence
+    path (the ResilientLoop contract)."""
+
+    def __init__(self):
+        self.state = {"w": np.zeros(2, np.float32)}
+        self.loads = 0
+
+    def state_dict(self):
+        return self.state
+
+    def load_state_dict(self, state):
+        self.state = state
+        self.loads += 1
+
+
+class TestTriggerMatrix:
+    """Each wired trigger yields exactly one schema-valid bundle with
+    pre-trigger ring data (the ISSUE 11 acceptance matrix)."""
+
+    def _prefill(self, n=3):
+        for i in range(n):
+            flightrec.record_step(i + 1, metrics={"loss": 0.1})
+
+    def test_manual_via_incidentz_endpoint(self, tmp_path):
+        rec = _install(tmp_path)
+        self._prefill()
+        with obs_server.MonitoringServer(port=0, host="127.0.0.1") as srv:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/incidentz", data=b"",
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                doc = json.loads(resp.read())
+        assert doc["ok"] is True
+        bundle = _assert_one_valid_bundle(rec, "manual", min_ring_steps=3)
+        assert doc["incident_id"] == bundle["incident_id"]
+        assert bundle["trigger"]["detail"]["source"] == "http"
+
+    def test_incidentz_without_recorder_503s(self):
+        with obs_server.MonitoringServer(port=0, host="127.0.0.1") as srv:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/incidentz", data=b"",
+                method="POST",
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.code == 503
+
+    def test_slo_alert_fire_dumps_bundle(self, tmp_path):
+        telemetry.set_enabled(True)
+        rec = _install(tmp_path)
+        self._prefill()
+        agg = timeseries.WindowedAggregator()
+        agg.tick(now=0.0)
+        for _ in range(20):
+            telemetry.observe("serve.latency_s", 1.0)
+        agg.tick(now=1.0)
+        tracker = obs_slo.SLOTracker(agg, [obs_slo.AlertRule(
+            "lat", "serve.latency_s p90 < 0.1", windows_s=(10.0,),
+        )])
+        out = tracker.evaluate(now=1.0)
+        assert out["lat"]["firing"] is True
+        bundle = _assert_one_valid_bundle(rec, "slo_alert",
+                                          min_ring_steps=3)
+        assert bundle["trigger"]["detail"]["rule"] == "lat"
+        assert bundle["trigger"]["detail"]["burn"] > 2.0
+        # a second evaluation of the still-firing rule does NOT re-dump
+        # (fire-edge triggered, not level-triggered)
+        tracker.evaluate(now=1.0)
+        assert len(_bundles(rec)) == 1
+
+    def test_divergence_restore_dumps_bundle(self, tmp_path):
+        from tpu_syncbn.utils import checkpoint as ckpt
+
+        rec = _install(tmp_path)
+        self._prefill()
+        trainer = _StubTrainer()
+        ckpt_dir = str(tmp_path / "ckpt")
+        ckpt.save_checkpoint(ckpt_dir, 3,
+                             {"w": np.ones(2, np.float32)})
+        loop = resilience.ResilientLoop(trainer, ckpt_dir)
+        loop.step = 7
+        loop._restore_last_good()
+        assert loop.step == 3 and trainer.loads == 1
+        bundle = _assert_one_valid_bundle(rec, "divergence_restore",
+                                          min_ring_steps=3)
+        assert bundle["trigger"]["detail"]["step"] == 7
+        assert bundle["trigger"]["detail"]["restored_step"] == 3
+
+    def test_watchdog_stall_dumps_bundle(self, tmp_path):
+        rec = _install(tmp_path)
+        self._prefill()
+        with resilience.Watchdog(0.05, name="t-stall", poll_s=0.01):
+            deadline = time.monotonic() + 5.0
+            while not _bundles(rec) and time.monotonic() < deadline:
+                time.sleep(0.02)
+        bundle = _assert_one_valid_bundle(rec, "watchdog_stall",
+                                          min_ring_steps=3)
+        assert bundle["trigger"]["detail"]["watchdog"] == "t-stall"
+
+    def test_circuit_open_dumps_bundle(self, tmp_path):
+        from tpu_syncbn.serve.admission import CircuitBreaker
+
+        rec = _install(tmp_path)
+        self._prefill()
+        breaker = CircuitBreaker(failure_threshold=2)
+        assert breaker.record_failure() is False
+        assert breaker.record_failure() is True
+        bundle = _assert_one_valid_bundle(rec, "circuit_open",
+                                          min_ring_steps=3)
+        assert bundle["trigger"]["detail"]["breaker"] \
+            == "serve.circuit_state"
+        # the breaker transitions also landed in the serve ring
+        kinds = [e["kind"] for e in bundle["rings"]["serve"]]
+        assert "circuit_state" in kinds
+
+    def test_manual_via_signal(self, tmp_path):
+        """kill -USR2: the no-HTTP manual trigger (opt-in handler)."""
+        import signal
+
+        rec = _install(tmp_path)
+        self._prefill()
+        prev = flightrec.install_signal_trigger(signal.SIGUSR2)
+        try:
+            os.kill(os.getpid(), signal.SIGUSR2)
+            deadline = time.monotonic() + 5.0
+            while not _bundles(rec) and time.monotonic() < deadline:
+                time.sleep(0.02)
+        finally:
+            signal.signal(signal.SIGUSR2, prev)
+        bundle = _assert_one_valid_bundle(rec, "manual", min_ring_steps=3)
+        assert bundle["trigger"]["detail"]["source"] == "signal"
+
+    def test_bundle_carries_state_and_contract_fingerprint(self, tmp_path):
+        rec = _install(tmp_path)
+        obs_server.HEARTBEATS.beat("train")
+        obs_server.register_readiness("t", lambda: (True, {"x": 1}))
+        rec.trigger("manual", force=True)
+        bundle = _assert_one_valid_bundle(rec, "manual")
+        assert "train" in bundle["state"]["heartbeat_age_s"]
+        assert bundle["state"]["readiness"]["checks"]["t"]["ok"] is True
+        fp = bundle["contract"]["fingerprint"]
+        # the repo's golden contracts exist, so the fingerprint resolves
+        assert fp is not None and fp["programs"] >= 10
+        assert bundle["config"]["env"].keys() >= set()
+
+
+class TestTriggerDiscipline:
+    def test_cooldown_suppresses_rapid_retrigger(self, tmp_path):
+        rec = _install(tmp_path, cooldown_s=60.0)
+        assert rec.trigger("manual") is not None
+        assert rec.trigger("manual") is None  # cooled down
+        assert rec.trigger("manual", force=True) is not None  # bypass
+        assert len(_bundles(rec)) == 2
+        assert rec.counters.count("suppressed") == 1
+
+    def test_reentrant_trigger_drops_instead_of_deadlocking(self, tmp_path):
+        """A readiness hook that itself fires the trigger (the SLO-hook-
+        during-dump shape) must be dropped by the non-blocking trigger
+        lock, not recurse or deadlock."""
+        rec = _install(tmp_path)
+
+        def evil_hook():
+            flightrec.trigger("manual", force=True)
+            return True, {}
+
+        obs_server.register_readiness("evil", evil_hook)
+        path = rec.trigger("manual", force=True)
+        assert path is not None
+        assert len(_bundles(rec)) == 1
+        assert rec.counters.count("suppressed") == 1
+
+    def test_max_bundles_prunes_oldest(self, tmp_path):
+        rec = _install(tmp_path, max_bundles=2)
+        paths = [rec.trigger("manual", force=True) for _ in range(4)]
+        assert all(p is not None for p in paths)
+        kept = _bundles(rec)
+        assert len(kept) == 2
+
+    def test_dump_failure_never_raises(self, tmp_path, monkeypatch):
+        rec = _install(tmp_path)
+        monkeypatch.setattr(incident, "build_bundle",
+                            lambda *a, **k: 1 / 0)
+        assert rec.trigger("manual", force=True) is None
+        assert rec.counters.count("errors") == 1
+
+    def test_failed_dump_does_not_consume_cooldown(
+        self, tmp_path, monkeypatch
+    ):
+        """A transient write failure must not silence the NEXT trigger
+        for the same incident: the cooldown is only spent by a dump
+        that actually produced a bundle."""
+        rec = _install(tmp_path, cooldown_s=3600.0)
+        real = incident.build_bundle
+        monkeypatch.setattr(incident, "build_bundle",
+                            lambda *a, **k: 1 / 0)
+        assert rec.trigger("circuit_open") is None  # failed, not cooled
+        monkeypatch.setattr(incident, "build_bundle", real)
+        assert rec.trigger("circuit_open") is not None  # retry lands
+        assert len(_bundles(rec)) == 1
+
+    def test_unsettled_device_value_reads_pending_not_blocking(self):
+        """float() on a device array blocks until its computation
+        settles — on a hung collective (the watchdog_stall trigger)
+        that would wedge the dump forever. The non-blocking is_ready
+        probe must short-circuit it."""
+        class Hung:
+            def is_ready(self):
+                return False
+
+            def __float__(self):  # the dump must never reach this
+                raise AssertionError("blocking fetch on a hung value")
+
+        assert flightrec._scalarize(Hung()) == "pending"
+
+
+# ------------------------------------------------------------ 2-host merge
+
+
+class TestBundleMerge:
+    def test_two_host_bundles_merge_through_merge_exports(self, tmp_path):
+        telemetry.set_enabled(True)
+        rec = _install(tmp_path)
+        telemetry.count("serve.requests", 5)
+        telemetry.observe("step.time_s", 0.1)
+        rec.trigger("manual", force=True)
+        path0 = _bundles(rec)[0]
+        with open(path0) as f:
+            b0 = json.load(f)
+        # host 1's bundle: same shape, different identity (the per-host
+        # files a rank-0 merge consumes)
+        b1 = json.loads(json.dumps(b0))
+        b1["host"] = 1
+        b1["incident_id"] = b0["incident_id"] + "-h1"
+        path1 = str(tmp_path / "h1.json")
+        with open(path1, "w") as f:
+            json.dump(b1, f)
+        out = str(tmp_path / "merged.json")
+        merged = incident.merge_bundles([path0, path1], out)
+        assert merged["hosts"] == [0, 1]
+        assert len(merged["incident_ids"]) == 2
+        # counters and histogram vectors SUM across hosts — the
+        # merge_exports semantics, not a second schema
+        assert merged["registry"]["counters"]["serve.requests"] == 10
+        assert merged["registry"]["histograms"]["step.time_s"]["count"] == 2
+        assert os.path.exists(out)
+
+    def test_merge_rejects_invalid_bundle(self, tmp_path):
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            json.dump({"schema": 99}, f)
+        with pytest.raises(ValueError, match="schema"):
+            incident.merge_bundles([bad])
+
+
+# ------------------------------------------------------------ attribution
+
+
+def _synthetic_bundle(*, dispatch_s, data_wait_s, covered_s, steps,
+                      flops_per_step=None, bytes_per_step=None):
+    """Minimal valid bundle with known timing histograms — the
+    attribution math's ground truth."""
+    def hist(total, count):
+        return {"buckets": [60.0], "counts": [count, 0], "count": count,
+                "sum": total, "min": None, "max": None}
+
+    windows = {
+        "schema": telemetry.SCHEMA_VERSION,
+        "counters": {}, "gauges": {},
+        "histograms": {
+            "step.time_s": hist(dispatch_s, steps),
+            "step.data_wait_s": hist(data_wait_s, steps),
+        },
+        "window": {"covered_s": covered_s, "frames": 1, "interval_s": 1.0},
+    }
+    return {
+        "schema": incident.BUNDLE_SCHEMA,
+        "kind": incident.BUNDLE_KIND,
+        "incident_id": "t-0", "host": 0, "wall_time": 0.0,
+        "trigger": {"kind": "manual", "detail": {}},
+        "config": {"env": {}, "argv": []},
+        "contract": {
+            "flops_per_step": flops_per_step,
+            "collective_bytes_per_step": bytes_per_step,
+        },
+        "registry": {"schema": telemetry.SCHEMA_VERSION, "counters": {},
+                     "gauges": {}, "histograms": {}},
+        "windows": windows,
+        "rings": {"steps": [], "serve": []},
+        "trace": {"traceEvents": []},
+        "state": {"heartbeat_age_s": {}, "readiness": {"ok": True}},
+    }
+
+
+class TestAttribution:
+    def test_shares_sum_to_one_and_split_by_contract(self):
+        """10s wall: 2s data wait, 6s in-dispatch, 2s other host time.
+        Contract: flops and bytes chosen so the static cost model splits
+        the in-dispatch time 50/50 compute vs collective."""
+        bundle = _synthetic_bundle(
+            dispatch_s=6.0, data_wait_s=2.0, covered_s=10.0, steps=3,
+            flops_per_step=incident.DEFAULT_FLOP_RATE,      # 1s/step est
+            bytes_per_step=incident.DEFAULT_WIRE_RATE,      # 1s/step est
+        )
+        attr = incident.attribution(bundle)
+        assert attr["share_sum"] == pytest.approx(1.0, abs=1e-6)
+        assert attr["shares"]["data_wait"] == pytest.approx(0.2)
+        assert attr["shares"]["host_dispatch"] == pytest.approx(0.2)
+        assert attr["shares"]["compute"] == pytest.approx(0.3)
+        assert attr["shares"]["collective"] == pytest.approx(0.3)
+        assert attr["steps"] == 3
+        assert attr["split"] == "cost_model"
+        assert attr["inputs"]["bytes_source"] == "contract.bytes_per_step"
+
+    def test_no_contract_means_all_dispatch_is_compute(self):
+        bundle = _synthetic_bundle(dispatch_s=6.0, data_wait_s=2.0,
+                                   covered_s=10.0, steps=3)
+        attr = incident.attribution(bundle)
+        assert attr["split"] == "no_collectives"
+        assert attr["shares"]["collective"] == 0.0
+        assert attr["shares"]["compute"] == pytest.approx(0.6)
+        assert attr["share_sum"] == pytest.approx(1.0, abs=1e-6)
+
+    def test_bytes_without_flops_declines_the_split(self):
+        """Bytes-on-wire alone would claim ALL in-dispatch time as
+        collective — overstating; without a flops estimate the split
+        must decline and say so."""
+        bundle = _synthetic_bundle(dispatch_s=6.0, data_wait_s=2.0,
+                                   covered_s=10.0, steps=3,
+                                   bytes_per_step=1e9)
+        attr = incident.attribution(bundle)
+        assert attr["split"] == "unattributed"
+        assert attr["shares"]["collective"] == 0.0
+        assert attr["share_sum"] == pytest.approx(1.0, abs=1e-6)
+
+    def test_seam_sums_beyond_window_normalize_to_one(self):
+        """A registry-sourced report (no covered window) still sums to
+        1.0 — the seams themselves become the wall."""
+        bundle = _synthetic_bundle(dispatch_s=6.0, data_wait_s=2.0,
+                                   covered_s=0.0, steps=3)
+        attr = incident.attribution(bundle)
+        assert attr["wall_s"] == pytest.approx(8.0)
+        assert attr["share_sum"] == pytest.approx(1.0, abs=1e-6)
+        assert attr["shares"]["host_dispatch"] == 0.0
+
+    def test_no_step_samples_returns_none(self):
+        bundle = _synthetic_bundle(dispatch_s=0.0, data_wait_s=0.0,
+                                   covered_s=0.0, steps=0)
+        assert incident.attribution(bundle) is None
+
+    def test_diff_names_the_component_that_moved(self):
+        a = incident.attribution(_synthetic_bundle(
+            dispatch_s=6.0, data_wait_s=2.0, covered_s=10.0, steps=3))
+        b = incident.attribution(_synthetic_bundle(
+            dispatch_s=2.0, data_wait_s=6.0, covered_s=10.0, steps=3))
+        d = incident.diff_attribution(a, b)
+        assert d["moved_most"] in ("data_wait", "compute")
+        assert d["deltas"]["data_wait"] == pytest.approx(0.4)
+
+    def test_inspect_and_diff_cli(self, tmp_path, capsys):
+        rec = _install(tmp_path)
+        telemetry.set_enabled(True)
+        telemetry.observe("step.time_s", 0.2)
+        p1 = rec.trigger("manual", force=True)
+        telemetry.observe("step.time_s", 0.3)
+        p2 = rec.trigger("manual", force=True)
+        assert incident.main(["inspect", p1]) == 0
+        out = capsys.readouterr().out
+        assert "explained step time" in out
+        assert incident.main(["diff", p1, p2, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert "attribution" in doc and "counter_movers" in doc
+
+    def test_cli_merge_subcommand(self, tmp_path, capsys):
+        rec = _install(tmp_path)
+        p = rec.trigger("manual", force=True)
+        out = str(tmp_path / "m.json")
+        assert incident.main(["merge", out, p]) == 0
+        assert os.path.exists(out)
+
+    def test_cli_unreadable_bundle_exits_1(self, tmp_path, capsys):
+        assert incident.main(["inspect",
+                              str(tmp_path / "nope.json")]) == 1
+
+
+# ---------------------------------------------------------------- statusz
+
+
+class TestStatusz:
+    def test_render_golden(self):
+        """The /statusz text is the operator's one-glance contract:
+        exact text for a known report (the /metrics golden-pin
+        discipline)."""
+        report = {
+            "train_step": 42.0,
+            "heartbeat_age_s": {"serve": 0.25, "train": 1.5},
+            "readiness": {
+                "ok": False,
+                "checks": {
+                    "serve": {"ok": False, "queue_depth": 9},
+                    "train": {"ok": True, "step": 42},
+                },
+            },
+            "alerts": {
+                "slo": {"serve_latency": {
+                    "firing": True, "fired_count": 2,
+                    "burns": {"60.0": 4.1},
+                }},
+            },
+            "circuits": {"serve.circuit_state": 2.0},
+            "program_caches": {"serve": {"hits": 4, "misses": 2}},
+            "last_incident": {
+                "id": "20260804T000000-h0-001-manual",
+                "trigger": "manual", "path": "/tmp/i.json",
+            },
+            "recorder_installed": True,
+        }
+        assert obs_server.render_statusz(report) == (
+            "tpu_syncbn statusz\n"
+            "==================\n"
+            "train step: 42\n"
+            "\n"
+            "heartbeats (age s)\n"
+            "  serve                0.25\n"
+            "  train                1.5\n"
+            "\n"
+            "readiness: NOT READY\n"
+            "  serve                FAIL {'queue_depth': 9}\n"
+            "  train                ok  {'step': 42}\n"
+            "\n"
+            "alerts\n"
+            "  slo/serve_latency        FIRING (fired 2x, "
+            "burns {'60.0': 4.1})\n"
+            "\n"
+            "circuit breakers\n"
+            "  serve.circuit_state          open (2)\n"
+            "\n"
+            "program caches\n"
+            "  serve    hits=4 misses=2\n"
+            "\n"
+            "last incident\n"
+            "  id=20260804T000000-h0-001-manual trigger=manual\n"
+            "  path=/tmp/i.json\n"
+        )
+
+    def test_render_empty_report(self):
+        text = obs_server.render_statusz({})
+        assert "(none registered)" in text
+        assert "(no SLO tracker attached)" in text
+        assert "set TPU_SYNCBN_FLIGHTREC=1" in text
+
+    def test_endpoint_serves_live_state(self, tmp_path):
+        telemetry.set_enabled(True)
+        rec = _install(tmp_path)
+        rec.trigger("manual", force=True)
+        obs_server.HEARTBEATS.beat("train")
+        with obs_server.MonitoringServer(port=0, host="127.0.0.1") as srv:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/statusz", timeout=10
+            ) as resp:
+                assert resp.status == 200
+                assert "text/plain" in resp.headers["Content-Type"]
+                text = resp.read().decode()
+        assert text.startswith("tpu_syncbn statusz")
+        assert "train" in text
+        assert rec.last_incident["id"] in text
+
+    def test_statusz_in_404_route_list(self):
+        with obs_server.MonitoringServer(
+            port=0, host="127.0.0.1", registry=telemetry.Registry()
+        ) as srv:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/nope", timeout=10)
+            doc = json.loads(e.value.read())
+        assert "/statusz" in doc["routes"]
+        assert "POST /incidentz" in doc["routes"]
+
+
+# ------------------------------------------------------------ flow events
+
+
+class TestFlowEvents:
+    def test_tracer_flow_events_validate(self):
+        t = tracing.Tracer()
+        with t.span("enqueue"):
+            t.flow_start("req", 7)
+        with t.span("batch"):
+            t.flow_end("req", 7)
+        events = tracing.validate_trace(t.events)
+        flows = [e for e in events if e["ph"] in ("s", "f")]
+        assert [e["ph"] for e in flows] == ["s", "f"]
+        assert all(e["id"] == 7 for e in flows)
+        assert flows[1]["bp"] == "e"  # binds to the enclosing slice
+
+    def test_batcher_links_enqueue_to_batch_span(self):
+        """The satellite contract: each request's enqueue span opens a
+        flow (id = request id) that terminates inside the serve.batch
+        span that answered it — batching latency is visually
+        attributable in Perfetto."""
+        from tests.test_serve import StubEngine
+        from tpu_syncbn import serve as serve_lib
+
+        tracer = tracing.install()
+        eng = StubEngine(bucket=4)
+        with serve_lib.DynamicBatcher(eng, max_batch=4, max_wait_ms=5,
+                                      breaker=False) as bat:
+            futs = [bat.submit(np.ones((1, 1), np.float32))
+                    for _ in range(3)]
+            for f in futs:
+                f.result(timeout=10)
+        events = tracing.validate_trace(tracer.events)
+        starts = {e["id"] for e in events if e["ph"] == "s"
+                  and e["name"] == "serve.request"}
+        ends = {e["id"] for e in events if e["ph"] == "f"
+                and e["name"] == "serve.request"}
+        assert len(starts) == 3
+        assert starts == ends  # every enqueue flow terminated
+        names = {e["name"] for e in events if e["ph"] == "X"}
+        assert {"serve.enqueue", "serve.batch"} <= names
+        # every flow id is a real request id carried by an enqueue span
+        enq_rids = {e["args"]["rid"] for e in events
+                    if e.get("name") == "serve.enqueue"}
+        assert starts == enq_rids
+
+    def test_no_tracer_means_no_flow_overhead(self):
+        from tests.test_serve import StubEngine
+        from tpu_syncbn import serve as serve_lib
+
+        assert tracing.get() is None
+        eng = StubEngine(bucket=4)
+        with serve_lib.DynamicBatcher(eng, max_batch=4, max_wait_ms=5,
+                                      breaker=False) as bat:
+            assert bat.submit(
+                np.ones((1, 1), np.float32)).result(timeout=10) is not None
+
+
+# ----------------------------------------------- metric-name drift gate
+
+
+#: Families whose members carry a dynamic token; each maps to the doc
+#: pattern that documents the family.
+_DYNAMIC_FAMILIES = (
+    (r"^slo\.[a-z0-9_]+\.burn_rate$", "slo.<rule>.burn_rate"),
+    (r"^serve\.circuit_state\.[a-z0-9_]+$", "serve.circuit_state.<key>"),
+    (r"^(train|gan|serve)\.program_cache\.(hits|misses|evictions)$",
+     ".program_cache."),
+    (r"^audit\.rule\.[a-z0-9_.]+$", "audit.rule.<rule_id>"),
+)
+
+
+class TestMetricNameDrift:
+    """ISSUE 11 satellite: every metric family the obs/serve/audit/
+    incident acceptance paths actually produce must appear in the
+    docs/OBSERVABILITY.md (or RESILIENCE.md) tables AND carry a
+    subsystem prefix srclint's KNOWN_METRIC_PREFIXES admits — so docs
+    and lint cannot silently diverge from runtime."""
+
+    def _produce(self, tmp_path):
+        """Exercise the subsystems' telemetry producers cheaply."""
+        from tests.test_serve import StubEngine
+        from tpu_syncbn import audit as audit_mod, serve as serve_lib
+        from tpu_syncbn.serve.admission import CircuitBreaker
+
+        telemetry.set_enabled(True)
+        # serve: a real batcher round trip + a breaker transition
+        eng = StubEngine(bucket=4)
+        with serve_lib.DynamicBatcher(eng, max_batch=4,
+                                      max_wait_ms=5) as bat:
+            bat.submit(np.ones((1, 1), np.float32)).result(timeout=10)
+        CircuitBreaker(failure_threshold=1, key="tenant_b"
+                       ).record_failure()
+        # obs/slo/monitor: server probes + one SLO evaluation
+        agg = timeseries.WindowedAggregator()
+        agg.tick(now=0.0)
+        telemetry.observe("step.time_s", 0.01)
+        agg.tick(now=1.0)
+        with obs_server.MonitoringServer(
+            port=0, host="127.0.0.1", aggregator=agg
+        ) as srv:
+            for route in ("/metrics", "/healthz", "/statusz"):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}{route}", timeout=10
+                ).read()
+        obs_slo.SLOTracker(agg, [obs_slo.AlertRule(
+            "drift_check", "step.time_s p99 < 60")]).evaluate(now=1.0)
+        # audit: the lint layer (pure ast — fast)
+        audit_mod.run_audit(contracts=False)
+        # incident: a forced bundle
+        _install(tmp_path).trigger("manual", force=True)
+
+    def test_produced_names_are_documented_and_lintable(self, tmp_path):
+        import re
+
+        from tpu_syncbn.audit.srclint import KNOWN_METRIC_PREFIXES
+
+        self._produce(tmp_path)
+        snap = telemetry.snapshot()
+        names = sorted(
+            set(snap["counters"]) | set(snap["gauges"])
+            | set(snap["histograms"])
+        )
+        assert len(names) >= 20  # the producers actually produced
+        docs = ""
+        for doc in ("docs/OBSERVABILITY.md", "docs/RESILIENCE.md"):
+            with open(os.path.join(ROOT, doc)) as f:
+                docs += f.read()
+        undocumented, unknown_prefix = [], []
+        for name in names:
+            if name.split(".", 1)[0] not in KNOWN_METRIC_PREFIXES:
+                unknown_prefix.append(name)
+            if name in docs:
+                continue
+            if any(re.match(pat, name) and marker in docs
+                   for pat, marker in _DYNAMIC_FAMILIES):
+                continue
+            # grouped table rows ("serve.requests / rejected / ..."):
+            # the family prefix and the member token both appear
+            family, _, tail = name.rpartition(".")
+            if family and f"{name.split('.', 1)[0]}." in docs \
+                    and tail in docs:
+                continue
+            undocumented.append(name)
+        assert not unknown_prefix, (
+            f"metric prefixes missing from KNOWN_METRIC_PREFIXES: "
+            f"{unknown_prefix}"
+        )
+        assert not undocumented, (
+            "metrics produced at runtime but absent from the docs "
+            f"tables: {undocumented} — document them in "
+            "docs/OBSERVABILITY.md (and extend the vocabulary "
+            "deliberately)"
+        )
+
+    def test_incident_counter_group_prefix_is_vocabulary(self):
+        from tpu_syncbn.audit.srclint import KNOWN_METRIC_PREFIXES
+
+        assert "incident" in KNOWN_METRIC_PREFIXES
+
+
+# ----------------------------------------------- audit CLI changed-only
+
+
+@pytest.mark.audit
+class TestChangedOnlyCoversObs:
+    """ISSUE 11 satellite: the audit CLI's --changed-only fast path
+    lints the new obs modules when they change, and correctly skips the
+    (slow) contract layer for an obs-only change — obs defines no
+    compiled programs."""
+
+    def test_changed_obs_modules_are_linted_without_contracts(
+        self, monkeypatch, capsys
+    ):
+        import tpu_syncbn
+        from tpu_syncbn.audit import __main__ as audit_cli
+
+        pkg = os.path.dirname(os.path.abspath(tpu_syncbn.__file__))
+        changed = [
+            os.path.join(pkg, "obs", "flightrec.py"),
+            os.path.join(pkg, "obs", "incident.py"),
+        ]
+        monkeypatch.setattr(audit_cli, "_changed_files",
+                            lambda ref, root: list(changed))
+        rc = audit_cli.main(["--changed-only", "HEAD", "--json"])
+        captured = capsys.readouterr()
+        assert rc == 0
+        report = json.loads(captured.out)
+        assert report["files_linted"] == 2
+        assert report["programs_checked"] == 0  # contract layer skipped
+        assert "skipping the contract layer" in captured.err

@@ -38,12 +38,19 @@ def _clean_monitor_state():
     empty registry, no tracer, no heartbeats, no readiness hooks, and
     no env-gated server."""
     def reset():
+        from tpu_syncbn.obs import flightrec, slo as obs_slo
+
         telemetry.set_enabled(None)
         telemetry.REGISTRY.reset()
         tracing.uninstall()
+        rec = flightrec.uninstall()
+        if rec is not None:
+            rec.close()
         obs_server.HEARTBEATS.clear()
         with obs_server._readiness_lock:
             obs_server._readiness.clear()
+        with obs_slo._attached_lock:
+            obs_slo._attached.clear()
         obs_server.stop_env_server()
 
     reset()

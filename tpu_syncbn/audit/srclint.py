@@ -43,8 +43,8 @@ PREFIX_RE = re.compile(r"^[a-z0-9_]+$")
 #: ``monitor.heartbeat_age_s`` — pinned in obs.server.MONITOR_METRICS).
 KNOWN_METRIC_PREFIXES = frozenset({
     "audit", "bench", "checkpoint", "collectives", "data", "events",
-    "gan", "loader", "monitor", "obs", "probe", "rendezvous",
-    "resilience", "scan", "serve", "slo", "step", "train",
+    "gan", "incident", "loader", "monitor", "obs", "probe",
+    "rendezvous", "resilience", "scan", "serve", "slo", "step", "train",
 })
 
 _SUPPRESS_RE = re.compile(r"#\s*audit:\s*ok(?:\[([a-z0-9_,\s]+)\])?")

@@ -22,12 +22,23 @@ Six modules, one budget rule — near-zero cost when off:
 * :mod:`tpu_syncbn.obs.slo` — declarative SLO objectives with
   multi-window error-budget burn-rate alert rules (hysteresis), feeding
   ``/readyz`` and the ``obs.alert.*`` counters.
+* :mod:`tpu_syncbn.obs.flightrec` — always-on flight recorder: bounded
+  rings of recent spans / windowed registry deltas / step monitors /
+  serve decisions, env-gated (``TPU_SYNCBN_FLIGHTREC``), dumped as an
+  incident bundle on an SLO alert, divergence restore, watchdog stall,
+  circuit open, or ``POST /incidentz``.
+* :mod:`tpu_syncbn.obs.incident` — incident-bundle schema, atomic
+  writer, rank-0 merge (through ``merge_exports``), and the
+  explained-step-time attribution report
+  (``python -m tpu_syncbn.obs.incident inspect|diff|merge``).
 
 See docs/OBSERVABILITY.md for knobs, schemas, the Perfetto how-to, and
 the live-monitoring quickstart.
 """
 
 from tpu_syncbn.obs import (  # noqa: F401
+    flightrec,
+    incident,
     server,
     slo,
     stepstats,
@@ -35,6 +46,7 @@ from tpu_syncbn.obs import (  # noqa: F401
     timeseries,
     tracing,
 )
+from tpu_syncbn.obs.flightrec import FlightRecorder  # noqa: F401
 from tpu_syncbn.obs.server import MONITOR_METRICS, MonitoringServer  # noqa: F401
 from tpu_syncbn.obs.slo import AlertRule, Availability, SLOTracker  # noqa: F401
 from tpu_syncbn.obs.telemetry import (  # noqa: F401
@@ -46,7 +58,7 @@ from tpu_syncbn.obs.telemetry import (  # noqa: F401
     Registry,
 )
 from tpu_syncbn.obs.timeseries import WindowedAggregator  # noqa: F401
-from tpu_syncbn.obs.tracing import Tracer  # noqa: F401
+from tpu_syncbn.obs.tracing import RingTracer, Tracer  # noqa: F401
 
 __all__ = [
     "telemetry",
@@ -55,6 +67,10 @@ __all__ = [
     "timeseries",
     "server",
     "slo",
+    "flightrec",
+    "incident",
+    "FlightRecorder",
+    "RingTracer",
     "REGISTRY",
     "Registry",
     "Counter",

@@ -184,6 +184,124 @@ def render_prometheus(snap: dict, *, namespace: str = "tpu_syncbn") -> str:
 
 
 # ---------------------------------------------------------------------------
+# /statusz: one human-readable page of process state
+
+
+def statusz_report(
+    *, registry: telemetry.Registry | None = None, now: float | None = None,
+) -> dict:
+    """Gather the ``/statusz`` inputs into one JSON-ready dict:
+    heartbeats, readiness checks, attached SLO alert state, circuit-
+    breaker gauges, program-cache counters, and the last incident. The
+    rendering (:func:`render_statusz`) is a pure function of this dict,
+    so the page text is golden-pinnable like ``/metrics``."""
+    from tpu_syncbn.obs import flightrec, slo as obs_slo
+
+    reg = registry if registry is not None else telemetry.REGISTRY
+    snap = reg.snapshot()
+    ready_ok, checks = evaluate_readiness()
+    circuits = {
+        name: value for name, value in snap["gauges"].items()
+        if name == "serve.circuit_state"
+        or name.startswith("serve.circuit_state.")
+    }
+    caches: dict[str, dict] = {}
+    for name, value in snap["counters"].items():
+        family, sep, field = name.partition(".program_cache.")
+        if sep:
+            caches.setdefault(family, {})[field] = value
+    rec = flightrec.get()
+    return {
+        "heartbeat_age_s": {
+            n: round(a, 3) for n, a in sorted(HEARTBEATS.ages(now).items())
+        },
+        "readiness": {"ok": ready_ok, "checks": checks},
+        "alerts": obs_slo.tracker_states(),
+        "circuits": circuits,
+        "program_caches": caches,
+        "train_step": snap["gauges"].get("train.step"),
+        "last_incident": rec.last_incident if rec is not None else None,
+        "recorder_installed": rec is not None,
+    }
+
+
+_CIRCUIT_NAMES = {0: "closed", 1: "half_open", 2: "open"}
+
+
+def render_statusz(report: dict) -> str:
+    """Render a :func:`statusz_report` dict as the ``/statusz`` text
+    page — deterministic for a given report (sorted keys, fixed layout),
+    golden-text-pinned by tests/test_incident.py the way ``/metrics``
+    exposition is by tests/test_monitor.py."""
+    lines = ["tpu_syncbn statusz", "=================="]
+    step = report.get("train_step")
+    if step is not None:
+        lines.append(f"train step: {step:g}")
+    lines.append("")
+    lines.append("heartbeats (age s)")
+    hb = report.get("heartbeat_age_s") or {}
+    if hb:
+        for name, age in sorted(hb.items()):
+            lines.append(f"  {name:<20} {age:g}")
+    else:
+        lines.append("  (none registered)")
+    lines.append("")
+    ready = report.get("readiness") or {}
+    lines.append(
+        "readiness: " + ("ok" if ready.get("ok") else "NOT READY")
+    )
+    for name, check in sorted((ready.get("checks") or {}).items()):
+        verdict = "ok " if check.get("ok") else "FAIL"
+        detail = {k: v for k, v in check.items() if k != "ok"}
+        lines.append(f"  {name:<20} {verdict} {detail}")
+    lines.append("")
+    lines.append("alerts")
+    alerts = report.get("alerts") or {}
+    if alerts:
+        for tracker, rules in sorted(alerts.items()):
+            for rule, st in sorted(rules.items()):
+                state = "FIRING" if st.get("firing") else "quiet"
+                lines.append(
+                    f"  {tracker}/{rule:<20} {state} "
+                    f"(fired {st.get('fired_count', 0)}x, "
+                    f"burns {st.get('burns', {})})"
+                )
+    else:
+        lines.append("  (no SLO tracker attached)")
+    lines.append("")
+    lines.append("circuit breakers")
+    circuits = report.get("circuits") or {}
+    if circuits:
+        for name, code in sorted(circuits.items()):
+            state = _CIRCUIT_NAMES.get(int(code), f"?{code}")
+            lines.append(f"  {name:<28} {state} ({int(code)})")
+    else:
+        lines.append("  (none)")
+    lines.append("")
+    lines.append("program caches")
+    caches = report.get("program_caches") or {}
+    if caches:
+        for family, fields in sorted(caches.items()):
+            stats = " ".join(
+                f"{k}={fields[k]}" for k in sorted(fields)
+            )
+            lines.append(f"  {family:<8} {stats}")
+    else:
+        lines.append("  (none)")
+    lines.append("")
+    lines.append("last incident")
+    inc = report.get("last_incident")
+    if inc:
+        lines.append(f"  id={inc.get('id')} trigger={inc.get('trigger')}")
+        lines.append(f"  path={inc.get('path')}")
+    elif report.get("recorder_installed"):
+        lines.append("  (recorder armed, no incident yet)")
+    else:
+        lines.append("  (no flight recorder — set TPU_SYNCBN_FLIGHTREC=1)")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
 # the server
 
 
@@ -228,10 +346,50 @@ class _Handler(BaseHTTPRequestHandler):
             ok, checks = evaluate_readiness()
             self._send_json(200 if ok else 503,
                             {"ok": ok, "checks": checks})
+        elif path == "/statusz":
+            body = render_statusz(
+                statusz_report(registry=mon.registry)
+            ).encode()
+            self._send(200, body, "text/plain; charset=utf-8")
         else:
             self._send_json(404, {"error": f"no route {path!r}",
                                   "routes": ["/metrics", "/healthz",
-                                             "/readyz"]})
+                                             "/readyz", "/statusz",
+                                             "POST /incidentz"]})
+
+    def do_POST(self):  # noqa: N802 (http.server API)
+        from tpu_syncbn.obs import flightrec
+
+        telemetry.count("obs.server.requests")
+        path = self.path.split("?", 1)[0]
+        if path != "/incidentz":
+            self._send_json(404, {"error": f"no POST route {path!r}",
+                                  "routes": ["POST /incidentz"]})
+            return
+        rec = flightrec.get()
+        if rec is None:
+            self._send_json(503, {
+                "ok": False,
+                "error": "no flight recorder installed — set "
+                         "TPU_SYNCBN_FLIGHTREC=1 (docs/OBSERVABILITY.md)",
+            })
+            return
+        bundle_path = rec.trigger(
+            "manual", {"source": "http", "client": self.client_address[0]},
+            force=True,
+        )
+        if bundle_path is None:
+            self._send_json(503, {
+                "ok": False,
+                "error": "trigger suppressed or dump failed "
+                         "(a dump may already be in flight)",
+            })
+            return
+        self._send_json(200, {
+            "ok": True,
+            "incident_id": (rec.last_incident or {}).get("id"),
+            "path": bundle_path,
+        })
 
 
 class MonitoringServer:

@@ -201,6 +201,20 @@ def serve_overload_rules(
     ]
 
 
+# module registry of attached trackers: /statusz and incident bundles
+# read every attached tracker's alert state through tracker_states()
+_attached_lock = threading.Lock()
+_attached: dict[str, "SLOTracker"] = {}
+
+
+def tracker_states() -> dict[str, dict]:
+    """Alert state of every attached tracker, keyed by its readiness-
+    hook name — what ``/statusz`` renders and incident bundles embed."""
+    with _attached_lock:
+        items = list(_attached.items())
+    return {name: tracker.state() for name, tracker in items}
+
+
 @dataclasses.dataclass
 class AlertRule:
     """Fire when the error-budget burn rate exceeds ``burn_threshold``
@@ -281,6 +295,7 @@ class SLOTracker:
         is not in violation, and a rule can only fire on evidence."""
         telemetry.count("slo.evaluations")
         out: dict[str, dict] = {}
+        fired: list[tuple[str, float, str]] = []
         for rule in self.rules:
             burns = {w: self._burn(rule, w, now) for w in rule.windows_s}
             known = [b for b in burns.values() if b is not None]
@@ -298,6 +313,8 @@ class SLOTracker:
                     st.clear_streak = 0
                     st.fired_count += 1
                     telemetry.count("obs.alert.fired")
+                    fired.append((rule.name, round(worst, 4),
+                                  rule.objective.describe()))
                     tracing.instant(
                         "slo_alert_fired", rule=rule.name,
                         objective=rule.objective.describe(),
@@ -330,6 +347,17 @@ class SLOTracker:
                           for w, b in burns.items()},
                 "objective": rule.objective.describe(),
             }
+        if fired:
+            # incident capture OUTSIDE the tracker lock: the dump's
+            # readiness probe re-enters evaluate(), which must not
+            # deadlock on self._lock (the recorder's non-blocking
+            # trigger lock drops the re-entrant trigger itself)
+            from tpu_syncbn.obs import flightrec
+
+            for name, burn, objective in fired:
+                flightrec.trigger("slo_alert", {
+                    "rule": name, "burn": burn, "objective": objective,
+                })
         return out
 
     def _logger(self):
@@ -365,8 +393,10 @@ class SLOTracker:
     def attach(self, name: str = "slo"):
         """Register this tracker as a ``/readyz`` hook: each probe
         re-evaluates the rules and reports firing alerts as not-ready.
-        Returns ``self``; detach with
-        :func:`tpu_syncbn.obs.server.unregister_readiness`."""
+        Also lists the tracker in the module registry
+        (:func:`tracker_states`) so ``/statusz`` and incident bundles
+        see its alert state. Returns ``self``; :meth:`detach` undoes
+        both."""
         from tpu_syncbn.obs import server as obs_server
 
         def hook() -> tuple[bool, dict]:
@@ -375,4 +405,20 @@ class SLOTracker:
             return not firing, {"firing": firing}
 
         obs_server.register_readiness(name, hook)
+        with _attached_lock:
+            _attached[name] = self
+        self._attached_name = name
         return self
+
+    def detach(self, name: str | None = None) -> None:
+        """Unregister the readiness hook and drop the tracker from the
+        module registry (``name`` defaults to the one :meth:`attach`
+        used)."""
+        from tpu_syncbn.obs import server as obs_server
+
+        name = name if name is not None \
+            else getattr(self, "_attached_name", "slo")
+        obs_server.unregister_readiness(name)
+        with _attached_lock:
+            if _attached.get(name) is self:
+                _attached.pop(name, None)

@@ -31,6 +31,7 @@ import json
 import os
 import threading
 import time
+from collections import deque
 from typing import Any
 
 _NULL = contextlib.nullcontext()
@@ -130,6 +131,47 @@ class Tracer:
             "args": dict(args),
         })
 
+    def _flow(self, ph: str, name: str, flow_id: int, extra: dict,
+              args: dict) -> None:
+        event = {
+            "name": name,
+            "ph": ph,
+            "id": int(flow_id),
+            "ts": round(self._now_us(), 3),
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "cat": "tpu_syncbn",
+            "args": dict(args),
+        }
+        event.update(extra)
+        self._emit(event)
+
+    def flow_start(self, name: str, flow_id: int, **args) -> None:
+        """Open a flow arrow (``ph: "s"``): Perfetto draws an arrow from
+        the slice enclosing this timestamp on this thread to wherever the
+        matching :meth:`flow_end` lands (same ``name`` + ``flow_id``).
+        The serving stack uses request ids as flow ids, so a request's
+        enqueue span and the batch span that eventually answered it are
+        visually linked in the trace."""
+        self._flow("s", name, flow_id, {}, args)
+
+    def flow_end(self, name: str, flow_id: int, **args) -> None:
+        """Close a flow arrow (``ph: "f"``, ``bp: "e"`` — bind to the
+        enclosing slice, so the arrow terminates at the span currently
+        open on this thread rather than at a bare point)."""
+        self._flow("f", name, flow_id, {"bp": "e"}, args)
+
+    def recent_events(self, limit: int | None = None) -> list[dict]:
+        """The newest ``limit`` recorded events (all when ``None``) —
+        the flight recorder's span-ring read: a self-contained,
+        Perfetto-loadable slice of recent activity without writing a
+        trace file."""
+        with self._lock:
+            events = list(self.events)
+        if limit is not None and len(events) > limit:
+            events = events[-limit:]
+        return events
+
     # -- queries ----------------------------------------------------------
 
     def current_span_id(self) -> int | None:
@@ -179,6 +221,25 @@ class Tracer:
         return path
 
 
+class RingTracer(Tracer):
+    """A :class:`Tracer` whose event store is a bounded ring: the newest
+    ``capacity`` events survive, older ones fall off. This is the
+    always-on form the flight recorder installs
+    (:mod:`tpu_syncbn.obs.flightrec`) — span recording with memory
+    bounded by construction, so it can run for days and still hold the
+    seconds *before* an incident. :meth:`Tracer.save` and
+    :meth:`Tracer.recent_events` work unchanged (they copy the ring)."""
+
+    def __init__(self, capacity: int = 2048, **kwargs):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        super().__init__(**kwargs)
+        self.capacity = int(capacity)
+        # deque.append matches the list API every recording path uses;
+        # maxlen makes eviction O(1) and allocation-free
+        self.events = deque(maxlen=self.capacity)  # type: ignore[assignment]
+
+
 # ---------------------------------------------------------------------------
 # module-level installed tracer
 
@@ -225,6 +286,20 @@ def instant(name: str, **args) -> None:
         t.instant(name, **args)
 
 
+def flow_start(name: str, flow_id: int, **args) -> None:
+    """Flow-arrow start on the installed tracer (no-op when off)."""
+    t = _installed
+    if t is not None:
+        t.flow_start(name, flow_id, **args)
+
+
+def flow_end(name: str, flow_id: int, **args) -> None:
+    """Flow-arrow end on the installed tracer (no-op when off)."""
+    t = _installed
+    if t is not None:
+        t.flow_end(name, flow_id, **args)
+
+
 def current_span_id() -> int | None:
     t = _installed
     return t.current_span_id() if t is not None else None
@@ -267,10 +342,14 @@ def validate_trace(events: list) -> list[dict]:
             raise ValueError(f"trace event {i} is not a dict")
         if not isinstance(ev.get("name"), str):
             raise ValueError(f"trace event {i} has no name")
-        if ev.get("ph") not in ("X", "B", "E", "i", "I", "M", "C"):
+        if ev.get("ph") not in ("X", "B", "E", "i", "I", "M", "C",
+                                "s", "t", "f"):
             raise ValueError(f"trace event {i} has unknown phase {ev.get('ph')!r}")
         if ev["ph"] != "M" and not isinstance(ev.get("ts"), (int, float)):
             raise ValueError(f"trace event {i} has no numeric ts")
         if ev["ph"] == "X" and not isinstance(ev.get("dur"), (int, float)):
             raise ValueError(f"complete event {i} has no numeric dur")
+        if ev["ph"] in ("s", "t", "f") and not isinstance(
+                ev.get("id"), (int, str)):
+            raise ValueError(f"flow event {i} has no id")
     return events

@@ -223,7 +223,7 @@ class Watchdog:
                 # tag the dump with the most recently opened trace span
                 # (the monitor thread has no span stack of its own) so a
                 # Perfetto trace and this event log join on span id
-                from tpu_syncbn.obs import telemetry, tracing
+                from tpu_syncbn.obs import flightrec, telemetry, tracing
 
                 span_id = tracing.latest_open_span_id()
                 telemetry.count("resilience.watchdog_stalls")
@@ -239,6 +239,15 @@ class Watchdog:
                 )
                 logger = dist.get_logger("tpu_syncbn.resilience")
                 logger.error("%s", diag)
+                # the stack dump says where THIS host is stuck; the
+                # incident bundle says what the whole process was doing
+                # in the seconds before (docs/OBSERVABILITY.md)
+                flightrec.trigger("watchdog_stall", {
+                    "watchdog": self.name, "idle_s": round(idle, 2),
+                    "deadline_s": self.deadline_s,
+                    **({"span_id": span_id} if span_id is not None
+                       else {}),
+                })
                 if self._on_stall is not None:
                     with contextlib.suppress(Exception):
                         self._on_stall(diag)
@@ -308,7 +317,7 @@ def stall_guard(
             try:
                 tag, payload = q.get(timeout=deadline_s)
             except _queue.Empty:
-                from tpu_syncbn.obs import telemetry, tracing
+                from tpu_syncbn.obs import flightrec, telemetry, tracing
 
                 span_id = tracing.latest_open_span_id()
                 telemetry.count("resilience.data_stalls")
@@ -322,6 +331,10 @@ def stall_guard(
                     f"WATCHDOG: {name!r} fetch exceeded {deadline_s}s{tag}"
                 )
                 dist.get_logger("tpu_syncbn.resilience").error("%s", diag)
+                flightrec.trigger("watchdog_stall", {
+                    "source": name, "deadline_s": deadline_s,
+                    "stall": "data_fetch",
+                })
                 raise StallError(
                     f"{name} fetch exceeded the {deadline_s}s watchdog "
                     "deadline"
@@ -606,7 +619,7 @@ class ResilientLoop:
         self.recovering = True
         # tag the rollback with the current trace span so the Perfetto
         # timeline and this log line correlate (same id in both)
-        from tpu_syncbn.obs import tracing
+        from tpu_syncbn.obs import flightrec, tracing
 
         span_id = tracing.latest_open_span_id()
         tracing.instant(
@@ -619,6 +632,12 @@ class ResilientLoop:
             self.step, restored,
             f" (trace_span={span_id})" if span_id is not None else "",
         )
+        # the bundle holds the step monitors from the steps BEFORE the
+        # blow-up — the evidence a post-mortem of the divergence needs
+        flightrec.trigger("divergence_restore", {
+            "step": self.step, "restored_step": restored,
+            **({"span_id": span_id} if span_id is not None else {}),
+        })
         self.step = restored
 
     # -- the loop ---------------------------------------------------------
@@ -639,7 +658,7 @@ class ResilientLoop:
         async checkpoint writes are flushed on every exit path."""
         import numpy as _np
 
-        from tpu_syncbn.obs import server as obs_server, telemetry
+        from tpu_syncbn.obs import flightrec, server as obs_server, telemetry
         from tpu_syncbn.parallel.collectives import DispatchWireTally
 
         policy = getattr(self.trainer, "divergence_guard", None)
@@ -649,6 +668,11 @@ class ResilientLoop:
         # with TPU_SYNCBN_METRICS_PORT set this run answers /metrics,
         # /healthz (step heartbeat below), /readyz (the `train` hook)
         obs_server.start_from_env()
+        # flight recorder (docs/OBSERVABILITY.md "Incidents"): with
+        # TPU_SYNCBN_FLIGHTREC set this run keeps bounded rings of
+        # recent spans/monitors and dumps an incident bundle on a
+        # divergence restore, watchdog stall, SLO alert, or /incidentz
+        flightrec.install_from_env()
         obs_server.register_readiness("train", self.readiness)
         wire_tally = DispatchWireTally()
         try:
@@ -697,6 +721,12 @@ class ResilientLoop:
                     # beat; the gauge gives scrapers the live position
                     obs_server.HEARTBEATS.beat("train")
                     telemetry.set_gauge("train.step", self.step)
+                    # step ring: async device scalars recorded as-is
+                    # (no host sync here; scalarized at dump time)
+                    flightrec.record_step(
+                        self.step, metrics=out.metrics,
+                        monitors=getattr(out, "monitors", None),
+                    )
                     wire_tally.after_dispatch(k)
                     if policy is not None:
                         # scalar for a single step, (K,)-stacked for a

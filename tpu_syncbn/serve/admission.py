@@ -361,6 +361,13 @@ class CircuitBreaker:
 
     def _publish(self) -> None:
         telemetry.set_gauge(self.gauge_name, self._CODES[self._state])
+        # breaker transitions land in the flight recorder's serve ring
+        # (no-op without a recorder; record_serve only takes the
+        # recorder's own ring lock — no cross-lock cycle with ours)
+        from tpu_syncbn.obs import flightrec
+
+        flightrec.record_serve("circuit_state", state=self._state,
+                               breaker=self.gauge_name)
 
     @property
     def state(self) -> str:
@@ -448,7 +455,19 @@ class CircuitBreaker:
                 self.open_count += 1
                 self._consecutive = 0
                 self._publish()
-            return opened
+            retry_after = self._retry_after
+        if opened:
+            # incident capture OUTSIDE the breaker lock: the dump reads
+            # readiness hooks, which read this breaker's stats() — a
+            # trigger under self._lock would deadlock on itself
+            from tpu_syncbn.obs import flightrec
+
+            flightrec.trigger("circuit_open", {
+                "breaker": self.gauge_name,
+                "open_count": self.open_count,
+                "retry_after_s": round(retry_after, 4),
+            })
+        return opened
 
     def stats(self) -> dict:
         """JSON-ready breaker state for readiness detail blocks."""

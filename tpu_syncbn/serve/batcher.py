@@ -51,6 +51,7 @@ registry when telemetry is enabled.
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -59,9 +60,11 @@ from typing import Any
 
 import numpy as np
 
+from tpu_syncbn.obs import flightrec
 from tpu_syncbn.obs import server as obs_server
 from tpu_syncbn.obs import stepstats as obs_stepstats
 from tpu_syncbn.obs import telemetry
+from tpu_syncbn.obs.tracing import get as active_tracer
 from tpu_syncbn.runtime import distributed as dist
 from tpu_syncbn.serve.admission import (  # noqa: F401  (re-exported API)
     AdmissionController,
@@ -79,8 +82,13 @@ __all__ = ["DynamicBatcher", "RejectedError", "DeadlineExceededError",
 FILL_BUCKETS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0)
 
 
+#: Process-unique request ids — the Perfetto flow ids linking each
+#: request's enqueue span to the batch span that answered it.
+_request_ids = itertools.count(1)
+
+
 class _Request:
-    __slots__ = ("payload", "n", "future", "t0", "deadline")
+    __slots__ = ("payload", "n", "future", "t0", "deadline", "rid")
 
     def __init__(self, payload, n: int, deadline: float | None = None):
         self.payload = payload
@@ -89,6 +97,7 @@ class _Request:
         self.t0 = time.perf_counter()
         #: absolute completion deadline on time.monotonic, or None
         self.deadline = deadline
+        self.rid = next(_request_ids)
 
 
 class DynamicBatcher:
@@ -193,6 +202,11 @@ class DynamicBatcher:
         self.ready_depth = int(ready_depth)
         self._health_name = str(health_name)
         obs_server.start_from_env()
+        # flight recorder (docs/OBSERVABILITY.md "Incidents"): serve
+        # decisions (sheds, rejections, deadline misses, breaker
+        # transitions) ring-buffer into it; a circuit open dumps a
+        # bundle. TPU_SYNCBN_FLIGHTREC is the whole knob.
+        flightrec.install_from_env()
         obs_server.register_readiness(self._health_name, self.readiness)
         self._thread = threading.Thread(
             target=self._run, name="dynamic-batcher", daemon=True
@@ -265,6 +279,7 @@ class DynamicBatcher:
             ))
         self.counters.bump("shed")
         self.counters.bump("deadline_miss_total")
+        flightrec.record_serve("shed", rid=req.rid, n=req.n)
 
     def submit(self, item, *, deadline_ms: float | None = None) -> Future:
         """Enqueue one request; returns its ``Future``. Raises
@@ -281,11 +296,14 @@ class DynamicBatcher:
             )
         if self.draining or self._stopped.is_set():
             self.counters.bump("rejected")
+            flightrec.record_serve("rejected", reason="draining", n=n)
             raise RejectedError("batcher is draining — not admitting")
         if self._breaker is not None:
             admit, retry_after = self._breaker.allow()
             if not admit:
                 self.counters.bump("rejected")
+                flightrec.record_serve("rejected", reason="circuit_open",
+                                       n=n)
                 raise CircuitOpenError(
                     "engine circuit open after consecutive failures — "
                     f"retry in {retry_after:.2f}s",
@@ -298,10 +316,19 @@ class DynamicBatcher:
         deadline = (None if dl_ms is None
                     else time.monotonic() + float(dl_ms) / 1e3)
         req = _Request(item, n, deadline)
+        tracer = active_tracer()
+        if tracer is not None:
+            # flow start: Perfetto draws an arrow from this enqueue
+            # span to the serve.batch span that answers the request
+            # (flow id = request id), making batching latency visually
+            # attributable in any trace of this process
+            with tracer.span("serve.enqueue", rid=req.rid, n=n):
+                tracer.flow_start("serve.request", req.rid)
         try:
             self._q.put_nowait(req)
         except queue.Full:
             self.counters.bump("rejected")
+            flightrec.record_serve("rejected", reason="queue_full", n=n)
             raise RejectedError(
                 f"request queue full ({self._q.maxsize}) — shed load"
             ) from None
@@ -427,6 +454,13 @@ class DynamicBatcher:
                 "serve.batch", "serve.batch_s", n=n, bucket=bucket,
                 requests=len(live),
             ):
+                tracer = active_tracer()
+                if tracer is not None:
+                    # flow ends INSIDE the batch span so the arrows
+                    # terminate on it (bp="e" binds to the enclosing
+                    # slice)
+                    for r in live:
+                        tracer.flow_end("serve.request", r.rid)
                 out = self._engine.predict(payload)
         except Exception as e:  # answer everyone; keep serving
             self.counters.bump("errors")
@@ -459,6 +493,10 @@ class DynamicBatcher:
                 # up — count it so the miss rate covers late answers,
                 # not just sheds
                 self.counters.bump("deadline_miss_total")
+                flightrec.record_serve(
+                    "deadline_miss", rid=r.rid,
+                    late_s=round(mono - r.deadline, 4),
+                )
             r.future.set_result(jax.tree_util.tree_map(
                 lambda a: a[lo:lo + r.n], out
             ))
