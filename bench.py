@@ -953,6 +953,112 @@ def measure_audit(dp, batch) -> dict:
     }
 
 
+def measure_collectives(*, payload_mb: float = 1.0, steps: int = 5) -> dict:
+    """The ``collectives`` block of the bench line: the compressed-
+    collective layer (docs/PERFORMANCE.md "Compressed collectives")
+    measured two ways —
+
+    * **traced bytes-on-wire per mode** for a fixed per-chip payload
+      (the exact estimate the program contracts pin: jaxpr text, wire
+      dtypes), plus measured all-reduce wall time and effective
+      bandwidth per mode on THIS backend;
+    * **golden-pinned compression ratios** read from the contract files
+      (``dataparallel.compressed_{fp32,bf16,int8}.train_step``) — the
+      machine-checked ≥2×/≥3.5× claim, repeated here so the bench line
+      carries it as a ``--check-regression``-gated number.
+
+    CPU absolute ms/bandwidth are smoke noise like the headline
+    throughput; the ratios are backend-independent arithmetic over
+    program text and are the anchored quantities. Schema pinned by
+    tests/test_bench_tooling.py."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_syncbn import runtime
+    from tpu_syncbn.audit.contracts import summarize_jaxpr
+    from tpu_syncbn.compat import shard_map
+    from tpu_syncbn.parallel import collectives as coll
+    from tpu_syncbn.runtime.distributed import DATA_AXIS
+
+    t_start = time.perf_counter()
+    mesh = runtime.data_parallel_mesh()
+    world = int(mesh.shape[DATA_AXIS])
+    n_elems = max(1024, int(payload_mb * (1 << 20) / 4))
+    import jax.numpy as jnp
+
+    x = jax.device_put(
+        jnp.ones((world, n_elems), jnp.float32),
+        NamedSharding(mesh, P(DATA_AXIS)),
+    )
+
+    def build(mode):
+        if mode == "shuffle_sharded":
+            body = lambda a: coll.shuffle_sharded_psum(a, DATA_AXIS)
+        else:
+            m = "none" if mode == "fp32" else mode
+            body = lambda a: coll.compressed_pmean(a, DATA_AXIS, mode=m)
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(P(DATA_AXIS),), out_specs=P(DATA_AXIS),
+        )
+
+    modes = {}
+    fp32_bytes = None
+    for mode in ("fp32", "bf16", "int8", "shuffle_sharded"):
+        fn = build(mode)
+        wire = sum(
+            summarize_jaxpr(jax.make_jaxpr(fn)(x))
+            ["collective_bytes"].values()
+        )
+        jfn = jax.jit(fn)
+        jfn(x).block_until_ready()  # compile + warm
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(steps):
+            out = jfn(x)
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / steps
+        if mode == "fp32":
+            fp32_bytes = wire
+        modes[mode] = {
+            "wire_bytes": wire,
+            "ms": round(dt * 1e3, 3),
+            "gbytes_per_s": (
+                round(wire / max(dt, 1e-9) / 1e9, 3) if wire else None
+            ),
+            "compression_ratio": (
+                round(fp32_bytes / wire, 3) if wire and fp32_bytes
+                else None
+            ),
+        }
+
+    # golden-pinned ratios: arithmetic over the contract files, no
+    # tracing — absent goldens null the entry rather than fail the block
+    golden_ratio = {}
+    try:
+        from tpu_syncbn.audit import jaxpr_audit
+        from tpu_syncbn.audit.contracts import load_contract
+
+        gd = jaxpr_audit.default_golden_dir()
+        lossy = jaxpr_audit.lossy_collective_bytes
+        f32c = load_contract(jaxpr_audit.golden_path(
+            gd, "dataparallel.compressed_fp32.train_step"))
+        for m in ("bf16", "int8"):
+            c = load_contract(jaxpr_audit.golden_path(
+                gd, f"dataparallel.compressed_{m}.train_step"))
+            golden_ratio[m] = round(lossy(f32c) / max(1, lossy(c)), 3)
+    except (OSError, ValueError, KeyError) as e:
+        log(f"collectives golden ratios unavailable: {e}")
+        golden_ratio = {"bf16": None, "int8": None}
+    return {
+        "payload_mb_per_chip": payload_mb,
+        "world": world,
+        "modes": modes,
+        "golden_ratio": golden_ratio,
+        "measure_s": round(time.perf_counter() - t_start, 3),
+    }
+
+
 def check_regression(
     line: dict, *, baseline_path: str = _BASELINE_PATH,
     tolerance: float = 0.1,
@@ -1297,6 +1403,23 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
         log(f"audit measurement failed: {type(e).__name__}: {e}")
         audit_info = None
 
+    # compressed-collective layer: per-mode bytes-on-wire + golden
+    # ratios (docs/PERFORMANCE.md "Compressed collectives") — an
+    # annotation, never fatal to the metric
+    try:
+        with stepstats.timed_span("collectives_bench",
+                                  "bench.collectives_s"):
+            collectives_info = measure_collectives()
+        log("collectives: golden ratios "
+            f"bf16={collectives_info['golden_ratio'].get('bf16')} "
+            f"int8={collectives_info['golden_ratio'].get('int8')}, "
+            f"int8 wire {collectives_info['modes']['int8']['wire_bytes']}"
+            f" B vs fp32 {collectives_info['modes']['fp32']['wire_bytes']}"
+            " B")
+    except Exception as e:
+        log(f"collectives measurement failed: {type(e).__name__}: {e}")
+        collectives_info = None
+
     mfu = None
     peak, peak_source = (_peak_flops(jax.devices()[0], backend)
                          if on_accel else (None, None))
@@ -1357,6 +1480,12 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
         # per-device peak tracks the real workload's footprint); schema
         # pinned by tests/test_bench_tooling.py
         "audit": audit_info,
+        # docs/PERFORMANCE.md "Compressed collectives": per-wire-mode
+        # traced bytes + measured all-reduce time for a fixed payload,
+        # and the golden-pinned >=2x/>=3.5x compression ratios (the
+        # BASELINE-anchored quantities — backend-independent); schema
+        # pinned by tests/test_bench_tooling.py
+        "collectives": collectives_info,
         # docs/OBSERVABILITY.md "Incidents & flight recorder": forced-
         # trigger bundle cost (dump_s / bundle_bytes — both BASELINE
         # anchors), pre-trigger ring coverage, per-step recording

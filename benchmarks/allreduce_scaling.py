@@ -1,9 +1,9 @@
 """All-reduce scaling-efficiency harness (BASELINE target: >= 90% from
-8 -> 64 chips).
+8 -> 64 chips), with per-wire-mode sweeps (ISSUE 12).
 
-Measures the gradient-sized psum (the DP step's bulk collective — DDP's
-bucketed all-reduce equivalent) across increasing mesh sizes and reports
-efficiency relative to the smallest measured world:
+Measures the gradient-sized all-reduce (the DP step's bulk collective —
+DDP's bucketed all-reduce equivalent) across increasing mesh sizes and
+reports efficiency relative to the smallest measured world:
 
     efficiency(n) = t(base) / t(n)
 
@@ -11,19 +11,29 @@ efficiency relative to the smallest measured world:
 time is ~2·(n-1)/n · bytes/bw — nearly flat in n, so the ratio of step
 times is the standard efficiency metric).
 
+``--modes`` sweeps the compressed wire dtypes next to fp32: for every
+(world, mode) pair the line reports measured time AND the traced
+bytes-on-wire from the program text — the same estimate the program
+contracts pin (``audit.contracts.summarize_jaxpr``), so the claimed
+compression ratio and the measured speedup sit side by side in one
+artifact.
+
 On real hardware run it on a pod slice; without one, --simulate N runs the
 same code over N forced host devices (mechanics validation only — CPU
 "ICI" numbers are meaningless for the target).
 
 Usage:
     python benchmarks/allreduce_scaling.py [--sizes 2,4,8] [--mb 25]
-    python benchmarks/allreduce_scaling.py --simulate 8
+    python benchmarks/allreduce_scaling.py --simulate 8 \
+        --modes fp32,bf16,int8,shuffle
 """
 
 import argparse
 import json
 import sys
 import time
+
+MODES = ("fp32", "bf16", "int8", "shuffle")
 
 
 def main():
@@ -33,9 +43,17 @@ def main():
     p.add_argument("--mb", type=float, default=25.0,
                    help="payload per chip in MiB (DDP's default bucket size)")
     p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--modes", default="fp32",
+                   help="comma-separated wire modes to sweep "
+                        f"(subset of {','.join(MODES)})")
     p.add_argument("--simulate", type=int, default=None,
                    help="simulate N host devices on CPU")
     args = p.parse_args()
+
+    modes = [m.strip() for m in args.modes.split(",") if m.strip()]
+    bad = [m for m in modes if m not in MODES]
+    if bad:
+        raise SystemExit(f"unknown modes {bad}; pick from {MODES}")
 
     import os
 
@@ -48,8 +66,10 @@ def main():
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from tpu_syncbn import parallel, runtime
+    from tpu_syncbn import runtime
+    from tpu_syncbn.audit.contracts import summarize_jaxpr
     from tpu_syncbn.compat import shard_map
+    from tpu_syncbn.parallel import collectives as coll
 
     n_dev = jax.device_count()
     if args.sizes:
@@ -59,43 +79,76 @@ def main():
     if not sizes:
         raise SystemExit(f"need >= 2 devices, have {n_dev}")
 
+    def body_for(mode):
+        if mode == "shuffle":
+            return lambda a: coll.shuffle_sharded_psum(a, "data")
+        m = "none" if mode == "fp32" else mode
+        return lambda a: coll.compressed_pmean(a, "data", mode=m)
+
     n_elems = int(args.mb * (1 << 20) / 4)
     results = []
     for world in sizes:
         mesh = runtime.data_parallel_mesh(num_replicas=world)
         x = jnp.ones((world, n_elems), jnp.float32)
         xs = jax.device_put(x, NamedSharding(mesh, P("data")))
-        f = jax.jit(
-            shard_map(
-                lambda a: parallel.pmean(a, "data"),
+        for mode in modes:
+            sharded = shard_map(
+                body_for(mode),
                 mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
             )
-        )
-        f(xs).block_until_ready()  # compile + warm
-        t0 = time.perf_counter()
-        for _ in range(args.steps):
-            out = f(xs)
-        out.block_until_ready()
-        dt = (time.perf_counter() - t0) / args.steps
-        results.append({"world": world, "ms": dt * 1e3})
-        print(f"world={world:3d}: {dt*1e3:8.3f} ms / all-reduce", file=sys.stderr)
+            wire_bytes = sum(
+                summarize_jaxpr(jax.make_jaxpr(sharded)(xs))
+                ["collective_bytes"].values()
+            )
+            f = jax.jit(sharded)
+            f(xs).block_until_ready()  # compile + warm
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(args.steps):
+                out = f(xs)
+            out.block_until_ready()
+            dt = (time.perf_counter() - t0) / args.steps
+            results.append({
+                "world": world,
+                "mode": mode,
+                "ms": round(dt * 1e3, 3),
+                "bytes_on_wire": wire_bytes,
+            })
+            print(
+                f"world={world:3d} mode={mode:7s}: {dt*1e3:8.3f} ms, "
+                f"{wire_bytes} B on wire",
+                file=sys.stderr,
+            )
 
-    # Base is world=8 when measured (the BASELINE 8->64 target's anchor),
-    # else the smallest world. Raw ratios are corrected by the ring
-    # all-reduce's ideal time factor 2(n-1)/n so that perfect hardware
-    # scores 1.0 at every size (a raw 2-vs-64 ratio would bottom out at
-    # ~0.51 even on an ideal interconnect).
-    base_entry = next((r for r in results if r["world"] == 8), results[0])
-    ring = lambda n: 2.0 * (n - 1) / n
-    for r in results:
-        raw = base_entry["ms"] / r["ms"]
-        r["efficiency_vs_base"] = round(
-            raw * ring(r["world"]) / ring(base_entry["world"]), 4
-        )
+    # per-mode efficiency vs that mode's base world (8 when measured —
+    # the BASELINE 8->64 anchor — else the smallest), corrected by the
+    # ring all-reduce's ideal 2(n-1)/n factor so perfect hardware scores
+    # 1.0 at every size; plus compression ratio vs fp32 at equal world.
+    ring = lambda n: 2.0 * (n - 1) / max(n, 1)
+    fp32_bytes = {
+        r["world"]: r["bytes_on_wire"]
+        for r in results if r["mode"] == "fp32"
+    }
+    for mode in modes:
+        rows = [r for r in results if r["mode"] == mode]
+        base = next((r for r in rows if r["world"] == 8), rows[0])
+        for r in rows:
+            raw = base["ms"] / r["ms"]
+            r["efficiency_vs_base"] = round(
+                raw * ring(r["world"]) / ring(base["world"]), 4
+            )
+            fb = fp32_bytes.get(r["world"])
+            r["compression_ratio"] = (
+                round(fb / r["bytes_on_wire"], 3)
+                if fb and r["bytes_on_wire"] else None
+            )
     print(json.dumps({
         "metric": "allreduce_scaling",
         "payload_mb_per_chip": args.mb,
-        "base_world": base_entry["world"],
+        "modes": modes,
+        "base_world": next(
+            (r["world"] for r in results if r["world"] == 8), sizes[0]
+        ),
         "results": results,
     }))
 

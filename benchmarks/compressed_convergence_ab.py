@@ -1,0 +1,166 @@
+"""Compressed-gradient convergence A/B at small per-chip batch (ISSUE 12).
+
+Same design as ``syncbn_convergence_ab.py`` (identical init, data order,
+and learning rate across arms; trajectory distance, not toy accuracy),
+but the variable is the gradient WIRE DTYPE, not the BN sync:
+
+* **fp32** — the exact baseline (``compress="none"``);
+* **int8+EF** — chunk-quantized s8 all-reduce with the persistent
+  error-feedback residual (the production int8 configuration);
+* **int8 (no EF)** — ablation: the same quantizer with error feedback
+  disabled, isolating what the residual recovers;
+* **bf16** — the cheap middle ground.
+
+The headline number is each arm's early-window mean |loss − fp32_loss|:
+EQuARX's claim (arXiv:2506.17615) is that quantized all-reduce is
+convergence-neutral, and error feedback is the mechanism that makes the
+aggressive int8 budget (127/world per element) hold it. ``--tolerance``
+pins the acceptance bar for the int8+EF arm; the JSON line carries
+``within_tolerance`` so a driver can gate on it.
+
+    python benchmarks/compressed_convergence_ab.py --simulate 8 \
+        --steps 150 --per-chip-batch 2 [--tolerance 0.08]
+"""
+
+import argparse
+import json
+import sys
+
+from _common import setup
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--simulate", type=int, default=8,
+                   help="virtual host devices (the replica count)")
+    p.add_argument("--steps", type=int, default=150)
+    p.add_argument("--early-steps", type=int, default=None,
+                   help="early-window length for the MAE (default: "
+                        "min(50, steps))")
+    p.add_argument("--per-chip-batch", type=int, default=2)
+    p.add_argument("--image-size", type=int, default=16)
+    p.add_argument("--num-classes", type=int, default=10)
+    p.add_argument("--dataset-size", type=int, default=512)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--tolerance", type=float, default=0.08,
+                   help="pinned early-window loss-MAE bar for int8+EF "
+                        "vs fp32 (loss units)")
+    p.add_argument("--skip-ablation", action="store_true",
+                   help="skip the no-EF and bf16 arms (CI-speed run)")
+    p.add_argument("--curves", default=None,
+                   help="write full per-step loss curves to this JSON file")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    setup(args.simulate)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax import nnx
+
+    from tpu_syncbn import models, nn, parallel
+
+    R = args.simulate
+    B = args.per_chip_batch
+    global_batch = R * B
+    steps_per_epoch = args.dataset_size // global_batch
+    if steps_per_epoch < 1:
+        raise SystemExit(
+            f"--dataset-size {args.dataset_size} holds zero batches of "
+            f"global size {global_batch} (= {R} replicas × {B}/chip) — "
+            "raise --dataset-size or shrink the batch"
+        )
+    early = args.early_steps or min(50, args.steps)
+
+    rng = np.random.RandomState(args.seed)
+    mu = rng.randn(args.num_classes, 1, 1, 3).astype(np.float32)
+    ys = rng.randint(0, args.num_classes, args.dataset_size).astype(np.int32)
+    xs = (
+        mu[ys]
+        + 0.7 * rng.randn(
+            args.dataset_size, args.image_size, args.image_size, 3
+        ).astype(np.float32)
+    )
+
+    def batches():
+        order_rng = np.random.RandomState(args.seed + 1)
+        while True:
+            perm = order_rng.permutation(args.dataset_size)
+            for s in range(steps_per_epoch):
+                idx = perm[s * global_batch : (s + 1) * global_batch]
+                yield xs[idx], ys[idx]
+
+    def loss_fn(m, batch):
+        bx, by = batch
+        return optax.softmax_cross_entropy_with_integer_labels(
+            m(bx), by
+        ).mean()
+
+    def run(compress, error_feedback):
+        model = nn.convert_sync_batchnorm(models.resnet18(
+            num_classes=args.num_classes, small_input=True,
+            rngs=nnx.Rngs(args.seed),
+        ))
+        dp = parallel.DataParallel(
+            model, optax.sgd(args.lr), loss_fn,
+            compress=compress, error_feedback=error_feedback,
+        )
+        losses = []
+        stream = batches()
+        for _ in range(args.steps):
+            bx, by = next(stream)
+            batch = jax.device_put(
+                (jnp.asarray(bx), jnp.asarray(by)), dp.batch_sharding
+            )
+            losses.append(float(dp.train_step(batch).loss))
+        return np.asarray(losses)
+
+    arms = {"fp32": run("none", None)}
+    arms["int8_ef"] = run("int8", True)
+    if not args.skip_ablation:
+        arms["int8_noef"] = run("int8", False)
+        arms["bf16"] = run("bf16", None)
+
+    ref = arms["fp32"]
+
+    def mae(curve):
+        return float(np.abs(curve[:early] - ref[:early]).mean())
+
+    maes = {k: round(mae(v), 6) for k, v in arms.items() if k != "fp32"}
+    result = {
+        "metric": "compressed_grad_loss_curve_mae_vs_fp32",
+        "replicas": R,
+        "per_chip_batch": B,
+        "steps": args.steps,
+        "early_steps": early,
+        "tolerance": args.tolerance,
+        "early_mae": maes,
+        "within_tolerance": maes["int8_ef"] <= args.tolerance,
+        "ef_recovery_ratio": (
+            round(maes["int8_noef"] / max(maes["int8_ef"], 1e-9), 2)
+            if "int8_noef" in maes else None
+        ),
+        "final_loss": {k: round(float(v[-1]), 4) for k, v in arms.items()},
+    }
+    if args.curves:
+        with open(args.curves, "w") as f:
+            json.dump(
+                {**{k: v.tolist() for k, v in arms.items()}, **result}, f
+            )
+    print(json.dumps(result))
+    if not result["within_tolerance"]:
+        print(
+            f"int8+EF early-window MAE {maes['int8_ef']} exceeds the "
+            f"pinned tolerance {args.tolerance}",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

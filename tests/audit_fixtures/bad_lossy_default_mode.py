@@ -1,0 +1,29 @@
+"""Planted violations for the ``lossy_default_mode`` rule: compression
+mode parameters whose DEFAULT is a lossy wire dtype — the silent-routing
+hazard the rule exists to catch. Lint input only, never imported."""
+
+
+def quantized_reduce(tree, axis_name, mode="int8"):  # default is lossy
+    return tree, axis_name, mode
+
+
+def stat_sync(s, sq, count, *, stats_compress="bf16"):  # kw-only lossy
+    return s, sq, count, stats_compress
+
+
+class Trainer:
+    def __init__(self, model, compress="int8"):  # trainer-level lossy
+        self.model = model
+        self.compress = compress
+
+    def reduce(self, grads, grad_compression="bf16"):  # legacy knob too
+        return grads, grad_compression
+
+
+def clean_reduce(tree, axis_name, mode="none"):  # clean: exact default
+    return tree, axis_name, mode
+
+
+def explicit_call_site(tree):
+    # passing a lossy literal at a CALL site is the opt-in, not a hit
+    return quantized_reduce(tree, "ax", mode="int8")

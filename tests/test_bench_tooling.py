@@ -561,6 +561,9 @@ class TestTelemetryBlock:
         # the incident block is always present (the flight recorder is
         # armed on every run and a manual bundle is forced — ISSUE 11)
         self._validate_incident_block(line["incident"], steps=3)
+        # the collectives block is always present (the compressed-
+        # collective layer measured per wire mode — ISSUE 12)
+        self._validate_collectives_block(line["collectives"])
         # the serve block is null unless --serve ran the sweep
         assert line["serve"] is None
         # the --trace file is valid Chrome trace JSON with the three
@@ -614,6 +617,35 @@ class TestTelemetryBlock:
         # the liveness-grade SLO (p99 < 60s) holds on a healthy run
         assert block["slo_firing"] is False
         assert block["slo_burn_rate"] is not None
+
+    @staticmethod
+    def _validate_collectives_block(block):
+        """The schema-pinned `collectives` block (ISSUE 12): per-mode
+        traced bytes-on-wire + measured all-reduce time, and the
+        golden-pinned compression ratios that BASELINE anchors gate."""
+        assert set(block) == {
+            "payload_mb_per_chip", "world", "modes", "golden_ratio",
+            "measure_s",
+        }
+        assert block["world"] >= 1
+        assert set(block["modes"]) == {
+            "fp32", "bf16", "int8", "shuffle_sharded",
+        }
+        for mode, entry in block["modes"].items():
+            assert set(entry) == {
+                "wire_bytes", "ms", "gbytes_per_s", "compression_ratio",
+            }, mode
+            assert entry["ms"] >= 0
+        fp32 = block["modes"]["fp32"]["wire_bytes"]
+        assert fp32 > 0
+        # the wire-dtype arithmetic is exact: bf16 halves, int8 is the
+        # s8 payload plus the fp32 range-stat side channel
+        assert block["modes"]["bf16"]["wire_bytes"] * 2 == fp32
+        assert 3.5 <= block["modes"]["int8"]["compression_ratio"] <= 4.0
+        # golden ratios mirror the pinned contracts (the acceptance
+        # floors of the ISSUE 12 invariant)
+        assert block["golden_ratio"]["bf16"] >= 2.0
+        assert block["golden_ratio"]["int8"] >= 3.5
 
     @staticmethod
     def _validate_incident_block(block, *, steps):

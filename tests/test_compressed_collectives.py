@@ -1,0 +1,447 @@
+"""Compressed collectives (ISSUE 12): quantized all-reduce parity pins,
+error-feedback semantics against an analytic reference, shuffle-sharded
+reduction, compressed reduce-scatter, and the wire-dtype byte tallies.
+
+All over the real 8-device CPU mesh via shard_map — every op lowers to a
+real AllReduce/CollectivePermute, and the int8 paths are asserted to put
+s8 (not f32) on the wire in the compiled HLO.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_syncbn import runtime
+from tpu_syncbn.compat import shard_map
+from tpu_syncbn.obs import telemetry
+from tpu_syncbn.parallel import collectives as C
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return runtime.data_parallel_mesh()
+
+
+def shmap(mesh, fn, in_specs, out_specs):
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def _tree(rng):
+    return {
+        "a": jnp.asarray(rng.randn(N, 300).astype(np.float32)),
+        "b": jnp.asarray(rng.randn(N, 7).astype(np.float32)),
+    }
+
+
+_SPECS = {"a": P("data"), "b": P("data")}
+
+
+def _pmean_oracle(tree):
+    return {
+        k: np.tile(np.asarray(v).mean(0, keepdims=True), (N, 1))
+        for k, v in tree.items()
+    }
+
+
+# ---------------------------------------------------------------------------
+# compressed_psum / compressed_pmean
+
+
+def test_mode_validation():
+    with pytest.raises(ValueError, match="compression mode"):
+        C.check_compress_mode("fp8")
+    assert C.check_compress_mode("none") == "none"
+
+
+def test_compressed_pmean_none_is_exact(mesh):
+    tree = _tree(np.random.RandomState(0))
+    f = jax.jit(shmap(
+        mesh, lambda t: C.compressed_pmean(t, "data", mode="none"),
+        (_SPECS,), _SPECS,
+    ))
+    out = f(tree)
+    ref = _pmean_oracle(tree)
+    for k in tree:
+        np.testing.assert_allclose(np.asarray(out[k]), ref[k], rtol=1e-6)
+
+
+def test_compressed_pmean_bf16_exact_parity_on_representable_inputs(mesh):
+    """The bf16 parity pin: integer-valued inputs whose partial sums stay
+    bf16-representable reduce EXACTLY — bit-equal to the fp32 pmean."""
+    rng = np.random.RandomState(1)
+    vals = rng.randint(-8, 9, size=(N, 64)).astype(np.float32)
+    tree = {"a": jnp.asarray(vals)}
+    f = jax.jit(shmap(
+        mesh, lambda t: C.compressed_pmean(t, "data", mode="bf16"),
+        ({"a": P("data")},), {"a": P("data")},
+    ))
+    out = np.asarray(f(tree)["a"])
+    ref = np.tile(vals.mean(0, keepdims=True), (N, 1))
+    assert (out == ref).all(), "bf16 mode must be exact on representable sums"
+
+
+def test_compressed_pmean_int8_within_quantization_bound(mesh):
+    """int8's shared-range budget: per-element error of the MEAN is
+    bounded by the chunk quantization step (half-range / qmax)."""
+    rng = np.random.RandomState(2)
+    tree = _tree(rng)
+    f = jax.jit(shmap(
+        mesh, lambda t: C.compressed_pmean(t, "data", mode="int8"),
+        (_SPECS,), _SPECS,
+    ))
+    out = f(tree)
+    ref = _pmean_oracle(tree)
+    qmax = 127 // N
+    for k in tree:
+        flat = np.asarray(tree[k]).reshape(N, -1)
+        step = (flat.max() - flat.min()) / 2 / qmax
+        err = np.abs(np.asarray(out[k]) - ref[k]).max()
+        assert err <= step, (k, err, step)
+
+
+def test_compressed_pmean_int8_puts_s8_on_the_wire(mesh):
+    """The whole point: the gradient-sized AllReduce must move s8, and
+    the only f32 collectives left are the tiny range stats."""
+    tree = {"a": jnp.ones((N, 512), jnp.float32)}
+    f = jax.jit(shmap(
+        mesh, lambda t: C.compressed_pmean(t, "data", mode="int8"),
+        ({"a": P("data")},), {"a": P("data")},
+    ))
+    hlo = f.lower(tree).compile().as_text()
+    s8_reduces = re.findall(r"= s8\[[^\]]*\][^\n]*all-reduce", hlo)
+    assert s8_reduces, "int8 mode must lower to an s8 all-reduce"
+    # no f32 all-reduce at payload size (512 elems per shard): the only
+    # f32 reduction is the (2*n_chunks,) = 4-element range-stat pmax
+    big_f32 = re.findall(r"= f32\[(\d+)\][^\n]*all-reduce", hlo)
+    assert all(int(n) <= 4 for n in big_f32), big_f32
+
+
+def test_compressed_psum_mixed_tree_keeps_nonfloat_exact(mesh):
+    """Non-float leaves (counts, flags) ride an exact psum next to the
+    quantized float payload."""
+    tree = {
+        "g": jnp.asarray(np.random.RandomState(3).randn(N, 32), jnp.float32),
+        "n": jnp.ones((N,), jnp.int32),
+    }
+    specs = {"g": P("data"), "n": P("data")}
+    f = jax.jit(shmap(
+        mesh, lambda t: C.compressed_psum(t, "data", mode="int8"),
+        (specs,), specs,
+    ))
+    out = f(tree)
+    np.testing.assert_array_equal(np.asarray(out["n"]), np.full((N,), N))
+
+
+# ---------------------------------------------------------------------------
+# error feedback
+
+
+def _np_int8_ef_reference(cs, steps, lr, chunk, world):
+    """Analytic error-feedback SGD on the toy quadratic
+    f(w) = 0.5 * mean_i ||w - c_i||^2 — replicates the exact shared-range
+    quantization math of collectives._int8_qparams in numpy."""
+    D = cs.shape[1]
+    w = np.zeros(D, np.float64)
+    e = np.zeros((world, D), np.float64)
+    qmax = max(1, 127 // world)
+    pad = (-D) % chunk
+    losses = []
+    for _ in range(steps):
+        g = w[None, :] - cs  # per-replica gradient
+        p = g + e
+        pp = np.pad(p, ((0, 0), (0, pad)))
+        blocks = pp.reshape(world, -1, chunk)
+        gmin = blocks.min(axis=2).min(axis=0)
+        gmax = blocks.max(axis=2).max(axis=0)
+        zp = (gmax + gmin) * 0.5
+        half = (gmax - gmin) * 0.5
+        scale = np.where(half > 0, half / qmax, 1.0)
+        # float32 grid, like the device computation
+        scale32 = scale.astype(np.float32).astype(np.float64)
+        zp32 = zp.astype(np.float32).astype(np.float64)
+        q = np.clip(
+            np.round((blocks - zp32[None, :, None]) / scale32[None, :, None]),
+            -qmax, qmax,
+        )
+        own = scale32[None, :, None] * q + zp32[None, :, None]
+        e = (blocks - own).reshape(world, -1)[:, :D]
+        mean = (
+            (scale32[:, None] * q.sum(axis=0) + world * zp32[:, None])
+            / world
+        ).reshape(-1)[:D]
+        losses.append(0.5 * ((w[None, :] - cs) ** 2).mean())
+        w = w - lr * mean
+    return w, np.asarray(losses)
+
+
+def test_ef_int8_matches_analytic_reference(mesh):
+    """K compressed steps on the toy quadratic match the numpy
+    error-feedback reference step for step (same quantization grid,
+    same residual recursion) — the EF semantics pin."""
+    world, D, chunk, steps, lr = N, 6, 4, 12, 0.4
+    rng = np.random.RandomState(4)
+    cs = rng.randn(world, D).astype(np.float32)
+
+    def run(c_shards):
+        w = jnp.zeros((D,), jnp.float32)
+        e = jnp.zeros((D,), jnp.float32)
+        for _ in range(steps):
+            g = w - c_shards[0]
+            m, e = C.ef_compressed_pmean(
+                g, e, "data", mode="int8", chunk_size=chunk
+            )
+            w = w - lr * m
+        return w[None]
+
+    f = jax.jit(shmap(
+        mesh, run, (P("data"),), P("data"),
+    ))
+    got = np.asarray(f(jnp.asarray(cs)))[0]
+    ref, _ = _np_int8_ef_reference(
+        cs.astype(np.float64), steps, lr, chunk, world
+    )
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    # and EF actually converges to the optimum (mean of the c_i)
+    np.testing.assert_allclose(got, cs.mean(0), atol=0.05)
+
+
+def test_ef_residual_is_own_compression_error(mesh):
+    """One call: the returned residual equals p - C(p) (here p = g with a
+    zero incoming residual), i.e. re-compressing (g - residual) is
+    lossless."""
+    rng = np.random.RandomState(5)
+    g = jnp.asarray(rng.randn(N, 40).astype(np.float32))
+
+    def body(gs):
+        zero = jnp.zeros((40,), jnp.float32)
+        m, e = C.ef_compressed_pmean(
+            gs[0], zero, "data", mode="int8", chunk_size=8
+        )
+        # C(p) = p - e must quantize to itself: a second pass with the
+        # residual subtracted reproduces the same mean bit for bit
+        m2, e2 = C.ef_compressed_pmean(
+            gs[0] - e, jnp.zeros((40,), jnp.float32), "data",
+            mode="int8", chunk_size=8,
+        )
+        return m[None], m2[None], e[None], e2[None]
+
+    f = jax.jit(shmap(
+        mesh, body, (P("data"),),
+        (P("data"), P("data"), P("data"), P("data")),
+    ))
+    m, m2, e, e2 = f(g)
+    assert float(jnp.abs(e).max()) > 0, "quantization error must be captured"
+    np.testing.assert_allclose(np.asarray(m), np.asarray(m2), atol=1e-6)
+    assert float(jnp.abs(e2).max()) <= float(jnp.abs(e).max()) + 1e-6
+
+
+def test_ef_mode_none_passes_residual_through(mesh):
+    g = jnp.ones((N, 4), jnp.float32)
+
+    def body(gs):
+        r0 = jnp.full((4,), 7.0)
+        m, r = C.ef_compressed_pmean(gs[0], r0, "data", mode="none")
+        return m[None], r[None]
+
+    m, r = jax.jit(shmap(
+        mesh, body, (P("data"),), (P("data"), P("data")),
+    ))(g)
+    np.testing.assert_allclose(np.asarray(m), 1.0)
+    np.testing.assert_allclose(np.asarray(r), 7.0)
+
+
+# ---------------------------------------------------------------------------
+# shuffle-sharded variant
+
+
+def test_shuffle_sharded_psum_matches_psum(mesh):
+    rng = np.random.RandomState(6)
+    tree = _tree(rng)
+    ref = {
+        k: np.tile(np.asarray(v).sum(0, keepdims=True), (N, 1))
+        for k, v in tree.items()
+    }
+    for mode, tol in (("none", 1e-5), ("bf16", 0.15), ("int8", 1.0)):
+        f = jax.jit(shmap(
+            mesh,
+            lambda t, m=mode: C.shuffle_sharded_psum(t, "data", mode=m),
+            (_SPECS,), _SPECS,
+        ))
+        out = f(tree)
+        for k in tree:
+            np.testing.assert_allclose(
+                np.asarray(out[k]), ref[k], atol=tol,
+            ), (mode, k)
+
+
+def test_shuffle_sharded_hlo_is_collective_permutes(mesh):
+    """mode='none' shuffle-sharding must be ppermute-only (the DS-Sync
+    schedule), never an all-reduce/all-gather."""
+    x = jnp.ones((N, 64), jnp.float32)
+    f = jax.jit(shmap(
+        mesh, lambda t: C.shuffle_sharded_psum(t, "data", mode="none"),
+        (P("data"),), P("data"),
+    ))
+    hlo = f.lower(x).compile().as_text()
+    assert not re.findall(r" all-reduce(?:-start)?\(", hlo)
+    assert not re.findall(r" all-gather(?:-start)?\(", hlo)
+    assert re.findall(r" collective-permute(?:-start)?\(", hlo)
+
+
+def test_shuffle_sharded_num_shards_and_world1():
+    with pytest.raises(ValueError, match="num_shards"):
+        # validation is trace-time; reach it through an abstract trace
+        mesh = runtime.data_parallel_mesh()
+        jax.make_jaxpr(shard_map(
+            lambda t: C.shuffle_sharded_psum(t, "data", num_shards=0),
+            mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+        ))(jnp.ones((N, 4)))
+
+
+# ---------------------------------------------------------------------------
+# compressed reduce-scatter (the ZeRO path)
+
+
+def test_compressed_reduce_scatter_modes(mesh):
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(N, N * 16).astype(np.float32))
+    full = np.asarray(x).sum(0)
+    span = float(np.asarray(x).max() - np.asarray(x).min())
+    for mode, tol in (
+        ("none", 1e-5), ("bf16", 0.05 * span), ("int8", span / 2 / 15),
+    ):
+        def body(xs, m=mode):
+            sh, res = C.compressed_reduce_scatter(
+                xs[0], "data", mode=m, want_residual=True
+            )
+            return sh[None], res[None]
+
+        sh, res = jax.jit(shmap(
+            mesh, body, (P("data"),), (P("data"), P("data")),
+        ))(x)
+        got = np.asarray(sh).reshape(-1)
+        np.testing.assert_allclose(got, full, atol=max(tol * N, 1e-4))
+        if mode == "none":
+            assert float(jnp.abs(res).max()) == 0.0
+
+
+def test_compressed_reduce_scatter_rejects_unshardable():
+    mesh = runtime.data_parallel_mesh()
+    with pytest.raises(ValueError, match="divide"):
+        jax.make_jaxpr(shard_map(
+            lambda x: C.compressed_reduce_scatter(
+                x[0], "data", mode="int8"
+            )[0][None],
+            mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+        ))(jnp.ones((N, 13)))
+
+
+# ---------------------------------------------------------------------------
+# reduce_moments stats modes
+
+
+def test_reduce_moments_compressed_keeps_count_exact(mesh):
+    rng = np.random.RandomState(8)
+    data = rng.randn(N, 16, 5).astype(np.float32)
+    flat = data.reshape(-1, 5)
+
+    def body(xs, m):
+        local = xs[0]
+        s = local.sum(0)
+        sq = (local * local).sum(0)
+        cnt = jnp.asarray(local.shape[0], jnp.float32)
+        mean, var, count = C.reduce_moments(s, sq, cnt, "data", mode=m)
+        return jnp.stack([mean, var, jnp.full_like(mean, count)])[None]
+
+    for mode, tol in (("bf16", 0.05), ("int8", 0.5)):
+        out = np.asarray(jax.jit(shmap(
+            mesh, lambda xs, m=mode: body(xs, m),
+            (P("data", None, None),), P("data", None, None),
+        ))(data))
+        np.testing.assert_allclose(out[0, 0], flat.mean(0), atol=tol)
+        # the census is NEVER lossy
+        np.testing.assert_array_equal(out[0, 2], np.full((5,), 128.0))
+
+
+def test_reduce_moments_rejects_group_scoped_compression(mesh):
+    with pytest.raises(ValueError, match="group_size"):
+        jax.make_jaxpr(shard_map(
+            lambda s: C.reduce_moments(
+                s[0], s[0], jnp.float32(1.0), "data",
+                group_size=2, mode="int8",
+            )[0][None],
+            mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+        ))(jnp.ones((N, 4)))
+
+
+# ---------------------------------------------------------------------------
+# wire-dtype byte tallies (the DispatchWireTally satellite)
+
+
+def _traced_delta(fn, *args):
+    before = C.traced_bytes_total()
+    jax.make_jaxpr(fn)(*args)
+    return C.traced_bytes_total() - before
+
+
+def test_tally_mixed_dtype_tree_counts_wire_itemsize(mesh):
+    """Regression pin: a mixed-dtype tree psum tallies each leaf at its
+    TRANSMITTED itemsize (f32=4, bf16=2, i32=4) — a bf16 leaf must not
+    count 4 bytes."""
+    telemetry.set_enabled(True)
+    try:
+        tree = {
+            "f": jnp.ones((N, 4), jnp.float32),
+            "h": jnp.ones((N, 8), jnp.bfloat16),
+            "i": jnp.ones((N, 2), jnp.int32),
+        }
+        specs = {"f": P("data"), "h": P("data"), "i": P("data")}
+        delta = _traced_delta(shard_map(
+            lambda t: C.psum(t, "data"),
+            mesh=mesh, in_specs=(specs,), out_specs=specs,
+        ), tree)
+        # per-shard payloads: 4*4 + 8*2 + 2*4 = 40 bytes
+        assert delta == 40, delta
+    finally:
+        telemetry.set_enabled(None)
+
+
+def test_tally_psum_in_groups_counts_fused_f32_payload(mesh):
+    """The wire-dtype fix: a bf16 tree through psum_in_groups transmits
+    the FUSED f32 payload — the tally must record 4 bytes/elem (the wire
+    dtype), not the 2 bytes/elem of the logical input."""
+    telemetry.set_enabled(True)
+    try:
+        x = jnp.ones((N, 16), jnp.bfloat16)
+        delta = _traced_delta(shard_map(
+            lambda t: C.psum_in_groups(t, "data", 2),
+            mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+        ), x)
+        # g=2: one butterfly stage, one ppermute of 16 f32 = 64 bytes
+        assert delta == 64, delta
+    finally:
+        telemetry.set_enabled(None)
+
+
+def test_tally_compressed_metrics(mesh):
+    """collectives.compressed_bytes counts the lossy wire payload; the
+    ratio gauge reads logical/wire."""
+    telemetry.set_enabled(True)
+    try:
+        x = jnp.ones((N, 256), jnp.float32)
+        jax.make_jaxpr(shard_map(
+            lambda t: C.compressed_pmean(t, "data", mode="int8"),
+            mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+        ))(x)
+        snap = telemetry.snapshot()
+        counters = snap["counters"]
+        assert counters.get("collectives.compressed_bytes", 0) >= 256
+        assert snap["gauges"]["collectives.compression_ratio"] >= 3.0
+    finally:
+        telemetry.set_enabled(None)

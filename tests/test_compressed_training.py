@@ -1,0 +1,282 @@
+"""Compressed-collective training semantics (ISSUE 12): the trainer-level
+contracts — error-feedback residual lifecycle (checkpoint round-trip,
+divergence rollback, restore_last_good zeroing), fused-scan parity, the
+ZeRO composition, GAN wiring, and the stats_compress opt-in."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import nnx
+
+from tpu_syncbn import nn as tnn
+from tpu_syncbn import parallel
+
+FEATURES, CLASSES, GLOBAL_BATCH = 8, 4, 16
+
+
+class Net(nnx.Module):
+    def __init__(self, rngs: nnx.Rngs):
+        self.fc1 = nnx.Linear(FEATURES, 16, rngs=rngs)
+        self.bn = tnn.BatchNorm1d(16)
+        self.fc2 = nnx.Linear(16, CLASSES, rngs=rngs)
+
+    def __call__(self, x):
+        return self.fc2(nnx.relu(self.bn(self.fc1(x))))
+
+
+def ce_loss(model, batch):
+    x, y = batch
+    logits = model(x)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+
+def make_dp(seed=0, **kw):
+    model = tnn.convert_sync_batchnorm(Net(nnx.Rngs(seed)))
+    return parallel.DataParallel(model, optax.sgd(0.05), ce_loss, **kw)
+
+
+def make_batch(dp, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(GLOBAL_BATCH, FEATURES).astype(np.float32)
+    y = rng.randint(0, CLASSES, GLOBAL_BATCH).astype(np.int32)
+    return jax.device_put((jnp.asarray(x), jnp.asarray(y)),
+                          dp.batch_sharding)
+
+
+def _residual_leaves(dp):
+    assert dp._ef, "trainer has no error-feedback state"
+    return [l for l in jax.tree_util.tree_leaves(dp.opt_state[1])
+            if l.size]
+
+
+# ---------------------------------------------------------------------------
+# trajectory sanity
+
+
+@pytest.mark.parametrize("kw", [
+    {"compress": "bf16"},
+    {"compress": "int8"},
+    {"compress": "int8", "error_feedback": False},
+    {"compress": "bf16", "error_feedback": True},
+])
+def test_compressed_training_tracks_fp32(kw):
+    """A short compressed run stays close to the fp32 trajectory and the
+    loss decreases — compression is a perturbation, not a derailment."""
+    ref = make_dp()
+    dp = make_dp(**kw)
+    batch = make_batch(ref)
+    ref_losses = [float(ref.train_step(batch).loss) for _ in range(8)]
+    losses = [float(dp.train_step(batch).loss) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+    assert abs(losses[-1] - ref_losses[-1]) < 0.05, (losses, ref_losses)
+
+
+def test_compress_validation_and_legacy_exclusion():
+    with pytest.raises(ValueError, match="compression mode"):
+        make_dp(compress="fp8")
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        make_dp(compress="bf16", grad_compression="bf16")
+    with pytest.raises(ValueError, match="error_feedback"):
+        make_dp(error_feedback=True)  # no lossy mode: nothing to feed back
+    # bf16 defaults EF off, int8 defaults EF on
+    assert not make_dp(compress="bf16")._ef
+    assert make_dp(compress="int8")._ef
+
+
+# ---------------------------------------------------------------------------
+# error-feedback residual lifecycle
+
+
+def test_residual_roundtrips_through_checkpoint(tmp_path):
+    from tpu_syncbn.utils import checkpoint as ckpt
+
+    dp = make_dp(compress="int8")
+    batch = make_batch(dp)
+    for _ in range(3):
+        dp.train_step(batch)
+    res = [np.asarray(l) for l in _residual_leaves(dp)]
+    assert any(np.abs(r).max() > 0 for r in res), "residual never captured"
+    ckpt.save_checkpoint(str(tmp_path), 3, dp.state_dict())
+
+    dp2 = make_dp(compress="int8", seed=1)
+    state, step = ckpt.load_checkpoint(str(tmp_path), dp2.state_dict())
+    assert step == 3
+    dp2.load_state_dict(state)
+    for a, b in zip(res, _residual_leaves(dp2)):
+        np.testing.assert_allclose(a, np.asarray(b))
+    # and training continues identically from the restored state
+    np.testing.assert_allclose(
+        float(dp.train_step(batch).loss), float(dp2.train_step(batch).loss),
+        rtol=1e-6,
+    )
+
+
+def test_reset_compression_residual():
+    dp = make_dp(compress="int8")
+    batch = make_batch(dp)
+    dp.train_step(batch)
+    assert any(float(jnp.abs(l).max()) > 0 for l in _residual_leaves(dp))
+    assert dp.reset_compression_residual()
+    assert all(float(jnp.abs(l).max()) == 0 for l in _residual_leaves(dp))
+    # fp32 trainer: nothing to reset
+    assert not make_dp().reset_compression_residual()
+
+
+def test_restore_last_good_zeroes_residual(tmp_path):
+    """The ResilientLoop divergence rollback must NOT replay the unwound
+    trajectory's compression error: restore, then residual == 0."""
+    from tpu_syncbn.runtime.resilience import ResilientLoop
+
+    dp = make_dp(compress="int8", divergence_guard="restore_last_good")
+    batch = make_batch(dp)
+    loop = ResilientLoop(dp, str(tmp_path), ckpt_every=100)
+    dp.train_step(batch)
+    loop.step = 1
+    loop.save()  # durable checkpoint WITH a nonzero residual
+    dp.train_step(batch)
+    assert any(float(jnp.abs(l).max()) > 0 for l in _residual_leaves(dp))
+    loop._restore_last_good()
+    assert all(float(jnp.abs(l).max()) == 0 for l in _residual_leaves(dp)), \
+        "restore_last_good must zero the error-feedback residual"
+    # ordinary resume keeps the checkpointed residual
+    dp2 = make_dp(compress="int8", divergence_guard="restore_last_good")
+    restored = parallel.resume_latest(dp2, str(tmp_path))
+    assert restored == 1
+    assert any(float(jnp.abs(l).max()) > 0 for l in _residual_leaves(dp2))
+
+
+def test_guard_skip_rolls_back_residual():
+    """A non-finite step is an exact skip: params, opt state AND the
+    error-feedback residual return to their pre-step values."""
+    dp = make_dp(compress="int8", divergence_guard="skip_step")
+    batch = make_batch(dp)
+    dp.train_step(batch)
+    params_before = jax.tree_util.tree_map(np.asarray, dp.params)
+    res_before = [np.asarray(l) for l in _residual_leaves(dp)]
+    x, y = batch
+    bad = (x.at[0, 0].set(jnp.nan), y)
+    out = dp.train_step(jax.device_put(bad, dp.batch_sharding))
+    assert float(out.metrics["nonfinite"]) == 1.0
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), b),
+        dp.params, params_before,
+    )
+    for a, b in zip(_residual_leaves(dp), res_before):
+        np.testing.assert_allclose(np.asarray(a), b)
+
+
+# ---------------------------------------------------------------------------
+# fused scan + ZeRO composition
+
+
+def test_train_steps_batches_parity_int8_ef():
+    """K fused compressed steps == K sequential train_step calls exactly
+    (the EF residual is a legal scan carry)."""
+    from tpu_syncbn.parallel import scan_driver
+
+    dp_seq = make_dp(compress="int8")
+    dp_fused = make_dp(compress="int8")
+    batches = [make_batch(dp_seq, seed=s) for s in range(3)]
+    seq = [float(dp_seq.train_step(b).loss) for b in batches]
+    stacked = jax.device_put(
+        scan_driver.stack_batches([jax.device_get(b) for b in batches]),
+        dp_fused.scan_batch_sharding,
+    )
+    out = dp_fused.train_steps_batches(stacked)
+    np.testing.assert_allclose(np.asarray(out.loss), seq, rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        dp_seq.params, dp_fused.params,
+    )
+    for a, b in zip(_residual_leaves(dp_seq), _residual_leaves(dp_fused)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_zero_compressed_trains():
+    """compress='int8' composes with the ZeRO reduce-scatter path: the
+    residual is per-dtype-group flat state and the loss still falls."""
+    dp = make_dp(compress="int8", zero=True, divergence_guard="skip_step")
+    batch = make_batch(dp)
+    losses = [float(dp.train_step(batch).loss) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+    assert any(float(jnp.abs(l).max()) > 0 for l in _residual_leaves(dp))
+    # state round-trips in zero mode too
+    sd = dp.state_dict()
+    dp2 = make_dp(compress="int8", zero=True, divergence_guard="skip_step",
+                  seed=1)
+    dp2.load_state_dict(sd)
+    np.testing.assert_allclose(
+        float(dp.train_step(batch).loss), float(dp2.train_step(batch).loss),
+        rtol=1e-6,
+    )
+
+
+# ---------------------------------------------------------------------------
+# GAN + stats_compress wiring
+
+
+def test_gan_compress_modes_smoke():
+    class G(nnx.Module):
+        def __init__(self, rngs):
+            self.fc = nnx.Linear(4, FEATURES, rngs=rngs)
+            self.bn = tnn.BatchNorm1d(FEATURES)
+
+        def __call__(self, z):
+            return self.bn(self.fc(z))
+
+    class D(nnx.Module):
+        def __init__(self, rngs):
+            self.fc = nnx.Linear(FEATURES, 1, rngs=rngs)
+            self.bn = tnn.BatchNorm1d(1)
+
+        def __call__(self, x):
+            return self.bn(self.fc(x))
+
+    with pytest.raises(ValueError, match="compression mode"):
+        parallel.GANTrainer(
+            tnn.convert_sync_batchnorm(G(nnx.Rngs(0))),
+            tnn.convert_sync_batchnorm(D(nnx.Rngs(1))),
+            optax.adam(1e-4), optax.adam(1e-4), compress="fp4",
+        )
+    gan = parallel.GANTrainer(
+        tnn.convert_sync_batchnorm(G(nnx.Rngs(0))),
+        tnn.convert_sync_batchnorm(D(nnx.Rngs(1))),
+        optax.adam(1e-4), optax.adam(1e-4), compress="bf16",
+    )
+    rng = np.random.RandomState(0)
+    real = jax.device_put(
+        jnp.asarray(rng.randn(GLOBAL_BATCH, FEATURES).astype(np.float32)),
+        gan.batch_sharding,
+    )
+    z = jax.device_put(
+        jnp.asarray(rng.randn(GLOBAL_BATCH, 4).astype(np.float32)),
+        gan.batch_sharding,
+    )
+    out = gan.train_step(real, z, z)
+    assert np.isfinite(float(out.d_loss)) and np.isfinite(float(out.g_loss))
+
+
+def test_stats_compress_opt_in():
+    # plain BN rejects the knob (it never syncs)
+    with pytest.raises(ValueError, match="plain BatchNorm"):
+        tnn.BatchNorm1d(FEATURES, stats_compress="bf16")
+    with pytest.raises(ValueError, match="compression mode"):
+        tnn.convert_sync_batchnorm(Net(nnx.Rngs(0)), stats_compress="fp8")
+    model = tnn.convert_sync_batchnorm(
+        Net(nnx.Rngs(0)), stats_compress="bf16"
+    )
+    assert model.bn.stats_compress == "bf16"
+    dp = parallel.DataParallel(model, optax.sgd(0.05), ce_loss)
+    batch = make_batch(dp)
+    losses = [float(dp.train_step(batch).loss) for _ in range(4)]
+    assert losses[-1] < losses[0]
+    # compressed stats stay replica-identical (psum'd), so the 'auto'
+    # buffer broadcast skip still applies
+    assert not dp._per_step_broadcast

@@ -71,6 +71,18 @@ _FEATURES = 8
 _LATENT = 4
 
 
+def lossy_collective_bytes(contract: ProgramContract) -> int:
+    """The ISSUE 12 'lossy-eligible' wire bytes of a program: every
+    collective byte except the ``pmin`` family — the divergence guard's
+    finiteness consensus is pinned exact-fp32 and excluded from the
+    compression claim on both sides of the ratio. ONE predicate shared
+    by ``check_invariants`` and bench's ``collectives`` block, so the
+    contract invariant and the BASELINE-anchored ratio can't drift
+    apart."""
+    return sum(v for k, v in contract.collective_bytes.items()
+               if k != "pmin")
+
+
 def default_golden_dir() -> str:
     """``tests/contracts/`` next to the package — valid for in-repo use
     (the CLI accepts ``--contracts-dir`` for anything else)."""
@@ -146,6 +158,28 @@ def _tiny_gan():
 
 def _mse(m, b):
     return (m(b) ** 2).mean()
+
+
+def _compress_mlp():
+    """Wider BN-free MLP for the compressed-collective programs: the
+    gradient payload (~2.2k params) dominates, and with no BatchStat
+    buffers every byte in the program is either gradient/loss payload
+    (lossy-eligible) or the guard's fp32 pmin (pinned exact) — which is
+    what makes the ≥2×/≥3.5× bytes-on-wire invariant sharp instead of
+    diluted by fixture constants. The SyncBN stats path has its own
+    pinned program (``syncbn.compressed_stats``)."""
+    import jax.numpy as jnp
+    from flax import nnx
+
+    class MLP(nnx.Module):
+        def __init__(self, rngs):
+            self.fc1 = nnx.Linear(_FEATURES, 16 * _FEATURES, rngs=rngs)
+            self.fc2 = nnx.Linear(16 * _FEATURES, _FEATURES, rngs=rngs)
+
+        def __call__(self, x):
+            return self.fc2(jnp.tanh(self.fc1(x)))
+
+    return MLP(nnx.Rngs(0))
 
 
 def _batch_struct(*lead):
@@ -238,6 +272,80 @@ def _dp_scan(k: int) -> ProgramSpec:
         mesh=dp.mesh,
         in_specs=(dp._pspec, dp._rest_spec, dp._opt_spec,
                   scan_driver.stack_batch_spec(P(dp.axis_name))),
+    )
+
+
+def _dp_compressed_train_step(mode: str) -> ProgramSpec:
+    """The ISSUE 12 trio: the same wide-MLP DataParallel train step at
+    wire mode fp32 (``compress="none"``), bf16, and int8 — divergence
+    guard armed on all three so every golden pins the guard's exact-fp32
+    ``pmin`` next to the compressed gradient payload. The bf16/int8
+    goldens' bytes-on-wire sit ≥2× / ≥3.5× below the fp32 golden
+    (``contract.compression_ratio`` enforces the ratio live)."""
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_syncbn import parallel
+
+    compress = "none" if mode == "fp32" else mode
+    dp = parallel.DataParallel(
+        _compress_mlp(), optax.sgd(0.1, momentum=0.9), _mse,
+        compress=compress, divergence_guard="skip_step",
+    )
+    return ProgramSpec(
+        name=f"dataparallel.compressed_{mode}.train_step",
+        fn=dp._train_step,
+        example_args=(dp._param_store, dp.rest, dp.opt_state,
+                      _batch_struct(_GLOBAL_BATCH)),
+        arg_labels=("params", "rest", "opt_state", "batch"),
+        # the BN-free fixture's `rest` is an EMPTY tree — the trainer
+        # still donates the argnum, but a zero-leaf arg has nothing to
+        # alias, so declaring it would trip donation_lost vacuously
+        declared_donated=("params", "opt_state"),
+        world=dp.world,
+        mesh=dp.mesh,
+        in_specs=(dp._pspec, dp._rest_spec, dp._opt_spec,
+                  P(dp.axis_name)),
+    )
+
+
+def _syncbn_compressed_stats() -> ProgramSpec:
+    """The compressed SyncBN moment reduction in isolation: (sum, sumsq)
+    ride the bf16 wire, the count census stays an exact fp32 psum — the
+    'stats compressed independently, count never lossy' contract as a
+    pinned program."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_syncbn.compat import shard_map
+    from tpu_syncbn.parallel import collectives
+    from tpu_syncbn.runtime.distributed import DATA_AXIS
+
+    mesh = _axis_mesh(DATA_AXIS)
+    world = int(mesh.shape[DATA_AXIS])
+
+    def body(s, sq, c):
+        mean, var, count = collectives.reduce_moments(
+            s[0], sq[0], c[0], DATA_AXIS, mode="bf16"
+        )
+        return jnp.stack([mean, var])[None], count[None]
+
+    in_specs = (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
+    fn = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=in_specs,
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+    ))
+    sds = jax.ShapeDtypeStruct
+    args = (
+        sds((world, _FEATURES), jnp.float32),
+        sds((world, _FEATURES), jnp.float32),
+        sds((world,), jnp.float32),
+    )
+    return ProgramSpec(
+        name="syncbn.compressed_stats", fn=fn, example_args=args,
+        arg_labels=("sum", "sumsq", "count"),
+        world=world, mesh=mesh, in_specs=in_specs,
     )
 
 
@@ -431,6 +539,13 @@ PROGRAM_BUILDERS: dict[str, Callable[[], ProgramSpec]] = {
     "dataparallel.zero_guard.train_step": _dp_zero_guard_train_step,
     "dataparallel.scan_k1.train_steps": lambda: _dp_scan(1),
     "dataparallel.scan_k4.train_steps": lambda: _dp_scan(4),
+    "dataparallel.compressed_fp32.train_step":
+        lambda: _dp_compressed_train_step("fp32"),
+    "dataparallel.compressed_bf16.train_step":
+        lambda: _dp_compressed_train_step("bf16"),
+    "dataparallel.compressed_int8.train_step":
+        lambda: _dp_compressed_train_step("int8"),
+    "syncbn.compressed_stats": _syncbn_compressed_stats,
     "gan.train_step": _gan_train_step,
     "serve.eval_bucket8": _serve_eval_bucket,
     "tensor.tp_mlp": _tensor_tp_mlp,
@@ -514,6 +629,44 @@ def check_invariants(
         v("contract.moe_two_all_to_all",
           "expert-parallel MoE relocates compute with exactly TWO "
           f"all_to_alls (dispatch + return), found {moe.collectives}")
+
+    fp32c = contracts.get("dataparallel.compressed_fp32.train_step")
+    if fp32c is not None:
+        lossy_bytes = lossy_collective_bytes
+        for mode, factor in (("bf16", 2.0), ("int8", 3.5)):
+            c = contracts.get(f"dataparallel.compressed_{mode}.train_step")
+            if c is None:
+                continue
+            ratio = lossy_bytes(fp32c) / max(1, lossy_bytes(c))
+            if ratio < factor:
+                v("contract.compression_ratio",
+                  f"compressed_{mode} train step puts "
+                  f"{lossy_bytes(c)} lossy-eligible bytes on the wire vs "
+                  f"{lossy_bytes(fp32c)} fp32 — ratio {ratio:.2f} < the "
+                  f"ISSUE 12 floor {factor}× (quantization stopped "
+                  "reaching the wire, or fp32 payload leaked in)")
+            if (c.collectives.get("pmin", 0) !=
+                    fp32c.collectives.get("pmin", 0)
+                    or c.collective_bytes.get("pmin", 0) !=
+                    fp32c.collective_bytes.get("pmin", 0)):
+                v("contract.guard_stays_fp32",
+                  f"compressed_{mode} train step's divergence-guard "
+                  f"pmin ({c.collectives.get('pmin', 0)} call(s), "
+                  f"{c.collective_bytes.get('pmin', 0)} B) differs from "
+                  f"the fp32 program's — the finiteness consensus must "
+                  "never ride a lossy wire (lossy_default_mode's "
+                  "runtime counterpart)")
+
+    stats = contracts.get("syncbn.compressed_stats")
+    if stats is not None and not stats.collectives.get("pmax"):
+        # the compressed stat reduction carries its quantize/cast wiring
+        # plus the exact count psum; bf16 mode has no pmax, so assert the
+        # psum split instead: at least 2 psum calls (payload + count)
+        if stats.collectives.get("psum", 0) < 2:
+            v("contract.stats_count_exact",
+              "syncbn.compressed_stats must reduce the count census "
+              "through its own exact psum next to the compressed "
+              f"payload, found {stats.collectives}")
 
     for name, c in contracts.items():
         for label in c.donated_declared:

@@ -1005,6 +1005,63 @@ def check_hardcoded_mesh_axis(
 
 
 # ---------------------------------------------------------------------------
+# rule: lossy_default_mode
+
+#: Parameter names that carry a wire-compression mode anywhere in the
+#: stack (``collectives.compressed_*``, the trainers' ``compress=``,
+#: SyncBN's ``stats_compress=``).
+_LOSSY_MODE_PARAMS = frozenset({
+    "mode", "compress", "stats_compress", "compress_stats",
+    "grad_compression",
+})
+#: The lossy wire dtypes. ``"none"``/``None``/``"fp32"`` defaults are
+#: clean; these as a DEFAULT are the hazard.
+_LOSSY_MODE_LITERALS = frozenset({"bf16", "int8"})
+
+
+def check_lossy_default_mode(
+    tree: ast.AST, path: str, src_lines: Sequence[str]
+) -> list[Violation]:
+    """``lossy_default_mode``: a compression-mode parameter whose
+    *default* value is a lossy wire dtype (``"bf16"``/``"int8"``).
+
+    ISSUE 12's safety contract: lossy collectives are opt-in at every
+    call site — the divergence guard's pmin/finiteness consensus and
+    SyncBN's moment/count reductions must never ride a quantized wire
+    because a caller forgot to pass a flag. A lossy default IS that
+    silent routing: every existing caller changes numerics without a
+    diff at the call site. Defaults must stay ``"none"`` (or ``None``);
+    lossy modes are passed explicitly. The companion contract invariant
+    (``contract.guard_stays_fp32``) pins the same property in the traced
+    programs."""
+    out: list[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        pos = list(node.args.posonlyargs) + list(node.args.args)
+        pairs = list(zip(
+            pos[len(pos) - len(node.args.defaults):], node.args.defaults,
+        )) + list(zip(node.args.kwonlyargs, node.args.kw_defaults))
+        for arg, default in pairs:
+            if (
+                arg.arg in _LOSSY_MODE_PARAMS
+                and isinstance(default, ast.Constant)
+                and default.value in _LOSSY_MODE_LITERALS
+            ):
+                out.append(Violation(
+                    rule="lossy_default_mode", path=path,
+                    line=default.lineno, col=default.col_offset,
+                    message=f"parameter {arg.arg!r} of {node.name!r} "
+                            f"defaults to lossy mode "
+                            f"{default.value!r} — wire compression must "
+                            "be explicit opt-in (default 'none'); a "
+                            "lossy default silently re-routes every "
+                            "caller, including guard/stat collectives",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # driver
 
 RULES: dict[str, Callable] = {
@@ -1017,6 +1074,7 @@ RULES: dict[str, Callable] = {
     "wallclock_duration": check_wallclock_duration,
     "unbounded_blocking": check_unbounded_blocking,
     "hardcoded_mesh_axis": check_hardcoded_mesh_axis,
+    "lossy_default_mode": check_lossy_default_mode,
 }
 
 

@@ -22,11 +22,17 @@ from __future__ import annotations
 from flax import nnx
 
 from tpu_syncbn.nn.normalization import BatchNorm, SyncBatchNorm
-from tpu_syncbn.parallel.collectives import normalize_group_spec
+from tpu_syncbn.parallel.collectives import (
+    check_compress_mode,
+    normalize_group_spec,
+)
 from tpu_syncbn.runtime.distributed import DATA_AXIS
 
 
-def _convert_one(bn: BatchNorm, axis_name: str, group_size=None) -> SyncBatchNorm:
+def _convert_one(
+    bn: BatchNorm, axis_name: str, group_size=None,
+    stats_compress: str = "none",
+) -> SyncBatchNorm:
     out = SyncBatchNorm(
         bn.num_features,
         eps=bn.eps,
@@ -36,6 +42,7 @@ def _convert_one(bn: BatchNorm, axis_name: str, group_size=None) -> SyncBatchNor
         channel_axis=bn.channel_axis,
         axis_name=axis_name,
         group_size=group_size,
+        stats_compress=stats_compress,
     )
     # Share (not copy) variables — the torch converter moves the same
     # Parameter/buffer objects onto the new module
@@ -49,7 +56,8 @@ def _convert_one(bn: BatchNorm, axis_name: str, group_size=None) -> SyncBatchNor
     return out
 
 
-def _swap_in_container(value, axis_name: str, group_size=None):
+def _swap_in_container(value, axis_name: str, group_size=None,
+                       stats_compress: str = "none"):
     """Swap BN→SyncBN inside ``value``; returns ``value`` itself (same
     object identity) when nothing needed converting."""
     if isinstance(value, SyncBatchNorm):
@@ -58,18 +66,22 @@ def _swap_in_container(value, axis_name: str, group_size=None):
         # in place rather than leaving a mixed-scope model silently.
         value.axis_name = axis_name
         value.group_size = group_size
+        value.stats_compress = stats_compress
         return value
     if isinstance(value, BatchNorm):
-        return _convert_one(value, axis_name, group_size)
+        return _convert_one(value, axis_name, group_size, stats_compress)
     if isinstance(value, (list, tuple)):
-        new = [_swap_in_container(v, axis_name, group_size) for v in value]
+        new = [_swap_in_container(v, axis_name, group_size,
+                                  stats_compress) for v in value]
         if all(a is b for a, b in zip(new, value)):
             return value
         if isinstance(value, tuple) and hasattr(value, "_fields"):  # namedtuple
             return type(value)(*new)
         return type(value)(new)
     if isinstance(value, dict):
-        new = {k: _swap_in_container(v, axis_name, group_size) for k, v in value.items()}
+        new = {k: _swap_in_container(v, axis_name, group_size,
+                                     stats_compress)
+               for k, v in value.items()}
         if all(new[k] is value[k] for k in value):
             return value
         return new
@@ -79,6 +91,7 @@ def _swap_in_container(value, axis_name: str, group_size=None):
 def convert_sync_batchnorm(
     module: nnx.Module, axis_name: str = DATA_AXIS,
     group_size: int | tuple | None = None,
+    stats_compress: str = "none",
 ):
     """Recursively replace BatchNorm modules with SyncBatchNorm.
 
@@ -90,13 +103,18 @@ def convert_sync_batchnorm(
     and (optionally) which replicas sync together — an int for
     contiguous subgroups of that size, or an explicit rank partition
     like ``((0, 3, 5), (1, 2, 4, 6, 7))`` for torch's arbitrary rank
-    sets.
+    sets. ``stats_compress`` opts the moment reduction into a lossy wire
+    dtype (``"bf16"``/``"int8"``; docs/PERFORMANCE.md "Compressed
+    collectives") — the safe default keeps stats exact fp32, independent
+    of any ``DataParallel(compress=...)`` gradient compression.
     """
     # same canonical form BatchNorm.__init__ applies — the in-place
     # rewrite path (value.group_size = ...) bypasses init
     group_size = normalize_group_spec(group_size)
+    check_compress_mode(stats_compress)
     if isinstance(module, BatchNorm):
-        return _swap_in_container(module, axis_name, group_size)
+        return _swap_in_container(module, axis_name, group_size,
+                                  stats_compress)
     seen = set()
     for _path, node in nnx.iter_graph(module):
         if not isinstance(node, nnx.Module) or id(node) in seen:
@@ -107,13 +125,15 @@ def convert_sync_batchnorm(
             # nodes; those are rewritten through the owning module's
             # vars() walk below instead
             for i in range(len(node)):
-                new = _swap_in_container(node[i], axis_name, group_size)
+                new = _swap_in_container(node[i], axis_name, group_size,
+                                           stats_compress)
                 if new is not node[i]:
                     node[i] = new
             continue
         if isinstance(node, getattr(nnx, "Dict", ())):
             for k in list(node):
-                new = _swap_in_container(node[k], axis_name, group_size)
+                new = _swap_in_container(node[k], axis_name, group_size,
+                                           stats_compress)
                 if new is not node[k]:
                     node[k] = new
             continue
@@ -123,7 +143,8 @@ def convert_sync_batchnorm(
             # bookkeeping attribute is off-limits.
             if attr == "_object__state":
                 continue
-            new = _swap_in_container(value, axis_name, group_size)
+            new = _swap_in_container(value, axis_name, group_size,
+                                     stats_compress)
             if new is not value:
                 setattr(node, attr, new)
     return module

@@ -28,7 +28,10 @@ import jax.numpy as jnp
 from flax import nnx
 
 from tpu_syncbn.ops import batch_norm as bn_ops
-from tpu_syncbn.parallel.collectives import normalize_group_spec
+from tpu_syncbn.parallel.collectives import (
+    check_compress_mode,
+    normalize_group_spec,
+)
 from tpu_syncbn.runtime.distributed import DATA_AXIS
 
 
@@ -69,19 +72,23 @@ class BatchNorm(nnx.Module):
         channel_axis: int = -1,
         axis_name: str | None = None,
         group_size: int | tuple | None = None,
+        stats_compress: str = "none",
         dtype: jnp.dtype = jnp.float32,
         rngs: nnx.Rngs | None = None,  # unused; accepted for nnx idiom
     ):
-        if (axis_name is not None or group_size is not None) and not isinstance(
-            self, SyncBatchNorm
-        ):
+        if (
+            axis_name is not None
+            or group_size is not None
+            or stats_compress != "none"
+        ) and not isinstance(self, SyncBatchNorm):
             # Plain BN never syncs (that per-replica behavior is the bug
             # the reference exists to fix, README.md:3); accepting sync
             # parameters here and ignoring them would silently reintroduce it.
             raise ValueError(
                 "plain BatchNorm does not sync across replicas; use "
                 "SyncBatchNorm (or convert_sync_batchnorm) for "
-                f"axis_name={axis_name!r} / group_size={group_size!r}"
+                f"axis_name={axis_name!r} / group_size={group_size!r} / "
+                f"stats_compress={stats_compress!r}"
             )
         self.num_features = num_features
         self.eps = eps
@@ -95,6 +102,12 @@ class BatchNorm(nnx.Module):
         # tuples, stable under jit caching; membership is validated
         # against the axis size at trace time (psum_in_groups)
         self.group_size = normalize_group_spec(group_size)
+        #: wire dtype of the cross-replica moment reduction — stats stay
+        #: exact fp32 unless EXPLICITLY opted into a lossy mode,
+        #: independently of any gradient compression the trainer applies
+        #: (the count census always stays fp32 either way —
+        #: collectives.reduce_moments)
+        self.stats_compress = check_compress_mode(stats_compress)
         self.use_running_average = False
         if affine:
             # torch init: weight=1, bias=0 ([torch] nn/modules/batchnorm.py reset_parameters)
@@ -159,6 +172,9 @@ class BatchNorm(nnx.Module):
             channel_axis=self.channel_axis,
             axis_name=self._sync_axis(),
             group_size=self.group_size if self._sync_axis() else None,
+            stats_compress=(
+                self.stats_compress if self._sync_axis() else "none"
+            ),
             mask=mask,
         )
         if self.track_running_stats:
@@ -222,6 +238,7 @@ class SyncBatchNorm(BatchNorm):
     def convert_sync_batchnorm(
         cls, module, axis_name: str = DATA_AXIS,
         group_size: int | tuple | None = None,
+        stats_compress: str = "none",
     ):
         """Drop-in spelling parity with
         ``torch.nn.SyncBatchNorm.convert_sync_batchnorm(module,
@@ -229,7 +246,9 @@ class SyncBatchNorm(BatchNorm):
         delegates to :func:`tpu_syncbn.nn.convert_sync_batchnorm`."""
         from tpu_syncbn.nn.convert import convert_sync_batchnorm
 
-        return convert_sync_batchnorm(module, axis_name, group_size)
+        return convert_sync_batchnorm(
+            module, axis_name, group_size, stats_compress
+        )
 
     def _sync_axis(self) -> str | None:
         # torch's need_sync requires self.training ([torch] nn/modules/

@@ -205,6 +205,7 @@ def sync_moments(
     channel_axis: int = -1,
     axis_name: str | None = None,
     group_size: int | tuple | None = None,
+    stats_compress: str = "none",
     mask: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Per-channel (mean, biased var, count) over the batch — cross-replica
@@ -231,7 +232,10 @@ def sync_moments(
         count = jnp.sum(mf, axis=axes)  # per-channel (all equal when the
         # mask has channel-axis size 1); reduce_moments handles either form
     if axis_name is not None:
-        return reduce_moments(s, sq, count, axis_name, group_size=group_size)
+        return reduce_moments(
+            s, sq, count, axis_name, group_size=group_size,
+            mode=stats_compress,
+        )
     mean, var = moments_from_stats(s, sq, count)
     return mean, var, count
 
@@ -320,6 +324,7 @@ def batch_norm_train(
     channel_axis: int = -1,
     axis_name: str | None = None,
     group_size: int | tuple | None = None,
+    stats_compress: str = "none",
     mask: jax.Array | None = None,
 ):
     """Full training-mode BN forward (optionally cross-replica synced).
@@ -341,7 +346,10 @@ def batch_norm_train(
     (``_functions.py:160-165``).
     """
     channel_last = channel_axis in (-1, x.ndim - 1)
-    if _use_pallas() and channel_last and mask is None and group_size is None:
+    if _use_pallas() and channel_last and mask is None \
+            and group_size is None and stats_compress == "none":
+        # (compressed stats keep the XLA path: the Pallas backward issues
+        # its own hand-written psum, which must stay exact)
         # fused Pallas fast path (ops.pallas_bn): one-pass stats kernel,
         # folded normalize, hand-derived backward issuing the reference's
         # exact collectives
@@ -353,7 +361,8 @@ def batch_norm_train(
     else:
         mean, var, count = sync_moments(
             x, channel_axis=channel_axis, axis_name=axis_name,
-            group_size=group_size, mask=mask,
+            group_size=group_size, stats_compress=stats_compress,
+            mask=mask,
         )
         y = batch_norm_elemt(
             x, mean, var, weight, bias, eps, channel_axis=channel_axis

@@ -68,7 +68,15 @@ def _tally(op: str, tree: Pytree) -> None:
     Per-execution traffic is this estimate times the step count; the
     payload estimate is the mathematical per-replica input size
     (shape × itemsize), which for an all-reduce equals what ring
-    algorithms move within a factor of 2(N-1)/N."""
+    algorithms move within a factor of 2(N-1)/N.
+
+    Tally at the TRANSMISSION site with the array that actually moves:
+    byte counts are shape × itemsize of the tallied leaves, so a helper
+    that re-packs its input before the wire (``psum_in_groups`` fusing a
+    bf16 tree into one f32 payload, the quantized paths below sending
+    int8) must tally the packed/quantized payload, not its logical
+    input — otherwise the inventory reports the logical itemsize while
+    the wire carries a different one."""
     if not telemetry.enabled():
         return
     nbytes = 0
@@ -376,12 +384,16 @@ def psum_in_groups(
             acc = flat
             for k in range(1, f):
                 perm = _stage_perm(groups, stride, f, k)
+                # wire payload is the fused f32 vector, NOT the caller's
+                # tree — tally what each exchange actually transmits
+                _tally("ppermute", flat)
                 acc = acc + lax.ppermute(flat, axis_name, perm)
             flat = acc
             stride *= f
         summed = flat
     else:
         # masked gather: every replica sees every row, sums its group's
+        _tally("all_gather", flat)  # wire dtype: the fused f32 payload
         gathered = lax.all_gather(flat, axis_name)  # (world, payload)
         member = [[0.0] * world for _ in range(world)]
         for g in groups:
@@ -440,12 +452,14 @@ def ring_all_reduce(
     # N-1 steps it owns the complete sum of chunk (me + 1) % n
     acc = jnp.take(chunks, me, axis=0)
     for s in range(1, n):
+        _tally("ppermute", acc)  # each hop moves one 1/N chunk
         acc = lax.ppermute(acc, axis_name, fwd)
         acc = acc + jnp.take(chunks, (me - s) % n, axis=0)
     # all-gather: circulate each finished chunk around the ring
     gathered = [acc]
     cur = acc
     for _ in range(n - 1):
+        _tally("ppermute", cur)
         cur = lax.ppermute(cur, axis_name, fwd)
         gathered.append(cur)
     # device me received chunk (me - s + 1) % n at gather step s; restore
@@ -465,6 +479,7 @@ def reduce_moments(
     axis_name: str = DATA_AXIS,
     *,
     group_size: int | tuple | None = None,
+    mode: str = "none",
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Count-weighted global moments from per-replica partial sums.
 
@@ -477,6 +492,15 @@ def reduce_moments(
     collective instead of an all_gather + recombine, and is exact for
     empty shards (they contribute zeros, matching ``:195-205``).
 
+    ``mode`` (default ``"none"`` — stats stay exact fp32) opts the
+    (sum, sumsq) payload into a lossy wire dtype via
+    :func:`compressed_psum`; the **count always rides fp32** — it feeds
+    the safe-divide and the empty-shard semantics, and quantizing an
+    integer census would corrupt uneven-shard correctness for a handful
+    of saved bytes. Lossy stats cannot be scoped to subgroups
+    (``group_size``): the butterfly path re-fuses payloads at f32, so
+    combining the two flags raises instead of silently un-compressing.
+
     Args:
       local_sum:   per-channel sum of x over this replica's local elements.
       local_sumsq: per-channel sum of x² over this replica's local elements.
@@ -487,13 +511,26 @@ def reduce_moments(
       *biased* (1/N) variance — what BN normalizes with; the unbiased
       running-var correction is the caller's job (see ops.batch_norm).
     """
+    check_compress_mode(mode)
     triple = (local_sum, local_sumsq, local_count)
     if group_size is not None:
+        if mode != "none":
+            raise ValueError(
+                "compressed SyncBN stats (mode="
+                f"{mode!r}) cannot be combined with group_size="
+                f"{group_size!r}: the group butterfly re-fuses payloads "
+                "at f32 — sync the full axis or keep stats exact"
+            )
         total_sum, total_sumsq, total_count = psum_in_groups(
             triple, axis_name, group_size
         )
+    elif mode != "none":
+        total_sum, total_sumsq = compressed_psum(
+            (local_sum, local_sumsq), axis_name, mode=mode
+        )
+        total_count = psum(local_count, axis_name)
     else:
-        total_sum, total_sumsq, total_count = lax.psum(triple, axis_name)
+        total_sum, total_sumsq, total_count = psum(triple, axis_name)
     mean, var = moments_from_stats(total_sum, total_sumsq, total_count)
     return mean, var, total_count
 
@@ -509,6 +546,453 @@ def moments_from_stats(
     mean = s / safe
     var = jnp.maximum(sq / safe - mean * mean, 0.0)
     return mean, var
+
+
+# ---------------------------------------------------------------------------
+# compressed collectives (EQuARX-style quantized all-reduce, arxiv
+# 2506.17615; DS-Sync shuffle-sharding, arxiv 2007.03298)
+
+#: Wire-compression modes accepted by every ``compressed_*`` entry point
+#: (and the trainers' ``compress=``): ``"none"`` exact fp32, ``"bf16"``
+#: dtype-cast (2 B/elem), ``"int8"`` chunk-quantized (1 B/elem + one
+#: fp32 scale/zero-point pair per chunk).
+COMPRESS_MODES = ("none", "bf16", "int8")
+
+#: Elements per quantization chunk: one (scale, zero-point) pair is
+#: shared by this many consecutive elements of the fused payload. 256
+#: keeps the fp32 side-channel at 8/256 ≈ 3% of the int8 payload while
+#: bounding the blast radius of one outlier element to its own chunk.
+DEFAULT_CHUNK_ELEMS = 256
+
+
+def check_compress_mode(mode: str) -> str:
+    if mode not in COMPRESS_MODES:
+        raise ValueError(
+            f"compression mode must be one of {COMPRESS_MODES}, got {mode!r}"
+        )
+    return mode
+
+
+def _tally_compressed(logical_bytes: int, wire_bytes: int) -> None:
+    """Trace-time compression accounting (docs/OBSERVABILITY.md):
+    ``collectives.compressed_bytes`` counts what the lossy payloads put
+    on the wire; the gauge holds logical/wire for the most recent
+    compressed collective. The underlying psum/pmax calls tally their
+    own per-op bytes at the wire dtype as usual."""
+    if not telemetry.enabled():
+        return
+    telemetry.count("collectives.compressed_bytes", int(wire_bytes))
+    telemetry.count(
+        "collectives.compressed_saved_bytes",
+        max(0, int(logical_bytes) - int(wire_bytes)),
+    )
+    if wire_bytes:
+        telemetry.set_gauge(
+            "collectives.compression_ratio", logical_bytes / wire_bytes
+        )
+
+
+def _nbytes(leaves) -> int:
+    return sum(
+        int(math.prod(tuple(l.shape))) * np.dtype(l.dtype).itemsize
+        for l in leaves
+    )
+
+
+def _split_float_leaves(tree: Pytree):
+    """(treedef, float-leaf list, float index list, all leaves): the
+    compressed paths quantize floating leaves and move anything else
+    (int flags, counters) through an exact psum."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    fidx = [i for i, l in enumerate(leaves)
+            if jnp.issubdtype(jnp.dtype(l.dtype), jnp.floating)]
+    return treedef, [leaves[i] for i in fidx], fidx, leaves
+
+
+def _fuse_f32(leaves) -> jax.Array:
+    """Fuse leaves into ONE flat f32 payload (quantization chunks then
+    span leaf boundaries — per-chunk ranges stay local to 256 elements
+    regardless of layer shapes)."""
+    parts = [jnp.ravel(l).astype(jnp.float32) for l in leaves]
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
+def _unfuse(flat: jax.Array, like_leaves, *, cast: bool = True):
+    out, offset = [], 0
+    for l in like_leaves:
+        n = int(math.prod(tuple(l.shape)))
+        piece = flat[offset:offset + n].reshape(tuple(l.shape))
+        out.append(piece.astype(l.dtype) if cast else piece)
+        offset += n
+    return out
+
+
+def _reassemble(treedef, leaves, fidx, freduced, exact):
+    """Re-interleave the compressed-reduced float leaves and the
+    exactly-reduced non-float leaves back into the original tree order —
+    ONE implementation shared by :func:`compressed_psum` and
+    :func:`ef_compressed_pmean` so the interleave can't drift between
+    them."""
+    out = list(leaves)
+    fset = set(fidx)
+    for i, s in zip(fidx, freduced):
+        out[i] = s
+    it = iter(exact)
+    for i in range(len(out)):
+        if i not in fset:
+            out[i] = next(it)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _int8_qparams(
+    blocks: jax.Array, axis_name: str, world: int
+) -> tuple[jax.Array, jax.Array, jax.Array, int]:
+    """Shared-range asymmetric int8 quantization parameters for chunked
+    ``blocks`` (n_chunks, chunk).
+
+    The range is the WORLD range (one tiny fp32 ``pmax`` of the per-chunk
+    (-min, max) pairs), so every replica quantizes on the same grid and
+    the int8 payloads sum EXACTLY on the wire: per-element magnitudes
+    are budgeted to ``qmax = 127 // world``, hence a world-sum of
+    ``world × qmax ≤ 127`` — no overflow, and ``psum`` of the int8
+    payload is a legal s8 AllReduce whose result is bit-defined. The
+    log2(world) bits the budget costs are exactly what error feedback
+    (:func:`ef_compressed_pmean`) recovers across steps.
+
+    The budget vanishes at ``world > 127`` (``127 // world == 0``), so
+    int8 mode refuses such axes instead of letting a floored qmax wrap
+    the s8 accumulator — use ``"bf16"`` there, or reduce hierarchically
+    in subgroups."""
+    if world > 127:
+        raise ValueError(
+            f"int8 compression supports axis sizes up to 127, got "
+            f"{world}: the no-overflow element budget 127 // world is "
+            "zero, so world-sums would wrap int8 — use mode='bf16'"
+        )
+    n = blocks.shape[0]
+    lmin = blocks.min(axis=1)
+    lmax = blocks.max(axis=1)
+    stats = pmax(jnp.concatenate([-lmin, lmax]), axis_name)
+    gmin, gmax = -stats[:n], stats[n:]
+    zp = ((gmax + gmin) * 0.5)[:, None]
+    half = ((gmax - gmin) * 0.5)[:, None]
+    qmax = 127 // world
+    scale = jnp.where(half > 0, half / qmax, 1.0)
+    q = jnp.clip(
+        jnp.round((blocks - zp) / scale), -qmax, qmax
+    ).astype(jnp.int8)
+    return q, scale, zp, qmax
+
+
+def _chunk_pad(flat: jax.Array, chunk: int) -> jax.Array:
+    pad = (-flat.size) % chunk
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat
+
+
+def compressed_psum(
+    tree: Pytree,
+    axis_name: str = DATA_AXIS,
+    *,
+    mode: str,
+    chunk_size: int = DEFAULT_CHUNK_ELEMS,
+) -> Pytree:
+    """All-reduce with a compressed wire dtype — everything happens
+    inside the compiled step, so XLA schedules one quantize → AllReduce
+    → dequantize chain with no host involvement (EQuARX's framing:
+    compression as part of the collective, arxiv 2506.17615).
+
+    * ``"none"``  — plain exact :func:`psum` (one code path for callers).
+    * ``"bf16"``  — leaves cast to bfloat16 for the wire, summed in
+      bf16, cast back: 2× fewer bytes, exact when the addends and sums
+      are bf16-representable.
+    * ``"int8"``  — float leaves fused into one flat payload, chunk-wise
+      asymmetric quantization (shared world range per chunk via one tiny
+      fp32 ``pmax``; see :func:`_int8_qparams` for the overflow budget),
+      s8 AllReduce, dequantize: ~4× fewer bytes.
+
+    Non-float leaves (counts, flags) always ride an exact psum. Lossy
+    modes are *opt-in by signature* — there is no lossy default anywhere
+    in the package (the ``lossy_default_mode`` lint rule pins that), and
+    the divergence guard's pmin/finiteness collectives never route
+    through here."""
+    check_compress_mode(mode)
+    if mode == "none":
+        return psum(tree, axis_name)
+    treedef, fleaves, fidx, leaves = _split_float_leaves(tree)
+    if not fleaves:
+        return psum(tree, axis_name)
+    world = _compat_axis_size(axis_name)
+    logical = _nbytes(fleaves)
+    exact = [l for i, l in enumerate(leaves) if i not in set(fidx)]
+    if exact:
+        exact = psum(exact, axis_name)
+    if mode == "bf16":
+        cast = [l.astype(jnp.bfloat16) for l in fleaves]
+        _tally_compressed(logical, _nbytes(cast))
+        summed = psum(cast, axis_name)
+        fsummed = [s.astype(l.dtype) for s, l in zip(summed, fleaves)]
+    else:  # int8
+        flat = _chunk_pad(_fuse_f32(fleaves), chunk_size)
+        blocks = flat.reshape(-1, chunk_size)
+        q, scale, zp, _ = _int8_qparams(blocks, axis_name, world)
+        # wire = s8 payload + the fp32 (-min, max) pair per chunk the
+        # range pmax moves (8 B/chunk) — matches the traced contract
+        _tally_compressed(logical, q.size + 8 * q.shape[0])
+        sumq = psum(q, axis_name)
+        summed_flat = (
+            scale * sumq.astype(jnp.float32) + world * zp
+        ).reshape(-1)
+        fsummed = _unfuse(summed_flat, fleaves)
+    return _reassemble(treedef, leaves, fidx, fsummed, exact)
+
+
+def compressed_pmean(
+    tree: Pytree,
+    axis_name: str = DATA_AXIS,
+    *,
+    mode: str,
+    chunk_size: int = DEFAULT_CHUNK_ELEMS,
+) -> Pytree:
+    """:func:`compressed_psum` followed by the world-size divide — the
+    compressed form of DDP's gradient averaging. The divide happens
+    post-dequantize in the leaf dtype (for ``world`` a power of two it
+    is exact, so the bf16 parity pin holds through the mean)."""
+    world = _compat_axis_size(axis_name)
+    summed = compressed_psum(
+        tree, axis_name, mode=mode, chunk_size=chunk_size
+    )
+    # plain division, exactly like lax.pmean: float leaves keep their
+    # dtype (a weak-typed divisor), integer leaves promote to the float
+    # mean — casting back to int would silently truncate counts
+    return jax.tree_util.tree_map(lambda s: s / world, summed)
+
+
+def init_error_feedback(tree: Pytree) -> Pytree:
+    """Zero residual matching ``tree``'s float leaves (f32, same shapes;
+    non-float leaves carry a zero-size placeholder so the residual tree
+    keeps the gradient tree's structure)."""
+    def zero(l):
+        if jnp.issubdtype(jnp.dtype(l.dtype), jnp.floating):
+            return jnp.zeros(tuple(l.shape), jnp.float32)
+        return jnp.zeros((0,), jnp.float32)
+    return jax.tree_util.tree_map(zero, tree)
+
+
+def ef_compressed_pmean(
+    tree: Pytree,
+    residual: Pytree,
+    axis_name: str = DATA_AXIS,
+    *,
+    mode: str,
+    chunk_size: int = DEFAULT_CHUNK_ELEMS,
+) -> tuple[Pytree, Pytree]:
+    """Error-feedback compressed gradient mean (EF-SGD / 1-bit-Adam
+    lineage): each replica reduces ``p = g + e`` instead of ``g`` and
+    re-captures ``e' = p − C(p)`` — its own quantization error — so
+    compression error does NOT accumulate across steps (it is re-sent
+    until it lands). Returns ``(mean over replicas of C(p), e')``.
+
+    ``residual`` is per-replica state (every replica's error differs);
+    the trainers store it inside ``opt_state`` exactly like the PR 1
+    divergence-guard state, so it persists through checkpoints, rides
+    fused-scan carries, and is rolled back with everything else on a
+    guarded non-finite step. ``mode="none"`` degrades to the exact
+    :func:`pmean` with an untouched residual."""
+    check_compress_mode(mode)
+    if mode == "none":
+        return pmean(tree, axis_name), residual
+    treedef, fleaves, fidx, leaves = _split_float_leaves(tree)
+    if not fleaves:
+        return pmean(tree, axis_name), residual
+    world = _compat_axis_size(axis_name)
+    res_leaves = jax.tree_util.tree_leaves(residual)
+    if len(res_leaves) != len(leaves):
+        raise ValueError(
+            f"residual tree has {len(res_leaves)} leaves, expected "
+            f"{len(leaves)} (init with init_error_feedback)"
+        )
+    fres = [res_leaves[i] for i in fidx]
+    p = [g.astype(jnp.float32) + r for g, r in zip(fleaves, fres)]
+    logical = _nbytes(fleaves)
+    exact = [l for i, l in enumerate(leaves) if i not in set(fidx)]
+    if exact:
+        exact = pmean(exact, axis_name)
+    if mode == "bf16":
+        cast = [x.astype(jnp.bfloat16) for x in p]
+        _tally_compressed(logical, _nbytes(cast))
+        summed = psum(cast, axis_name)
+        fmean = [
+            (s.astype(jnp.float32) / world).astype(l.dtype)
+            for s, l in zip(summed, fleaves)
+        ]
+        new_res = [x - c.astype(jnp.float32) for x, c in zip(p, cast)]
+    else:  # int8
+        flat = _chunk_pad(_fuse_f32(p), chunk_size)
+        blocks = flat.reshape(-1, chunk_size)
+        q, scale, zp, _ = _int8_qparams(blocks, axis_name, world)
+        _tally_compressed(logical, q.size + 8 * q.shape[0])
+        own = scale * q.astype(jnp.float32) + zp  # this replica's C(p)
+        res_flat = (blocks - own).reshape(-1)
+        sumq = psum(q, axis_name)
+        mean_flat = (
+            (scale * sumq.astype(jnp.float32) + world * zp) / world
+        ).reshape(-1)
+        fmean = _unfuse(mean_flat, fleaves)
+        new_res = _unfuse(res_flat, p, cast=False)
+    res_out = list(res_leaves)
+    for i, r in zip(fidx, new_res):
+        res_out[i] = r
+    return (
+        _reassemble(treedef, leaves, fidx, fmean, exact),
+        jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(residual), res_out
+        ),
+    )
+
+
+def compressed_reduce_scatter(
+    x: jax.Array,
+    axis_name: str = DATA_AXIS,
+    *,
+    mode: str,
+    want_residual: bool = False,
+) -> tuple[jax.Array, jax.Array | None]:
+    """Compressed ReduceScatter for the ZeRO path: ``x`` is a flat
+    vector whose length divides by the world size (the ``FlatLayout``
+    invariant); returns ``(summed local shard as f32, residual)``.
+
+    int8 quantizes per scatter shard (the chunk boundaries ARE the
+    shard boundaries, so each device dequantizes its own shard with one
+    locally-selected scale/zero-point pair); the same shared-range
+    overflow budget as :func:`compressed_psum` makes the s8
+    ReduceScatter exact on the wire. ``want_residual`` additionally
+    returns this replica's full-size compression error (f32, shape of
+    ``x``) for error feedback — under ZeRO the residual is inherently
+    per-replica and full-size (1× params in f32 per device; EF's known
+    memory cost)."""
+    check_compress_mode(mode)
+    world = _compat_axis_size(axis_name)
+    if x.size % world:
+        raise ValueError(
+            f"payload size {x.size} must divide by the axis size {world}"
+        )
+    xf = x.astype(jnp.float32)
+    if mode == "none":
+        return reduce_scatter(xf, axis_name), (
+            jnp.zeros_like(xf) if want_residual else None
+        )
+    logical = xf.size * 4
+    if mode == "bf16":
+        cast = xf.astype(jnp.bfloat16)
+        _tally_compressed(logical, cast.size * 2)
+        shard = reduce_scatter(cast, axis_name).astype(jnp.float32)
+        res = xf - cast.astype(jnp.float32) if want_residual else None
+        return shard, res
+    # int8: one quantization chunk per scatter shard
+    blocks = xf.reshape(world, -1)
+    q, scale, zp, _ = _int8_qparams(blocks, axis_name, world)
+    _tally_compressed(logical, q.size + 8 * world)
+    sumq = reduce_scatter(q.reshape(-1), axis_name)
+    me = lax.axis_index(axis_name)
+    s_me = jnp.take(scale[:, 0], me)
+    zp_me = jnp.take(zp[:, 0], me)
+    shard = s_me * sumq.astype(jnp.float32) + world * zp_me
+    res = None
+    if want_residual:
+        own = scale * q.astype(jnp.float32) + zp
+        res = (blocks - own).reshape(-1)
+    return shard, res
+
+
+def shuffle_sharded_psum(
+    tree: Pytree,
+    axis_name: str = DATA_AXIS,
+    *,
+    num_shards: int | None = None,
+    mode: str = "none",
+    chunk_size: int = DEFAULT_CHUNK_ELEMS,
+) -> Pytree:
+    """DS-Sync-style shuffle-sharded all-reduce for large trees (arxiv
+    2007.03298): the fused payload is partitioned into ``num_shards``
+    shards, and each shard is reduced by its own mixed-radix butterfly
+    of ``ppermute``s built over a DIFFERENT rank ordering (the full-world
+    group rotated by the shard index, through the same
+    :func:`_stage_perm` machinery as :func:`psum_in_groups`). Every
+    shard's exchange schedule therefore uses different neighbor links at
+    each stage — the divide-and-shuffle idea: same total bytes as one
+    butterfly, but the per-stage traffic spreads across the torus links
+    instead of serializing on one ring, which is what helps when the
+    tree is large enough to be bandwidth-bound on a single schedule.
+
+    Composes with the wire modes: ``"bf16"`` runs the butterflies on the
+    bf16 payload; ``"int8"`` quantizes once up front (shared world range,
+    the usual ``127 // world`` element budget, so int8 partial sums stay
+    exact through every stage) and dequantizes once at the end.
+
+    Exact for ``"none"`` (pinned against ``lax.psum``); the result is
+    numerically identical on every replica but typed device-varying —
+    callers inside ``shard_map`` should declare a varying out-spec or
+    re-reduce, which is why the trainers wire :func:`compressed_pmean`
+    (unvarying by construction) rather than this variant."""
+    check_compress_mode(mode)
+    world = _compat_axis_size(axis_name)
+    if world == 1:
+        return tree
+    shards = world if num_shards is None else int(num_shards)
+    if shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {shards}")
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    flat = _fuse_f32(leaves)
+    logical = flat.size * 4
+    scale = zp = None
+    if mode == "bf16":
+        payload = flat.astype(jnp.bfloat16)
+        _tally_compressed(logical, payload.size * 2)
+    elif mode == "int8":
+        blocks = _chunk_pad(flat, chunk_size).reshape(-1, chunk_size)
+        q, scale, zp, _ = _int8_qparams(blocks, axis_name, world)
+        payload = q.reshape(-1)
+        _tally_compressed(logical, payload.size + 8 * blocks.shape[0])
+    else:
+        payload = flat
+    payload_size = payload.size
+    pad = (-payload_size) % shards
+    if pad:
+        payload = jnp.concatenate(
+            [payload, jnp.zeros((pad,), payload.dtype)]
+        )
+    segs = payload.reshape(shards, -1)
+    factors = _prime_factors(world)
+    outs = []
+    for j in range(shards):
+        # shard j's butterfly runs over the world rotated by j: same
+        # stage count, different (src, dst) links every stage
+        order = tuple((r + j) % world for r in range(world))
+        seg = segs[j]
+        stride = 1
+        for f in factors:
+            acc = seg
+            for k in range(1, f):
+                perm = _stage_perm((order,), stride, f, k)
+                _tally("ppermute", seg)
+                acc = acc + lax.ppermute(seg, axis_name, perm)
+            seg = acc
+            stride *= f
+        outs.append(seg)
+    summed = jnp.concatenate(outs)[:payload_size]
+    if mode == "bf16":
+        summed_flat = summed.astype(jnp.float32)
+    elif mode == "int8":
+        summed_flat = (
+            scale * summed.reshape(-1, chunk_size).astype(jnp.float32)
+            + world * zp
+        ).reshape(-1)[:flat.size]
+    else:
+        summed_flat = summed
+    out = _unfuse(summed_flat, leaves)
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 # ---------------------------------------------------------------------------

@@ -70,14 +70,26 @@ class GANTrainer:
         axis_name: str = DATA_AXIS,
         donate: bool = True,
         monitors: bool | str = True,
+        compress: str = "none",
     ):
         """``monitors`` (default True): compute per-network grad
         norms/non-finite counts and BN running-stat health inside the
         compiled step, returned via ``GANStepOutput.monitors`` — same
         contract (including ``"full"`` per-layer keys and the
-        no-extra-host-sync guarantee) as ``DataParallel(monitors=...)``."""
+        no-extra-host-sync guarantee) as ``DataParallel(monitors=...)``.
+
+        ``compress`` (default ``"none"``): wire dtype of BOTH networks'
+        gradient all-reduce (docs/PERFORMANCE.md "Compressed
+        collectives"). Stateless here — error feedback is a
+        ``DataParallel`` feature (the GAN step's 6-way replicated state
+        layout has no per-replica slot; int8 without EF is a larger
+        per-step perturbation, so prefer ``"bf16"`` for GANs). Losses,
+        D/G probability metrics, and BN-stat buffer broadcasts stay
+        exact."""
         if loss not in LOSSES:
             raise ValueError(f"loss must be one of {sorted(LOSSES)}, got {loss!r}")
+        collectives.check_compress_mode(compress)
+        self.compress = compress
         if monitors not in (True, False, "full"):
             raise ValueError(
                 f"monitors must be True, False, or 'full', got {monitors!r}"
@@ -132,6 +144,13 @@ class GANTrainer:
         g_def, d_def = self.g_def, self.d_def
         loss_pair = self.loss_pair
 
+        def grad_mean(grads):
+            if self.compress != "none":
+                return collectives.compressed_pmean(
+                    grads, axis, mode=self.compress
+                )
+            return collectives.pmean(grads, axis)
+
         def step(gp, gr, dp_, dr, og, od, real, z_d, z_g):
             # ---- D step ------------------------------------------------
             def d_loss_fn(dp_in, gr_in, dr_in):
@@ -155,7 +174,7 @@ class GANTrainer:
             (d_loss, (gr, dr, real_logits, fake_logits)), d_grads = (
                 jax.value_and_grad(d_loss_fn, has_aux=True)(dp_in, gr, dr)
             )
-            d_grads = collectives.pmean(d_grads, axis)
+            d_grads = grad_mean(d_grads)
             d_updates, od = self.d_opt.update(d_grads, od, dp_)
             dp_ = optax.apply_updates(dp_, d_updates)
 
@@ -176,7 +195,7 @@ class GANTrainer:
             (g_loss, (gr, dr)), g_grads = jax.value_and_grad(
                 g_loss_fn, has_aux=True
             )(gp_in, gr, dr)
-            g_grads = collectives.pmean(g_grads, axis)
+            g_grads = grad_mean(g_grads)
             g_updates, og = self.g_opt.update(g_grads, og, gp)
             gp = optax.apply_updates(gp, g_updates)
 

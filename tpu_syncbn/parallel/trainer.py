@@ -94,6 +94,7 @@ def _model_traces_pallas_bn(model: nnx.Module) -> bool:
             isinstance(node, BatchNorm)
             and node.channel_axis == -1
             and node.group_size is None
+            and getattr(node, "stats_compress", "none") == "none"
         ):
             return True
     return False
@@ -216,6 +217,8 @@ class DataParallel:
         donate: bool = True,
         remat: bool = False,
         grad_compression: str | None = None,
+        compress: str = "none",
+        error_feedback: bool | None = None,
         zero: bool = False,
         divergence_guard: str | None = None,
         monitors: bool | str = True,
@@ -229,7 +232,31 @@ class DataParallel:
         cross-replica all-reduce and back — DDP's
         ``bf16_compress_hook`` communication hook
         (``[torch] distributed/algorithms/ddp_comm_hooks``), halving the
-        gradient traffic over ICI/DCN at a small precision cost.
+        gradient traffic over ICI/DCN at a small precision cost. This is
+        the legacy stateless hook; prefer ``compress=``.
+
+        ``compress`` (default ``"none"``) opts the gradient all-reduce
+        into a compressed wire dtype (docs/PERFORMANCE.md "Compressed
+        collectives"): ``"bf16"`` halves, ``"int8"`` quarters the bytes
+        on the wire (chunk-quantized shared-range s8 AllReduce —
+        ``collectives.compressed_psum``). The step's loss/metric pmean
+        rides bf16 under any lossy mode (reporting scalars, not training
+        state); the divergence guard's pmin/finiteness collective and
+        SyncBN's count census ALWAYS stay exact fp32, and SyncBN moment
+        stats compress only via their own explicit opt-in
+        (``convert_sync_batchnorm(stats_compress=...)``) — never
+        implicitly with the gradients.
+
+        ``error_feedback`` (default: on for ``compress="int8"``, off for
+        ``"bf16"``) arms the persistent error-feedback residual: each
+        replica reduces ``grads + residual`` and re-captures its own
+        quantization error, so compression error is re-sent until it
+        lands instead of accumulating across steps. The residual is
+        per-replica state riding inside ``opt_state`` (like the
+        divergence-guard state), so it persists through checkpoints, is
+        rolled back on a guarded non-finite step, and is zeroed by
+        ``restore_last_good`` rollbacks (``reset_compression_residual``).
+        Memory cost: one f32 copy of the gradients per device.
 
         ``zero=True`` shards parameters and optimizer state across the
         data axis (ZeRO; beyond reference scope — DDP replicates both,
@@ -284,6 +311,27 @@ class DataParallel:
             raise ValueError(
                 f"grad_compression must be None or 'bf16', got {grad_compression!r}"
             )
+        collectives.check_compress_mode(compress)
+        if grad_compression is not None and compress != "none":
+            raise ValueError(
+                "grad_compression (legacy bf16 hook) and compress are "
+                "mutually exclusive — use compress='bf16'"
+            )
+        self.compress = compress
+        if error_feedback and compress == "none":
+            raise ValueError(
+                "error_feedback=True needs a lossy compress mode "
+                "('bf16'/'int8') — there is no compression error to "
+                "feed back on the exact fp32 wire"
+            )
+        #: error feedback defaults on only where the quantization error
+        #: is large enough to matter (int8's shared-range budget); bf16
+        #: rounding is benign and the residual costs params-sized f32
+        #: state per device
+        self._ef = compress != "none" and (
+            error_feedback if error_feedback is not None
+            else compress == "int8"
+        )
         if broadcast_buffers not in (True, False, "auto"):
             raise ValueError(
                 "broadcast_buffers must be True, False, or 'auto', got "
@@ -397,6 +445,31 @@ class DataParallel:
                 )
             # non-zero mode: _opt_spec is the single prefix spec P(),
             # which covers the (opt_state, guard) tuple unchanged
+        if self._ef:
+            # error-feedback residual rides OUTSIDE the guard wrap in
+            # opt_state: (inner_opt[, guard], residual). Per-replica
+            # state (every replica's quantization error differs), stored
+            # honestly with a leading world axis sharded on the data
+            # axis — the broadcast_buffers=False storage pattern.
+            if self.zero:
+                res0 = {
+                    dt: jnp.zeros(
+                        (self.world,
+                         n if jnp.issubdtype(jnp.dtype(dt), jnp.floating)
+                         else 0),
+                        jnp.float32,
+                    )
+                    for dt, n in self._layout.padded.items()
+                }
+            else:
+                res0 = jax.tree_util.tree_map(
+                    lambda z: jnp.zeros((self.world,) + z.shape, z.dtype),
+                    collectives.init_error_feedback(params),
+                )
+            self.opt_state = (
+                self.opt_state, jax.device_put(res0, self._per_replica)
+            )
+            self._opt_spec = (self._opt_spec, P(axis_name))
         if broadcast_buffers:
             self.rest = jax.device_put(self.rest, self._replicated)
         else:
@@ -492,6 +565,12 @@ class DataParallel:
         def step(pstore, rest, opt_state, batch):
             monitors: dict = {}
             guard_in = None
+            ef_in = ef_out = None
+            if self._ef:
+                # residual rides outermost in opt_state; strip the
+                # per-replica storage axis of 1 (like honest buffers)
+                opt_state, ef_stored = opt_state
+                ef_in = jax.tree_util.tree_map(lambda x: x[0], ef_stored)
             if self.divergence_guard is not None:
                 opt_state, guard_in = opt_state
             pstore_in, opt_in = pstore, opt_state
@@ -559,8 +638,16 @@ class DataParallel:
                 loss = jnp.mean(losses)
                 metrics = jax.tree_util.tree_map(jnp.mean, metricses)
 
-            loss = collectives.pmean(loss, axis)
-            metrics = collectives.pmean(metrics, axis)
+            if self.compress != "none":
+                # reporting scalars ride the wire in bf16 under any
+                # lossy mode — they are telemetry, not training state
+                loss = collectives.compressed_pmean(loss, axis, mode="bf16")
+                metrics = collectives.compressed_pmean(
+                    metrics, axis, mode="bf16"
+                )
+            else:
+                loss = collectives.pmean(loss, axis)
+                metrics = collectives.pmean(metrics, axis)
 
             ok = None
             if guard_in is not None:
@@ -581,8 +668,26 @@ class DataParallel:
                 # all-reduce DDP would issue, and the optimizer only
                 # needs this device's shard
                 flat_g = self._layout.flatten(grads)
+                new_ef: dict = {}
 
-                def scatter(g):
+                def scatter(dt, g):
+                    floating = jnp.issubdtype(g.dtype, jnp.floating)
+                    if self.compress != "none" and floating:
+                        # compressed reduce-scatter (one quantization
+                        # chunk per scatter shard); with EF the residual
+                        # is re-sent with the next step's gradients
+                        p = g.astype(jnp.float32)
+                        if self._ef:
+                            p = p + ef_in[dt]
+                        shard, res = collectives.compressed_reduce_scatter(
+                            p, axis, mode=self.compress,
+                            want_residual=self._ef,
+                        )
+                        if self._ef:
+                            new_ef[dt] = res
+                        return (shard / self.world).astype(g.dtype)
+                    if self._ef:
+                        new_ef[dt] = ef_in[dt]  # exact group: no error
                     if self.grad_compression == "bf16":
                         d = g.dtype
                         g = collectives.reduce_scatter(
@@ -592,7 +697,9 @@ class DataParallel:
                         g = collectives.reduce_scatter(g, axis)
                     return g / self.world
 
-                gshard = {dt: scatter(g) for dt, g in flat_g.items()}
+                gshard = {dt: scatter(dt, g) for dt, g in flat_g.items()}
+                if self._ef:
+                    ef_out = new_ef
                 if self.monitors:
                     # shards only: one scalar device-side psum globalizes
                     monitors.update(obs_stepstats.grad_monitors(
@@ -609,7 +716,15 @@ class DataParallel:
                 pstore = optax.apply_updates(pstore, updates)
             else:
                 # DDP gradient averaging: one compiler-scheduled all-reduce
-                if self.grad_compression == "bf16":
+                if self._ef:
+                    grads, ef_out = collectives.ef_compressed_pmean(
+                        grads, ef_in, axis, mode=self.compress
+                    )
+                elif self.compress != "none":
+                    grads = collectives.compressed_pmean(
+                        grads, axis, mode=self.compress
+                    )
+                elif self.grad_compression == "bf16":
                     # bf16_compress_hook parity: halve the wire traffic
                     dtypes = jax.tree_util.tree_map(lambda g: g.dtype, grads)
                     grads = jax.tree_util.tree_map(
@@ -649,6 +764,10 @@ class DataParallel:
                 pstore = sel(pstore, pstore_in)
                 opt_state = sel(opt_state, opt_in)
                 rest = sel(rest, rest_in)
+                if ef_out is not None:
+                    # a skipped step must not consume the residual: the
+                    # gradients it absorbed never reached the weights
+                    ef_out = sel(ef_out, ef_in)
                 notok_i = 1 - ok.astype(jnp.int32)
                 lr_scale = guard_in["lr_scale"]
                 if self.divergence_guard == "halve_lr":
@@ -692,6 +811,13 @@ class DataParallel:
                 if self._check_vma:
                     rest = _pcast_varying(rest, axis)
                 rest = jax.tree_util.tree_map(lambda x: x[None], rest)
+            if self._ef:
+                # re-stack the per-replica residual (honest P(data)
+                # storage, stable scan carry) and re-wrap outermost
+                if self._check_vma:
+                    ef_out = _pcast_varying(ef_out, axis)
+                ef_out = jax.tree_util.tree_map(lambda x: x[None], ef_out)
+                opt_state = (opt_state, ef_out)
             return pstore, rest, opt_state, loss, metrics, monitors
 
         return step
@@ -846,6 +972,22 @@ class DataParallel:
         else:
             self._param_store = jax.device_put(tree, self._replicated)
 
+    def reset_compression_residual(self) -> bool:
+        """Zero the error-feedback residual (no-op without one; returns
+        whether there was state to reset). Called by
+        ``ResilientLoop._restore_last_good``: after a divergence
+        rollback the restored checkpoint's residual encodes compression
+        error of a gradient trajectory that has been UNWOUND — re-sending
+        it would inject stale updates into the recovered run. Ordinary
+        resume keeps the checkpointed residual (it belongs to the
+        trajectory being continued)."""
+        if not self._ef:
+            return False
+        inner, ef = self.opt_state
+        zero = jax.tree_util.tree_map(jnp.zeros_like, ef)
+        self.opt_state = (inner, jax.device_put(zero, self._per_replica))
+        return True
+
     def train_step(self, batch) -> StepOutput:
         """One optimizer step on a *global* batch (sharded or shardable
         along axis 0 across the mesh)."""
@@ -948,6 +1090,14 @@ class DataParallel:
                 is_leaf=lambda x: isinstance(x, P),
             )
             self.opt_state = jax.device_put(state["opt_state"], shardings)
+        elif self._ef:
+            # the residual is per-replica state: re-place it sharded on
+            # the data axis, everything inside it replicated
+            inner, ef = state["opt_state"]
+            self.opt_state = (
+                jax.device_put(inner, self._replicated),
+                jax.device_put(ef, self._per_replica),
+            )
         else:
             self.opt_state = jax.device_put(
                 state["opt_state"], self._replicated

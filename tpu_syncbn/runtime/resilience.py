@@ -613,6 +613,13 @@ class ResilientLoop:
             )
             return
         restored = resume_latest(self.trainer, self.ckpt_dir)
+        # the restored residual (compressed-collective error feedback)
+        # encodes quantization error of the unwound trajectory — zero it
+        # so the recovered run doesn't replay stale updates; an ordinary
+        # resume (no divergence) keeps the checkpointed residual
+        reset = getattr(self.trainer, "reset_compression_residual", None)
+        if callable(reset):
+            reset()
         self.counters.bump("divergence_restores")
         # not-ready until a finite step lands on the restored state
         # (cleared in run(); read by /readyz through readiness())
