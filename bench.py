@@ -905,6 +905,116 @@ def measure_incident(recorder, *, steps: int, wall_s: float,
     }
 
 
+def measure_numerics(publisher, monitors, *, steps: int, wall_s: float) -> dict:
+    """The ``numerics`` block of the bench line: the drift/compression-
+    health monitor family (docs/OBSERVABILITY.md "Numerics & drift"),
+    measured on the run's own state.
+
+    The publisher rode the timed loop (one non-blocking ``publish`` per
+    step next to ``flightrec.record_step``), so ``numerics.*``
+    histograms hold the loop's skew/dispersion series. This reports:
+
+    * ``monitors`` — the final step's numerics monitor values (the
+      skew/clip/residual series' endpoints);
+    * ``samples``/``published`` — registry sample count and how many
+      step records the loop's publisher emitted;
+    * ``record_step_cost_s`` / ``record_overhead_frac`` — the per-step
+      publish cost, micro-measured, over the measured average step time
+      (the ≤2% acceptance bound; ``numerics.record_overhead_frac`` is a
+      BASELINE.json ``--check-regression`` anchor);
+    * ``drift`` — a forced threshold crossing must produce exactly ONE
+      schema-valid ``numerics_drift`` incident bundle carrying the
+      pre-trigger step-monitor ring;
+    * ``rules`` — the ``numerics_rules()`` SLO rule names.
+
+    Schema pinned by tests/test_bench_tooling.py."""
+    import shutil
+    import tempfile
+
+    from tpu_syncbn.obs import (
+        flightrec, incident as incident_mod, numerics as obs_numerics,
+        telemetry,
+    )
+
+    publisher.flush()
+    final: dict = {}
+    for key in sorted(obs_numerics.PUBLISHED_MONITORS):
+        if isinstance(monitors, dict) and key in monitors:
+            try:
+                v = float(monitors[key])
+            except (TypeError, ValueError):
+                final[key] = None
+                continue
+            # non-finite values become strings: json.dumps would emit a
+            # bare NaN literal (invalid strict JSON) on exactly the
+            # divergent run where this block matters most — the same
+            # rule flightrec._scalarize applies to ring entries
+            finite = v == v and abs(v) != float("inf")
+            final[key] = round(v, 6) if finite else str(v)
+    # steady-state publish cost: plain-float monitors are ready by
+    # construction, so this times the queue + emit path itself. The 1000
+    # synthetic records go into a SCRATCH registry — flooding the live
+    # one would dilute numerics.samples ~300x and pin the histograms at
+    # 0 in every later snapshot (incident bundle, telemetry block)
+    probe = obs_numerics.NumericsPublisher(thresholds={})
+    sample = {k: 0.0 for k in ("bn_mean_skew", "bn_var_skew",
+                               "replica_grad_norm",
+                               "replica_grad_norm_disp")}
+    live_registry = telemetry.REGISTRY
+    telemetry.REGISTRY = telemetry.Registry()
+    try:
+        t0 = time.perf_counter()
+        for i in range(1000):
+            probe.publish(i, sample)
+        record_cost_s = (time.perf_counter() - t0) / 1000
+    finally:
+        telemetry.REGISTRY = live_registry
+    avg_step_s = wall_s / steps if steps else None
+    # forced drift: a publisher with a zero threshold must dump exactly
+    # one numerics_drift bundle whose step ring holds the loop's
+    # pre-trigger monitors
+    drift = None
+    rec = flightrec.get()
+    if rec is not None:
+        drift_dir = tempfile.mkdtemp(prefix="bench_numerics_")
+        prev_dir = rec.incident_dir
+        rec.incident_dir = drift_dir
+        try:
+            dpub = obs_numerics.NumericsPublisher(
+                thresholds={"bn_mean_skew": 0.0}
+            )
+            dpub.publish(steps, {"bn_mean_skew": 1.0})
+            names = [n for n in os.listdir(drift_dir)
+                     if n.endswith(".json")]
+            drift = {"bundles": len(names), "trigger": None,
+                     "ring_steps": 0, "valid": False}
+            if len(names) == 1:
+                bundle = incident_mod.load_bundle(
+                    os.path.join(drift_dir, names[0])
+                )  # schema-validates
+                drift = {
+                    "bundles": 1,
+                    "trigger": bundle["trigger"]["kind"],
+                    "ring_steps": len(bundle["rings"]["steps"]),
+                    "valid": bundle["trigger"]["kind"] == "numerics_drift",
+                }
+        finally:
+            rec.incident_dir = prev_dir
+            shutil.rmtree(drift_dir, ignore_errors=True)
+    snap = telemetry.snapshot()
+    return {
+        "monitors": final,
+        "samples": snap["counters"].get("numerics.samples", 0),
+        "published": publisher.published,
+        "record_step_cost_s": round(record_cost_s, 9),
+        "record_overhead_frac": (
+            round(record_cost_s / avg_step_s, 6) if avg_step_s else None
+        ),
+        "drift": drift,
+        "rules": [r.name for r in obs_numerics.numerics_rules()],
+    }
+
+
 def measure_audit(dp, batch) -> dict:
     """The ``audit`` block of the bench line: the static-analysis layer
     (docs/STATIC_ANALYSIS.md) run against THIS process — the package
@@ -1228,6 +1338,13 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
     recorder = flightrec.install(flightrec.FlightRecorder(
         aggregator=agg, incident_dir=incident_tmp,
     ))
+    # numerics publisher rides the timed loop next to record_step: the
+    # non-blocking is_ready drain fills the numerics.* registry
+    # histograms at step cadence (docs/OBSERVABILITY.md "Numerics &
+    # drift"); the numerics block below measures its per-step cost
+    from tpu_syncbn.obs import numerics as obs_numerics
+
+    numerics_pub = obs_numerics.NumericsPublisher()
 
     # instrumented loop: per-step "data_wait"/"step" spans + the
     # step.time_s histogram (host DISPATCH time per step — jax dispatch
@@ -1244,6 +1361,7 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
         # the incident block bounds this call's cost at ≤2% of a step)
         flightrec.record_step(si + 1, metrics=out.metrics,
                               monitors=out.monitors)
+        numerics_pub.publish(si + 1, out.monitors)
     fetch_sync(out.loss)  # the final loss value transitively forces
     # every step in the donated-state chain
     dt = time.perf_counter() - t0
@@ -1370,6 +1488,24 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
         log(f"monitor measurement failed: {type(e).__name__}: {e}")
         monitor_info = None
 
+    # numerics drift/compression-health layer measured on the run's own
+    # monitors (docs/OBSERVABILITY.md "Numerics & drift") — an
+    # annotation, never fatal to the metric. Runs BEFORE the incident
+    # block: its forced drift trigger is non-forced at the recorder, so
+    # it must land before a forced manual dump spends the cooldown.
+    try:
+        with stepstats.timed_span("numerics_bench", "bench.numerics_s"):
+            numerics_info = measure_numerics(
+                numerics_pub, out.monitors, steps=steps, wall_s=dt,
+            )
+        drift_ok = (numerics_info["drift"] or {}).get("valid")
+        log(f"numerics: {numerics_info['samples']} samples, record "
+            f"overhead {numerics_info['record_overhead_frac']}, drift "
+            f"bundle valid={drift_ok}")
+    except Exception as e:
+        log(f"numerics measurement failed: {type(e).__name__}: {e}")
+        numerics_info = None
+
     # flight recorder + incident bundle measured on the run's own state
     # (docs/OBSERVABILITY.md "Incidents & flight recorder") — an
     # annotation, never fatal to the metric
@@ -1492,6 +1628,13 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
         # overhead, and the explained-step-time attribution (shares sum
         # to 1.0); schema pinned by tests/test_bench_tooling.py
         "incident": incident_info,
+        # docs/OBSERVABILITY.md "Numerics & drift": the drift/
+        # compression-health monitor family — final skew/clip/residual
+        # values, publish cost (numerics.record_overhead_frac is a
+        # BASELINE anchor, ≤2% of step time), and the forced
+        # numerics_drift bundle proof; schema pinned by
+        # tests/test_bench_tooling.py
+        "numerics": numerics_info,
         # a fallback line is a liveness smoke signal, not a measurement
         # of anything the project tracks — cross-round diffs of it are
         # meaningless and tagged as such

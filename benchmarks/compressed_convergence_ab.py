@@ -101,6 +101,14 @@ def main():
             m(bx), by
         ).mean()
 
+    #: the compression-health monitor series each arm reports alongside
+    #: its loss curve (obs.numerics — docs/OBSERVABILITY.md "Numerics &
+    #: drift"): clip fraction and overflow headroom explain an int8 arm
+    #: that diverges via range saturation; the EF residual ratio shows
+    #: how much quantization error the residual is re-sending
+    HEALTH_KEYS = ("clip_fraction", "overflow_headroom",
+                   "ef_residual_ratio", "bn_mean_skew")
+
     def run(compress, error_feedback):
         model = nn.convert_sync_batchnorm(models.resnet18(
             num_classes=args.num_classes, small_input=True,
@@ -111,20 +119,27 @@ def main():
             compress=compress, error_feedback=error_feedback,
         )
         losses = []
+        health = {k: [] for k in HEALTH_KEYS}
         stream = batches()
         for _ in range(args.steps):
             bx, by = next(stream)
             batch = jax.device_put(
                 (jnp.asarray(bx), jnp.asarray(by)), dp.batch_sharding
             )
-            losses.append(float(dp.train_step(batch).loss))
-        return np.asarray(losses)
+            out = dp.train_step(batch)
+            losses.append(float(out.loss))
+            for k in HEALTH_KEYS:
+                if k in out.monitors:
+                    health[k].append(float(out.monitors[k]))
+        return np.asarray(losses), {k: v for k, v in health.items() if v}
 
-    arms = {"fp32": run("none", None)}
-    arms["int8_ef"] = run("int8", True)
+    arms_all = {"fp32": run("none", None)}
+    arms_all["int8_ef"] = run("int8", True)
     if not args.skip_ablation:
-        arms["int8_noef"] = run("int8", False)
-        arms["bf16"] = run("bf16", None)
+        arms_all["int8_noef"] = run("int8", False)
+        arms_all["bf16"] = run("bf16", None)
+    arms = {k: losses for k, (losses, _) in arms_all.items()}
+    healths = {k: h for k, (_, h) in arms_all.items()}
 
     ref = arms["fp32"]
 
@@ -132,6 +147,19 @@ def main():
         return float(np.abs(curve[:early] - ref[:early]).mean())
 
     maes = {k: round(mae(v), 6) for k, v in arms.items() if k != "fp32"}
+
+    def health_summary(series: dict) -> dict:
+        """Per-monitor {mean, max, final} over an arm's run — the
+        'WHY did this mode diverge' annotation next to its MAE."""
+        return {
+            k: {
+                "mean": round(float(np.mean(v)), 6),
+                "max": round(float(np.max(v)), 6),
+                "final": round(float(v[-1]), 6),
+            }
+            for k, v in series.items()
+        }
+
     result = {
         "metric": "compressed_grad_loss_curve_mae_vs_fp32",
         "replicas": R,
@@ -146,11 +174,23 @@ def main():
             if "int8_noef" in maes else None
         ),
         "final_loss": {k: round(float(v[-1]), 4) for k, v in arms.items()},
+        # per-arm compression-health summaries (obs.numerics): the
+        # convergence verdict plus its mechanism — e.g. an int8 arm
+        # whose MAE blew up with clip_fraction ~1 diverged by range
+        # saturation, not by quantization noise
+        "health": {k: health_summary(h) for k, h in healths.items()},
     }
     if args.curves:
         with open(args.curves, "w") as f:
             json.dump(
-                {**{k: v.tolist() for k, v in arms.items()}, **result}, f
+                {
+                    **{k: v.tolist() for k, v in arms.items()},
+                    "health_series": {
+                        k: {m: list(s) for m, s in h.items()}
+                        for k, h in healths.items()
+                    },
+                    **result,
+                }, f
             )
     print(json.dumps(result))
     if not result["within_tolerance"]:

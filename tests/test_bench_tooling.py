@@ -564,6 +564,9 @@ class TestTelemetryBlock:
         # the collectives block is always present (the compressed-
         # collective layer measured per wire mode — ISSUE 12)
         self._validate_collectives_block(line["collectives"])
+        # the numerics block is always present (the drift/compression-
+        # health monitors published through the timed loop — ISSUE 13)
+        self._validate_numerics_block(line["numerics"], steps=3)
         # the serve block is null unless --serve ran the sweep
         assert line["serve"] is None
         # the --trace file is valid Chrome trace JSON with the three
@@ -646,6 +649,40 @@ class TestTelemetryBlock:
         # floors of the ISSUE 12 invariant)
         assert block["golden_ratio"]["bf16"] >= 2.0
         assert block["golden_ratio"]["int8"] >= 3.5
+
+    @staticmethod
+    def _validate_numerics_block(block, *, steps):
+        """The schema-pinned `numerics` block (ISSUE 13): the drift/
+        compression-health layer measured on the run's own monitors —
+        the publish-cost bound is a BASELINE anchor (≤2% of step time)
+        and the forced drift must yield exactly one valid
+        numerics_drift bundle carrying the pre-trigger step ring."""
+        assert set(block) == {
+            "monitors", "samples", "published", "record_step_cost_s",
+            "record_overhead_frac", "drift", "rules",
+        }
+        # the loop's monitors were published and the skew family landed
+        assert block["published"] == steps
+        assert block["samples"] >= steps
+        mon = block["monitors"]
+        assert {"bn_mean_skew", "bn_var_skew", "replica_grad_norm",
+                "replica_grad_norm_disp"} <= set(mon)
+        for key, value in mon.items():
+            assert value is None or value == value, key  # no NaNs
+        # the ≤2% steady-state publish-cost acceptance bound
+        assert block["record_overhead_frac"] is not None
+        assert 0 <= block["record_overhead_frac"] <= 0.02
+        # forced drift: exactly ONE schema-valid numerics_drift bundle
+        # with the pre-trigger monitor ring
+        drift = block["drift"]
+        assert drift is not None
+        assert drift["bundles"] == 1
+        assert drift["trigger"] == "numerics_drift"
+        assert drift["valid"] is True
+        assert drift["ring_steps"] == steps
+        assert block["rules"] == [
+            "numerics_residual", "numerics_skew", "numerics_clip",
+        ]
 
     @staticmethod
     def _validate_incident_block(block, *, steps):

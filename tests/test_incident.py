@@ -596,6 +596,10 @@ class TestStatusz:
             },
             "circuits": {"serve.circuit_state": 2.0},
             "program_caches": {"serve": {"hits": 4, "misses": 2}},
+            "numerics": {
+                "numerics.bn_mean_skew": {"count": 12, "max": 0.5},
+            },
+            "numerics_counters": {"numerics.samples": 12},
             "last_incident": {
                 "id": "20260804T000000-h0-001-manual",
                 "trigger": "manual", "path": "/tmp/i.json",
@@ -625,6 +629,10 @@ class TestStatusz:
             "program caches\n"
             "  serve    hits=4 misses=2\n"
             "\n"
+            "numerics\n"
+            "  numerics.bn_mean_skew                count=12 max=0.5\n"
+            "  numerics.samples                     12\n"
+            "\n"
             "last incident\n"
             "  id=20260804T000000-h0-001-manual trigger=manual\n"
             "  path=/tmp/i.json\n"
@@ -634,6 +642,7 @@ class TestStatusz:
         text = obs_server.render_statusz({})
         assert "(none registered)" in text
         assert "(no SLO tracker attached)" in text
+        assert "(no numerics monitors published)" in text
         assert "set TPU_SYNCBN_FLIGHTREC=1" in text
 
     def test_endpoint_serves_live_state(self, tmp_path):
@@ -771,6 +780,19 @@ class TestMetricNameDrift:
                 ).read()
         obs_slo.SLOTracker(agg, [obs_slo.AlertRule(
             "drift_check", "step.time_s p99 < 60")]).evaluate(now=1.0)
+        # numerics (ISSUE 13): one published step exercising every
+        # counter family — a saturated clip fraction and a threshold
+        # crossing (drift_trips bumps even with no recorder installed)
+        from tpu_syncbn.obs import numerics as obs_numerics
+
+        obs_numerics.NumericsPublisher(
+            thresholds={"ef_residual_ratio": 0.1}
+        ).publish(1, {
+            "bn_mean_skew": 0.2, "bn_var_skew": 0.1,
+            "replica_grad_norm": 1.0, "replica_grad_norm_disp": 0.01,
+            "clip_fraction": 0.9, "overflow_headroom": 0.4,
+            "ef_residual_ratio": 0.2,
+        })
         # audit: the lint layer (pure ast — fast)
         audit_mod.run_audit(contracts=False)
         # incident: a forced bundle

@@ -40,10 +40,12 @@ PREFIX_RE = re.compile(r"^[a-z0-9_]+$")
 #: a docs/OBSERVABILITY.md table for it. ``obs`` / ``slo`` /
 #: ``monitor`` are ISSUE 8's live-monitoring families
 #: (``obs.server.*`` / ``obs.alert.*``, ``slo.*``,
-#: ``monitor.heartbeat_age_s`` — pinned in obs.server.MONITOR_METRICS).
+#: ``monitor.heartbeat_age_s`` — pinned in obs.server.MONITOR_METRICS);
+#: ``numerics`` is ISSUE 13's drift/compression-health family
+#: (``obs.numerics`` — docs/OBSERVABILITY.md "Numerics & drift").
 KNOWN_METRIC_PREFIXES = frozenset({
     "audit", "bench", "checkpoint", "collectives", "data", "events",
-    "gan", "incident", "loader", "monitor", "obs", "probe",
+    "gan", "incident", "loader", "monitor", "numerics", "obs", "probe",
     "rendezvous", "resilience", "scan", "serve", "slo", "step", "train",
 })
 

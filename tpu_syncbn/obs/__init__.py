@@ -13,6 +13,11 @@ Six modules, one budget rule — near-zero cost when off:
   data-wait / transfer / step timing seams, and on-device scalar
   monitors (grad norm, BN running-stat health, non-finite counts) that
   ride the compiled step's outputs so no extra device syncs are added.
+* :mod:`tpu_syncbn.obs.numerics` — cross-replica drift and
+  compression-health monitors computed inside the compiled step (one
+  fused scalar psum total), the non-blocking ``numerics.*`` registry
+  publisher, drift-triggered incident capture, and the numerics SLO
+  rule set (``numerics_rules``).
 * :mod:`tpu_syncbn.obs.timeseries` — windowed aggregation over the
   registry: ring buffer of per-interval deltas giving rolling rates
   (steps/s, req/s, bytes/s) and rolling-window p50/p99.
@@ -39,6 +44,7 @@ the live-monitoring quickstart.
 from tpu_syncbn.obs import (  # noqa: F401
     flightrec,
     incident,
+    numerics,
     server,
     slo,
     stepstats,
@@ -64,6 +70,7 @@ __all__ = [
     "telemetry",
     "tracing",
     "stepstats",
+    "numerics",
     "timeseries",
     "server",
     "slo",

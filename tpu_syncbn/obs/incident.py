@@ -54,7 +54,7 @@ MERGED_KIND = "tpu_syncbn.incident_merged"
 #: yields exactly one schema-valid bundle). Custom kinds are allowed
 #: (schema token form) — these are the wired ones.
 TRIGGER_KINDS = ("slo_alert", "divergence_restore", "watchdog_stall",
-                 "circuit_open", "manual")
+                 "circuit_open", "numerics_drift", "manual")
 
 _KIND_RE = re.compile(r"^[a-z0-9_]+$")
 

@@ -210,6 +210,17 @@ def statusz_report(
         family, sep, field = name.partition(".program_cache.")
         if sep:
             caches.setdefault(family, {})[field] = value
+    # numerics drift/compression health (obs.numerics — ISSUE 13): the
+    # published per-monitor histograms plus the sample/saturation/trip
+    # counters, so the drift story is on the one-glance page
+    numerics: dict[str, dict] = {}
+    for name, h in snap["histograms"].items():
+        if name.startswith("numerics."):
+            numerics[name] = {"count": h.get("count"), "max": h.get("max")}
+    numerics_counters = {
+        name: value for name, value in snap["counters"].items()
+        if name.startswith("numerics.")
+    }
     rec = flightrec.get()
     return {
         "heartbeat_age_s": {
@@ -219,6 +230,8 @@ def statusz_report(
         "alerts": obs_slo.tracker_states(),
         "circuits": circuits,
         "program_caches": caches,
+        "numerics": numerics,
+        "numerics_counters": numerics_counters,
         "train_step": snap["gauges"].get("train.step"),
         "last_incident": rec.last_incident if rec is not None else None,
         "recorder_installed": rec is not None,
@@ -288,6 +301,21 @@ def render_statusz(report: dict) -> str:
             lines.append(f"  {family:<8} {stats}")
     else:
         lines.append("  (none)")
+    lines.append("")
+    lines.append("numerics")
+    numerics = report.get("numerics") or {}
+    ncounters = report.get("numerics_counters") or {}
+    if numerics or ncounters:
+        for name, fields in sorted(numerics.items()):
+            mx = fields.get("max")
+            mx_s = f"{mx:g}" if isinstance(mx, (int, float)) else "-"
+            lines.append(
+                f"  {name:<36} count={fields.get('count', 0)} max={mx_s}"
+            )
+        for name, value in sorted(ncounters.items()):
+            lines.append(f"  {name:<36} {value}")
+    else:
+        lines.append("  (no numerics monitors published)")
     lines.append("")
     lines.append("last incident")
     inc = report.get("last_incident")

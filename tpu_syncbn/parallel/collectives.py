@@ -38,7 +38,7 @@ import numpy as np
 from jax import lax
 
 from tpu_syncbn.compat import axis_size as _compat_axis_size
-from tpu_syncbn.obs import telemetry
+from tpu_syncbn.obs import numerics as obs_numerics, telemetry
 from tpu_syncbn.runtime.distributed import DATA_AXIS
 
 Pytree = Any
@@ -532,6 +532,12 @@ def reduce_moments(
     else:
         total_sum, total_sumsq, total_count = psum(triple, axis_name)
     mean, var = moments_from_stats(total_sum, total_sumsq, total_count)
+    # numerics drift monitor (ISSUE 13): this replica's batch moments vs
+    # the just-synced global ones — local arithmetic after the existing
+    # psum, traced only while a trainer's monitor collector is active
+    obs_numerics.record_bn_skew(
+        local_sum, local_sumsq, local_count, mean, var
+    )
     return mean, var, total_count
 
 
@@ -681,7 +687,30 @@ def _int8_qparams(
     q = jnp.clip(
         jnp.round((blocks - zp) / scale), -qmax, qmax
     ).astype(jnp.int8)
+    if obs_numerics.active():
+        # compression-health monitor (ISSUE 13): fraction of elements
+        # sitting at the clip boundary ±qmax — a chunk whose mass pins
+        # the shared range edge is saturating, not quantizing. Traced
+        # only under an active monitor collector (local arithmetic).
+        at_limit = (jnp.abs(q.astype(jnp.int32)) >= qmax)
+        obs_numerics.record(
+            "clip_fraction", jnp.mean(at_limit.astype(jnp.float32))
+        )
     return q, scale, zp, qmax
+
+
+def _record_int8_headroom(sumq: jax.Array) -> None:
+    """Compression-health monitor (ISSUE 13): shared-range overflow
+    headroom of a world-summed int8 payload — 1 − max|Σq|/127. The
+    ``127 // world`` element budget guarantees this stays ≥ 0; a value
+    approaching 0 means the budget is fully consumed and any future
+    world growth would wrap the s8 accumulator. Local arithmetic on the
+    already-reduced payload; traced only under an active collector."""
+    if obs_numerics.active():
+        obs_numerics.record(
+            "overflow_headroom",
+            1.0 - jnp.max(jnp.abs(sumq.astype(jnp.float32))) / 127.0,
+        )
 
 
 def _chunk_pad(flat: jax.Array, chunk: int) -> jax.Array:
@@ -741,6 +770,7 @@ def compressed_psum(
         # range pmax moves (8 B/chunk) — matches the traced contract
         _tally_compressed(logical, q.size + 8 * q.shape[0])
         sumq = psum(q, axis_name)
+        _record_int8_headroom(sumq)
         summed_flat = (
             scale * sumq.astype(jnp.float32) + world * zp
         ).reshape(-1)
@@ -836,6 +866,7 @@ def ef_compressed_pmean(
         own = scale * q.astype(jnp.float32) + zp  # this replica's C(p)
         res_flat = (blocks - own).reshape(-1)
         sumq = psum(q, axis_name)
+        _record_int8_headroom(sumq)
         mean_flat = (
             (scale * sumq.astype(jnp.float32) + world * zp) / world
         ).reshape(-1)
@@ -895,6 +926,7 @@ def compressed_reduce_scatter(
     q, scale, zp, _ = _int8_qparams(blocks, axis_name, world)
     _tally_compressed(logical, q.size + 8 * world)
     sumq = reduce_scatter(q.reshape(-1), axis_name)
+    _record_int8_headroom(sumq)
     me = lax.axis_index(axis_name)
     s_me = jnp.take(scale[:, 0], me)
     zp_me = jnp.take(zp[:, 0], me)

@@ -31,6 +31,7 @@ from tpu_syncbn import compat
 from tpu_syncbn.compat import shard_map
 
 from tpu_syncbn.models.gan import bce_gan_losses, hinge_gan_losses
+from tpu_syncbn.obs import flightrec, numerics as obs_numerics
 from tpu_syncbn.parallel import collectives
 from tpu_syncbn.parallel.collectives import pcast_varying as _pcast_varying
 from tpu_syncbn.runtime import distributed as dist
@@ -125,6 +126,10 @@ class GANTrainer:
         self.g_opt_state = put(self.g_opt_state)
         self.d_opt_state = put(self.d_opt_state)
 
+        #: host-side iteration counter feeding the flight-recorder step
+        #: ring (one D+G update per count) — GAN incidents carry a step
+        #: history exactly like DataParallel/ResilientLoop runs
+        self.step_count = 0
         self._donate = bool(donate)
         self._step = self._build_step(donate)
         from tpu_syncbn.parallel import scan_driver
@@ -143,59 +148,84 @@ class GANTrainer:
         axis = self.axis_name
         g_def, d_def = self.g_def, self.d_def
         loss_pair = self.loss_pair
+        mon = bool(self.monitors)
 
         def grad_mean(grads):
-            if self.compress != "none":
-                return collectives.compressed_pmean(
-                    grads, axis, mode=self.compress
-                )
-            return collectives.pmean(grads, axis)
+            # the compressed paths record int8 clip fraction / overflow
+            # headroom into the active numerics collector
+            with obs_numerics.collect(enabled=mon) as col:
+                if self.compress != "none":
+                    reduced = collectives.compressed_pmean(
+                        grads, axis, mode=self.compress
+                    )
+                else:
+                    reduced = collectives.pmean(grads, axis)
+            return reduced, col.summary()
 
         def step(gp, gr, dp_, dr, og, od, real, z_d, z_g):
+            numx: dict = {}
+
             # ---- D step ------------------------------------------------
             def d_loss_fn(dp_in, gr_in, dr_in):
-                G = compat.nnx_merge(g_def, gp, gr_in, copy=True)
-                G.train()
-                fake = G(z_d)  # train-mode forward: G stats update
-                _, _, gr_out = nnx.split(G, nnx.Param, ...)
-                D = compat.nnx_merge(d_def, dp_in, dr_in, copy=True)
-                D.train()
-                real_logits = D(real)
-                fake_logits = D(jax.lax.stop_gradient(fake))
-                _, _, dr_out = nnx.split(D, nnx.Param, ...)
-                d_loss, _ = loss_pair(real_logits, fake_logits)
-                aux = (gr_out, dr_out, real_logits, fake_logits)
+                # the SyncBN forwards record batch-moment skew into the
+                # collector; it must live INSIDE the differentiated
+                # function and exit via aux (trainer.py has the VJP
+                # tracer-leak rationale)
+                with obs_numerics.collect(enabled=mon) as col:
+                    G = compat.nnx_merge(g_def, gp, gr_in, copy=True)
+                    G.train()
+                    fake = G(z_d)  # train-mode forward: G stats update
+                    _, _, gr_out = nnx.split(G, nnx.Param, ...)
+                    D = compat.nnx_merge(d_def, dp_in, dr_in, copy=True)
+                    D.train()
+                    real_logits = D(real)
+                    fake_logits = D(jax.lax.stop_gradient(fake))
+                    _, _, dr_out = nnx.split(D, nnx.Param, ...)
+                    d_loss, _ = loss_pair(real_logits, fake_logits)
+                aux = (gr_out, dr_out, real_logits, fake_logits,
+                       col.summary())
                 return d_loss, aux
 
             # varying-cast OUTSIDE the VJP so grads stay local and the
             # explicit pmean is the one aggregation (see trainer.py's
             # _microbatch_grads for the VMA transpose root cause)
             dp_in = _pcast_varying(dp_, axis) if self._check_vma else dp_
-            (d_loss, (gr, dr, real_logits, fake_logits)), d_grads = (
+            (d_loss, (gr, dr, real_logits, fake_logits, d_numx)), d_grads = (
                 jax.value_and_grad(d_loss_fn, has_aux=True)(dp_in, gr, dr)
             )
-            d_grads = grad_mean(d_grads)
+            if mon:
+                numx["d_replica_grad_norm"] = (
+                    obs_numerics.grad_norm_scalar(d_grads)
+                )
+            d_grads, d_cnumx = grad_mean(d_grads)
             d_updates, od = self.d_opt.update(d_grads, od, dp_)
             dp_ = optax.apply_updates(dp_, d_updates)
 
             # ---- G step ------------------------------------------------
             def g_loss_fn(gp_in, gr_in, dr_in):
-                G = compat.nnx_merge(g_def, gp_in, gr_in, copy=True)
-                G.train()
-                fake = G(z_g)
-                _, _, gr_out = nnx.split(G, nnx.Param, ...)
-                D = compat.nnx_merge(d_def, dp_, dr_in, copy=True)
-                D.train()
-                fake_logits = D(fake)
-                _, _, dr_out = nnx.split(D, nnx.Param, ...)
-                _, g_loss = loss_pair(jnp.zeros_like(fake_logits), fake_logits)
-                return g_loss, (gr_out, dr_out)
+                with obs_numerics.collect(enabled=mon) as col:
+                    G = compat.nnx_merge(g_def, gp_in, gr_in, copy=True)
+                    G.train()
+                    fake = G(z_g)
+                    _, _, gr_out = nnx.split(G, nnx.Param, ...)
+                    D = compat.nnx_merge(d_def, dp_, dr_in, copy=True)
+                    D.train()
+                    fake_logits = D(fake)
+                    _, _, dr_out = nnx.split(D, nnx.Param, ...)
+                    _, g_loss = loss_pair(
+                        jnp.zeros_like(fake_logits), fake_logits
+                    )
+                return g_loss, (gr_out, dr_out, col.summary())
 
             gp_in = _pcast_varying(gp, axis) if self._check_vma else gp
-            (g_loss, (gr, dr)), g_grads = jax.value_and_grad(
+            (g_loss, (gr, dr, g_numx)), g_grads = jax.value_and_grad(
                 g_loss_fn, has_aux=True
             )(gp_in, gr, dr)
-            g_grads = grad_mean(g_grads)
+            if mon:
+                numx["g_replica_grad_norm"] = (
+                    obs_numerics.grad_norm_scalar(g_grads)
+                )
+            g_grads, g_cnumx = grad_mean(g_grads)
             g_updates, og = self.g_opt.update(g_grads, og, gp)
             gp = optax.apply_updates(gp, g_updates)
 
@@ -227,6 +257,20 @@ class GANTrainer:
                 })
                 monitors.update(obs_stepstats.state_health(
                     (gr, dr), per_layer=self.monitors == "full"
+                ))
+                # numerics drift/compression family (obs.numerics): BN
+                # batch-moment skew from both sub-steps (worst wins),
+                # per-network grad-norm dispersion, int8 clip/headroom —
+                # fused into ONE scalar psum, the family's whole wire
+                # cost (pinned by the gan.train_step golden contract)
+                numx.update(obs_numerics.merge_max(
+                    d_numx, g_numx, d_cnumx, g_cnumx
+                ))
+                monitors.update(obs_numerics.cross_replica_monitors(
+                    numx, axis,
+                    disp_keys=("d_replica_grad_norm",
+                               "g_replica_grad_norm"),
+                    varying_cast=self._check_vma,
                 ))
             return gp, gr, dp_, dr, og, od, d_loss, g_loss, metrics, monitors
 
@@ -283,6 +327,17 @@ class GANTrainer:
             self.g_params, self.g_rest, self.d_params, self.d_rest,
             self.g_opt_state, self.d_opt_state, real, z_d, z_g,
         )
+        self.step_count += k
+        if flightrec.get() is not None:
+            # chunk-final slice: lazy device-side indexing, no host sync
+            # (the ring scalarizes at dump time, like every record_step)
+            last = lambda a: a[-1]
+            flightrec.record_step(
+                self.step_count,
+                metrics={"d_loss": last(d_loss), "g_loss": last(g_loss),
+                         **{k_: last(v) for k_, v in metrics.items()}},
+                monitors=jax.tree_util.tree_map(last, monitors),
+            )
         return GANStepOutput(d_loss=d_loss, g_loss=g_loss, metrics=metrics,
                              monitors=monitors)
 
@@ -295,6 +350,16 @@ class GANTrainer:
             self.g_params, self.g_rest, self.d_params, self.d_rest,
             self.g_opt_state, self.d_opt_state, real, z_d, z_g,
         )
+        self.step_count += 1
+        if flightrec.get() is not None:
+            # step ring (ISSUE 13 satellite): GAN incidents used to dump
+            # an empty step history — record the async device scalars
+            # as-is, no host sync (scalarized at dump time)
+            flightrec.record_step(
+                self.step_count,
+                metrics={"d_loss": d_loss, "g_loss": g_loss, **metrics},
+                monitors=monitors,
+            )
         return GANStepOutput(d_loss=d_loss, g_loss=g_loss, metrics=metrics,
                              monitors=monitors)
 
@@ -304,15 +369,22 @@ class GANTrainer:
         return self._generator, self._discriminator
 
     def state_dict(self) -> dict:
-        # copies: donated buffers are invalidated by the next train_step
-        return jax.tree_util.tree_map(
-            jnp.copy,
-            {
-                "g_params": self.g_params, "g_rest": self.g_rest,
-                "d_params": self.d_params, "d_rest": self.d_rest,
-                "g_opt_state": self.g_opt_state, "d_opt_state": self.d_opt_state,
-            },
-        )
+        # copies: donated buffers are invalidated by the next train_step.
+        # step_count rides along (host int, outside the device-copy map)
+        # so the flight-recorder step-ring numbering survives a resume —
+        # a post-restart incident must not relabel step 10000 as step 1.
+        return {
+            **jax.tree_util.tree_map(
+                jnp.copy,
+                {
+                    "g_params": self.g_params, "g_rest": self.g_rest,
+                    "d_params": self.d_params, "d_rest": self.d_rest,
+                    "g_opt_state": self.g_opt_state,
+                    "d_opt_state": self.d_opt_state,
+                },
+            ),
+            "step_count": self.step_count,
+        }
 
     def load_state_dict(self, state: dict) -> None:
         put = lambda t: jax.device_put(t, NamedSharding(self.mesh, P()))
@@ -320,6 +392,8 @@ class GANTrainer:
         self.d_params, self.d_rest = put(state["d_params"]), put(state["d_rest"])
         self.g_opt_state = put(state["g_opt_state"])
         self.d_opt_state = put(state["d_opt_state"])
+        # absent in pre-ISSUE-13 checkpoints: resume ring numbering at 0
+        self.step_count = int(state.get("step_count", 0))
 
     def generate(self, z) -> jax.Array:
         """Sample images with the current generator state (eval mode; the

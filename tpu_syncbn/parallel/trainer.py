@@ -50,7 +50,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_syncbn import compat
 from tpu_syncbn.compat import shard_map
-from tpu_syncbn.obs import stepstats as obs_stepstats
+from tpu_syncbn.obs import numerics as obs_numerics, stepstats as obs_stepstats
 from tpu_syncbn.parallel import collectives
 from tpu_syncbn.parallel.collectives import pcast_varying as _pcast_varying
 from tpu_syncbn.runtime import distributed as dist
@@ -297,7 +297,18 @@ class DataParallel:
         monitors; ``False`` turns the block off (``monitors == {}``).
         They ride the step's existing outputs — no extra per-step
         host→device syncs (under ``zero`` the grad norm needs one
-        scalar device-side psum, since grads exist only as shards)."""
+        scalar device-side psum, since grads exist only as shards).
+
+        Monitors include the numerics drift/compression family
+        (``obs.numerics``, docs/OBSERVABILITY.md "Numerics & drift"):
+        ``bn_mean_skew``/``bn_var_skew``/``bn_skew_layers`` (per-replica
+        BN batch moments vs the synced value), ``replica_grad_norm`` /
+        ``replica_grad_norm_disp`` (cross-replica grad-norm dispersion)
+        and — on the compressed paths — ``clip_fraction`` /
+        ``overflow_headroom`` (int8) and ``ef_residual_ratio`` (error
+        feedback). The whole family costs exactly ONE extra fused
+        scalar psum per compiled program (device↔device, never a host
+        sync), a bound the golden program contracts machine-check."""
         if accum_steps < 1:
             raise ValueError("accum_steps must be >= 1")
         if divergence_guard not in (
@@ -494,7 +505,11 @@ class DataParallel:
 
     def _microbatch_grads(self, params, rest, batch):
         """value_and_grad over one microbatch; returns (loss, metrics,
-        new_rest, grads)."""
+        new_rest, grads, numx) — ``numx`` being the numerics drift
+        scalars (BN batch-moment skew vs the synced value) the forward's
+        SyncBN reductions recorded under the monitor collector; ``{}``
+        with monitors off, so the traced program is unchanged."""
+        collect_numerics = bool(self.monitors)
 
         def lossed(p, r, b):
             # copy=True: fresh trace-local Variables, so BN's BatchStat
@@ -502,10 +517,14 @@ class DataParallel:
             # otherwise aliases the original module's variables)
             model = compat.nnx_merge(self.graphdef, p, r, copy=True)
             model.train()
-            out = self.loss_fn(model, b)
+            # the skew scalars are traced INSIDE the differentiated
+            # function, so they must exit through its aux (a module-level
+            # side channel would leak VJP-trace tracers)
+            with obs_numerics.collect(enabled=collect_numerics) as col:
+                out = self.loss_fn(model, b)
             loss, metrics = out if isinstance(out, tuple) else (out, {})
             _, _, new_r = nnx.split(model, nnx.Param, ...)
-            return loss, (metrics, new_r)
+            return loss, (metrics, new_r, col.summary())
 
         if self.remat:
             lossed = jax.checkpoint(lossed)
@@ -521,10 +540,10 @@ class DataParallel:
         # (With the checker off — pallas mode — grads are local anyway.)
         if self._check_vma:
             params = _pcast_varying(params, self.axis_name)
-        (loss, (metrics, new_rest)), grads = jax.value_and_grad(
+        (loss, (metrics, new_rest, numx)), grads = jax.value_and_grad(
             lossed, has_aux=True
         )(params, rest, batch)
-        return loss, metrics, new_rest, grads
+        return loss, metrics, new_rest, grads, numx
 
     def _gather_params(self, store):
         """ZeRO path: rebuild the full (device-varying) param tree from
@@ -580,7 +599,7 @@ class DataParallel:
                 rest = jax.tree_util.tree_map(lambda x: x[0], rest)
             rest_in = rest
             if self.accum_steps == 1:
-                loss, metrics, rest, grads = self._microbatch_grads(
+                loss, metrics, rest, grads, numx = self._microbatch_grads(
                     params, rest, batch
                 )
             else:
@@ -618,18 +637,18 @@ class DataParallel:
 
                 def body(carry, mb):
                     rest, acc = carry
-                    loss, metrics, rest, grads = self._microbatch_grads(
-                        params, rest, mb
+                    loss, metrics, rest, grads, numx = (
+                        self._microbatch_grads(params, rest, mb)
                     )
                     acc = jax.tree_util.tree_map(jnp.add, acc, grads)
                     rest = to_varying(rest) if pin_rest else rest
-                    return (rest, acc), (loss, metrics)
+                    return (rest, acc), (loss, metrics, numx)
 
                 zero = to_varying(
                     jax.tree_util.tree_map(jnp.zeros_like, params)
                 )
                 rest = to_varying(rest) if pin_rest else rest
-                (rest, grads), (losses, metricses) = jax.lax.scan(
+                (rest, grads), (losses, metricses, numxes) = jax.lax.scan(
                     body, (rest, zero), micro
                 )
                 grads = jax.tree_util.tree_map(
@@ -637,6 +656,11 @@ class DataParallel:
                 )
                 loss = jnp.mean(losses)
                 metrics = jax.tree_util.tree_map(jnp.mean, metricses)
+                # worst microbatch wins: skew anywhere in the accum
+                # window is drift (same fold as Collector.summary)
+                numx = jax.tree_util.tree_map(
+                    lambda a: jnp.max(a, axis=0), numxes
+                )
 
             if self.compress != "none":
                 # reporting scalars ride the wire in bf16 under any
@@ -669,6 +693,13 @@ class DataParallel:
                 # needs this device's shard
                 flat_g = self._layout.flatten(grads)
                 new_ef: dict = {}
+                if self.monitors:
+                    # per-replica grad norm BEFORE the reduce-scatter:
+                    # the local half of the dispersion monitor
+                    numx["replica_grad_norm"] = (
+                        obs_numerics.grad_norm_scalar(flat_g)
+                    )
+                ccol_ctx = obs_numerics.collect(enabled=bool(self.monitors))
 
                 def scatter(dt, g):
                     floating = jnp.issubdtype(g.dtype, jnp.floating)
@@ -697,10 +728,19 @@ class DataParallel:
                         g = collectives.reduce_scatter(g, axis)
                     return g / self.world
 
-                gshard = {dt: scatter(dt, g) for dt, g in flat_g.items()}
+                with ccol_ctx as ccol:
+                    # the compressed reduce-scatters record their int8
+                    # clip fraction / overflow headroom into the active
+                    # collector (parallel.collectives)
+                    gshard = {dt: scatter(dt, g) for dt, g in flat_g.items()}
                 if self._ef:
                     ef_out = new_ef
                 if self.monitors:
+                    numx.update(ccol.summary())
+                    if self._ef:
+                        numx["ef_residual_ratio"] = obs_numerics.residual_ratio(
+                            new_ef, numx["replica_grad_norm"]
+                        )
                     # shards only: one scalar device-side psum globalizes
                     monitors.update(obs_stepstats.grad_monitors(
                         gshard, axis, sharded=True
@@ -715,28 +755,48 @@ class DataParallel:
                     )
                 pstore = optax.apply_updates(pstore, updates)
             else:
-                # DDP gradient averaging: one compiler-scheduled all-reduce
-                if self._ef:
-                    grads, ef_out = collectives.ef_compressed_pmean(
-                        grads, ef_in, axis, mode=self.compress
-                    )
-                elif self.compress != "none":
-                    grads = collectives.compressed_pmean(
-                        grads, axis, mode=self.compress
-                    )
-                elif self.grad_compression == "bf16":
-                    # bf16_compress_hook parity: halve the wire traffic
-                    dtypes = jax.tree_util.tree_map(lambda g: g.dtype, grads)
-                    grads = jax.tree_util.tree_map(
-                        lambda g: g.astype(jnp.bfloat16), grads
-                    )
-                    grads = collectives.pmean(grads, axis)
-                    grads = jax.tree_util.tree_map(
-                        lambda g, d: g.astype(d), grads, dtypes
-                    )
-                else:
-                    grads = collectives.pmean(grads, axis)
                 if self.monitors:
+                    # per-replica grad norm BEFORE the all-reduce: the
+                    # local half of the dispersion monitor
+                    numx["replica_grad_norm"] = (
+                        obs_numerics.grad_norm_scalar(grads)
+                    )
+                # DDP gradient averaging: one compiler-scheduled
+                # all-reduce; the compressed paths record their int8
+                # clip fraction / overflow headroom into the collector
+                with obs_numerics.collect(
+                    enabled=bool(self.monitors)
+                ) as ccol:
+                    if self._ef:
+                        grads, ef_out = collectives.ef_compressed_pmean(
+                            grads, ef_in, axis, mode=self.compress
+                        )
+                    elif self.compress != "none":
+                        grads = collectives.compressed_pmean(
+                            grads, axis, mode=self.compress
+                        )
+                    elif self.grad_compression == "bf16":
+                        # bf16_compress_hook parity: halve the wire traffic
+                        dtypes = jax.tree_util.tree_map(
+                            lambda g: g.dtype, grads
+                        )
+                        grads = jax.tree_util.tree_map(
+                            lambda g: g.astype(jnp.bfloat16), grads
+                        )
+                        grads = collectives.pmean(grads, axis)
+                        grads = jax.tree_util.tree_map(
+                            lambda g, d: g.astype(d), grads, dtypes
+                        )
+                    else:
+                        grads = collectives.pmean(grads, axis)
+                if self.monitors:
+                    numx.update(ccol.summary())
+                    if self._ef:
+                        numx["ef_residual_ratio"] = (
+                            obs_numerics.residual_ratio(
+                                ef_out, numx["replica_grad_norm"]
+                            )
+                        )
                     # post-pmean grads are replicated: pure arithmetic,
                     # no collective needed
                     monitors.update(obs_stepstats.grad_monitors(grads))
@@ -749,6 +809,18 @@ class DataParallel:
                         lambda u: u * guard_in["lr_scale"], updates
                     )
                 pstore = optax.apply_updates(params, updates)
+
+            if self.monitors and numx:
+                # numerics drift/compression monitors (obs.numerics): the
+                # per-replica local scalars — BN batch-moment skew, local
+                # grad norm, int8 clip/headroom, EF residual ratio — fused
+                # into ONE scalar psum. That single collective is the
+                # monitors' whole wire cost, pinned by the golden program
+                # contracts and tests/test_numerics.py's one-psum gate.
+                monitors.update(obs_numerics.cross_replica_monitors(
+                    numx, axis, disp_keys=("replica_grad_norm",),
+                    varying_cast=self._check_vma,
+                ))
 
             if guard_in is not None:
                 # exact skip of a non-finite step: params, optimizer

@@ -665,7 +665,10 @@ class ResilientLoop:
         async checkpoint writes are flushed on every exit path."""
         import numpy as _np
 
-        from tpu_syncbn.obs import flightrec, server as obs_server, telemetry
+        from tpu_syncbn.obs import (
+            flightrec, numerics as obs_numerics, server as obs_server,
+            telemetry,
+        )
         from tpu_syncbn.parallel.collectives import DispatchWireTally
 
         policy = getattr(self.trainer, "divergence_guard", None)
@@ -682,6 +685,12 @@ class ResilientLoop:
         flightrec.install_from_env()
         obs_server.register_readiness("train", self.readiness)
         wire_tally = DispatchWireTally()
+        # numerics drift/compression telemetry (docs/OBSERVABILITY.md
+        # "Numerics & drift"): publishes each step's numerics monitors
+        # into the registry once their device values settle (is_ready
+        # probe — never a forced host sync on the loop) and fires the
+        # numerics_drift incident trigger on a threshold crossing
+        numerics_pub = obs_numerics.NumericsPublisher()
         try:
             with contextlib.ExitStack() as stack:
                 guard = stack.enter_context(PreemptionGuard())
@@ -734,6 +743,13 @@ class ResilientLoop:
                         self.step, metrics=out.metrics,
                         monitors=getattr(out, "monitors", None),
                     )
+                    mon = getattr(out, "monitors", None)
+                    if scanned and isinstance(mon, dict) and mon:
+                        # chunk outputs are (K,)-stacked: publish the
+                        # chunk-final slice (lazy device-side indexing,
+                        # no host sync)
+                        mon = {name: v[-1] for name, v in mon.items()}
+                    numerics_pub.publish(self.step, mon)
                     wire_tally.after_dispatch(k)
                     if policy is not None:
                         # scalar for a single step, (K,)-stacked for a
@@ -807,11 +823,26 @@ class ResilientLoop:
             # not a stale ready/not-ready claim
             obs_server.unregister_readiness("train")
             self._guard = None
+            try:
+                # non-blocking tail drain: publish whatever settled. A
+                # BLOCKING flush here could hang forever on the one exit
+                # path that matters most (a watchdog stall = a device
+                # value that never becomes ready); the clean-exit flush
+                # below gets the rest
+                numerics_pub.publish(self.step, None)
+            except Exception:
+                self._log.exception(
+                    "numerics publisher drain failed on loop exit"
+                )
         # async writes become durable before control leaves the loop — on
         # the preemption path this runs inside the grace window, and a
         # flush error DOES raise here: returning {'preempted': True}
         # over a failed boundary write would claim durability it lacks
         self.flush_checkpoints()
+        # clean exit: the device chain has settled (the loop's last step
+        # completed), so the blocking numerics drain is safe here and the
+        # final steps' drift evidence reaches the registry
+        numerics_pub.flush()
         return {
             "steps": steps_run,
             "step": self.step,
