@@ -1058,8 +1058,180 @@ def measure_audit(dp, batch) -> dict:
             "peak_mb_per_device": round(
                 flow.peak_bytes_per_device / 1e6, 3
             ),
+            # exact bytes for the memory block's static-vs-live
+            # reconciler (mem.headroom_frac is computed against this)
+            "peak_bytes_per_device": int(flow.peak_bytes_per_device),
         },
         "audit_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def measure_memory(sampler, *, audited_peak_bytes, steps, wall_s) -> dict:
+    """The ``memory`` block of the bench line: the live memory plane
+    (docs/OBSERVABILITY.md "Memory & compile") measured on the run's own
+    state.
+
+    The sampler watched the run (device ``memory_stats()`` watermarks,
+    or the CPU fallback's host census); this block closes the loop:
+
+    * **reconciliation** — the sharding auditor's pinned per-device peak
+      for the benched train step (``audit.sharding.peak_bytes_per_device``,
+      computed in this same run) becomes the sampler's contract, and one
+      sample reports the live ``used_frac`` / ``headroom_frac`` against
+      it — the static-vs-live agreement the ISSUE 14 reconciler exists
+      for;
+    * ``sample_cost_s`` / ``sample_overhead_frac`` — the steady-state
+      cost of one sample, micro-measured, over the measured average step
+      time (the ≤2% acceptance bound; ``memory.sample_cost_s`` is a
+      BASELINE.json ``--check-regression`` anchor);
+    * ``pressure`` — a planted drill: a sampler with a deliberately tiny
+      contract (own flight recorder + scratch registry, so the live
+      run's gauges stay honest) must dump exactly ONE schema-valid
+      ``mem_pressure`` bundle whose mem ring holds the pre-trigger
+      watermark history;
+    * ``profilez`` — one ``POST /profilez`` round trip against an
+      ephemeral monitoring server with the capture knob set: status,
+      captured bytes (duration- and size-capped), wall latency.
+
+    Schema pinned by tests/test_bench_tooling.py."""
+    import shutil
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from tpu_syncbn.obs import (
+        flightrec, incident as incident_mod, memwatch,
+        server as obs_server, telemetry,
+    )
+
+    if audited_peak_bytes:
+        sampler.set_contract(int(audited_peak_bytes),
+                             source="sharding_audit")
+    reading = sampler.sample()
+
+    # steady-state sampler cost, micro-measured (the census walk is the
+    # expensive part on the CPU fallback; device stats are one RPC)
+    repeats = 25
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        sampler.sample()
+    sample_cost_s = (time.perf_counter() - t0) / repeats
+    avg_step_s = wall_s / steps if steps else None
+
+    # planted pressure drill — own recorder + scratch registry: the
+    # live registry's mem.* gauges must keep describing the real run
+    drill_dir = tempfile.mkdtemp(prefix="bench_memwatch_")
+    scratch = telemetry.Registry()
+    rec = flightrec.FlightRecorder(registry=scratch,
+                                   incident_dir=drill_dir)
+    try:
+        dsampler = memwatch.MemorySampler(
+            registry=scratch, recorder=rec,
+            contract_bytes_per_device=1 << 60,  # history, no pressure
+        )
+        dsampler.sample()
+        dsampler.sample()
+        dsampler.set_contract(1, source="bench_drill")
+        dsampler.sample()  # over contract: fires mem_pressure
+        names = [n for n in os.listdir(drill_dir) if n.endswith(".json")]
+        pressure = {"bundles": len(names), "trigger": None,
+                    "ring_mem": 0, "valid": False}
+        if len(names) == 1:
+            bundle = incident_mod.load_bundle(
+                os.path.join(drill_dir, names[0])
+            )  # schema-validates
+            pressure = {
+                "bundles": 1,
+                "trigger": bundle["trigger"]["kind"],
+                "ring_mem": len(bundle["rings"]["mem"]),
+                "valid": (bundle["trigger"]["kind"] == "mem_pressure"
+                          and len(bundle["rings"]["mem"]) >= 3),
+            }
+    finally:
+        rec.close()
+        shutil.rmtree(drill_dir, ignore_errors=True)
+
+    # /profilez round trip: ephemeral server + the env knob, restored
+    # afterwards (bench must not leave a capture dir configured)
+    profilez = None
+    prof_dir = tempfile.mkdtemp(prefix="bench_profilez_")
+    prev_knob = os.environ.get("TPU_SYNCBN_PROFILE_DIR")
+    os.environ["TPU_SYNCBN_PROFILE_DIR"] = prof_dir
+    try:
+        srv = obs_server.MonitoringServer(port=0, host="127.0.0.1")
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/profilez?duration_s=0.1",
+                method="POST", data=b"",
+            )
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    status, body = resp.status, resp.read()
+            except urllib.error.HTTPError as e:
+                status, body = e.code, e.read()
+            roundtrip_s = time.perf_counter() - t0
+            payload = json.loads(body)
+            profilez = {
+                "status": status,
+                "bytes": payload.get("bytes"),
+                "roundtrip_s": round(roundtrip_s, 4),
+            }
+        finally:
+            srv.close()
+    finally:
+        if prev_knob is None:
+            os.environ.pop("TPU_SYNCBN_PROFILE_DIR", None)
+        else:
+            os.environ["TPU_SYNCBN_PROFILE_DIR"] = prev_knob
+        shutil.rmtree(prof_dir, ignore_errors=True)
+
+    return {
+        "source": reading["source"],
+        "bytes_in_use": reading["bytes_in_use"],
+        "peak_bytes": reading["peak_bytes"],
+        "rss_bytes": reading.get("rss_bytes"),
+        "cache_bytes_live": reading.get("cache_bytes_live"),
+        "contract_bytes_per_device": reading.get(
+            "contract_bytes_per_device"
+        ),
+        "contract_source": reading.get("contract_source"),
+        "used_frac": reading.get("used_frac"),
+        "headroom_frac": reading.get("headroom_frac"),
+        "samples": sampler.samples,
+        "sample_cost_s": round(sample_cost_s, 9),
+        "sample_overhead_frac": (
+            round(sample_cost_s / avg_step_s, 6) if avg_step_s else None
+        ),
+        "pressure": pressure,
+        "profilez": profilez,
+    }
+
+
+def compile_block(warm_s: float) -> dict:
+    """The ``compile`` block of the bench line: the compile-seam story
+    of this run, read from the ``compile.*`` registry family
+    (docs/OBSERVABILITY.md "Memory & compile") — ``warmup_s`` (the
+    measured compile+warmup of the headline program; a BASELINE.json
+    anchor), total/per-family event counts, the ``compile.time_s``
+    histogram totals, and the recompile-storm count (0 on any healthy
+    run). Schema pinned by tests/test_bench_tooling.py."""
+    from tpu_syncbn.obs import telemetry
+
+    snap = telemetry.snapshot()
+    counters = snap["counters"]
+    hist = snap["histograms"].get("compile.time_s") or {}
+    families = {}
+    for name, v in counters.items():
+        if name.startswith("compile.") and name.endswith(".events"):
+            families[name[len("compile."):-len(".events")]] = v
+    return {
+        "warmup_s": round(warm_s, 2),
+        "events_total": counters.get("compile.events_total", 0),
+        "storms": counters.get("compile.storms", 0),
+        "time_s_count": hist.get("count", 0),
+        "time_s_sum": round(hist.get("sum", 0.0), 4),
+        "families": families,
     }
 
 
@@ -1262,9 +1434,16 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
     ``serve`` (the ``--serve`` flag) additionally runs the
     dynamic-batching inference sweep (:func:`measure_serve`) on the
     trained state and attaches the schema-pinned ``serve`` block."""
-    from tpu_syncbn.obs import flightrec, stepstats, telemetry, tracing
+    from tpu_syncbn.obs import (
+        flightrec, profiling as obs_profiling, stepstats, telemetry,
+        tracing,
+    )
 
     telemetry.set_enabled(True)
+    # fresh recompile-storm window for THIS run: in a long-lived process
+    # (the tooling tests) the detector is a singleton and compiles from
+    # earlier work would count against bench's storm verdict
+    obs_profiling.set_detector(None)
     tracer = tracing.install() if trace_path else None
 
     from tpu_syncbn.runtime import probe
@@ -1346,6 +1525,18 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
 
     numerics_pub = obs_numerics.NumericsPublisher()
 
+    # memory watermarks (docs/OBSERVABILITY.md "Memory & compile"): one
+    # explicit sampler for the run — a pre-loop anchor and a post-loop
+    # watermark bracket the timed loop; the memory block below sets the
+    # audited-peak contract and reconciles. Triggering stays off
+    # (pressure_threshold=None): the block's planted drill proves the
+    # trigger path on its own recorder without spending the run's
+    # incident cooldown
+    from tpu_syncbn.obs import memwatch as obs_memwatch
+
+    mem_sampler = obs_memwatch.MemorySampler(pressure_threshold=None)
+    mem_sampler.sample()
+
     # instrumented loop: per-step "data_wait"/"step" spans + the
     # step.time_s histogram (host DISPATCH time per step — jax dispatch
     # is async, the final fetch_sync settles the chain). perf_counter
@@ -1366,6 +1557,7 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
     # every step in the donated-state chain
     dt = time.perf_counter() - t0
     agg.tick()  # close the timed loop's window frame
+    mem_sampler.sample()  # post-loop watermark
     telemetry.set_gauge("step.wall_avg_s", dt / steps)  # incl. device time
 
     img_per_sec = global_batch * steps / dt
@@ -1539,6 +1731,30 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
         log(f"audit measurement failed: {type(e).__name__}: {e}")
         audit_info = None
 
+    # live memory plane measured on the run's own state, reconciled
+    # against the audit block's pinned per-device peak
+    # (docs/OBSERVABILITY.md "Memory & compile") — an annotation, never
+    # fatal to the metric
+    try:
+        with stepstats.timed_span("memory_bench", "bench.memory_s"):
+            memory_info = measure_memory(
+                mem_sampler,
+                audited_peak_bytes=(
+                    (audit_info or {}).get("sharding", {})
+                    .get("peak_bytes_per_device")
+                ),
+                steps=steps, wall_s=dt,
+            )
+        log(f"memory: {memory_info['source']} source, headroom "
+            f"{memory_info['headroom_frac']}, sample cost "
+            f"{memory_info['sample_cost_s']}s, pressure drill "
+            f"valid={(memory_info['pressure'] or {}).get('valid')}, "
+            f"profilez {(memory_info['profilez'] or {}).get('status')} "
+            f"({(memory_info['profilez'] or {}).get('bytes')} B)")
+    except Exception as e:
+        log(f"memory measurement failed: {type(e).__name__}: {e}")
+        memory_info = None
+
     # compressed-collective layer: per-mode bytes-on-wire + golden
     # ratios (docs/PERFORMANCE.md "Compressed collectives") — an
     # annotation, never fatal to the metric
@@ -1616,6 +1832,17 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
         # per-device peak tracks the real workload's footprint); schema
         # pinned by tests/test_bench_tooling.py
         "audit": audit_info,
+        # docs/OBSERVABILITY.md "Memory & compile": live watermarks vs
+        # the audited per-device peak (headroom_frac), sampler cost
+        # (memory.sample_cost_s is a BASELINE anchor), the planted
+        # mem_pressure drill, and a /profilez round trip; schema pinned
+        # by tests/test_bench_tooling.py
+        "memory": memory_info,
+        # docs/OBSERVABILITY.md "Memory & compile": compile-seam events
+        # and times for this run (warmup_s is a BASELINE anchor;
+        # storms must read 0 on a healthy run); schema pinned by
+        # tests/test_bench_tooling.py
+        "compile": compile_block(warm_s),
         # docs/PERFORMANCE.md "Compressed collectives": per-wire-mode
         # traced bytes + measured all-reduce time for a fixed payload,
         # and the golden-pinned >=2x/>=3.5x compression ratios (the

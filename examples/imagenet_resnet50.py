@@ -236,9 +236,11 @@ def main():
         # final eval below (per-epoch --eval-every evals remain in scope;
         # only the end-of-training eval pass is excluded from the trace)
         prof = stack.enter_context(contextlib.ExitStack())
+        from tpu_syncbn.obs import profiling
+
         prof.enter_context(
-            utils.profiler_trace(args.profile_dir or "",
-                                 enabled=bool(args.profile_dir))
+            profiling.profiler_trace(args.profile_dir or "",
+                                     enabled=bool(args.profile_dir))
         )
         # SIGTERM/SIGINT (preemption notice) → finish the in-flight step,
         # checkpoint at the boundary, exit 0; the restarted job resumes

@@ -25,6 +25,14 @@ def cast(x, axis):
     return jax.lax.pvary(x, axis)  # bad: collectives.pcast_varying
 
 
+def profile(log_dir):
+    # bad: raw profiler start/stop outside obs/profiling.py — the
+    # unbounded process-singleton trace ISSUE 14 moved behind
+    # obs.profiling.capture / profiler_trace
+    jax.profiler.start_trace(log_dir)
+    jax.profiler.stop_trace()
+
+
 def suppressed(graphdef, params):
     # documented escape hatch: fallback probed one line above
     return nnx.merge(graphdef, params)  # audit: ok[raw_api_bypass]

@@ -22,7 +22,7 @@ FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "audit_fixtures")
 #: lockstep with RULES is itself a test: a rule without a fixture is
 #: dead weight by definition (ISSUE 6).
 RULE_FIXTURES = {
-    "raw_api_bypass": ("bad_raw_api_bypass.py", 6),
+    "raw_api_bypass": ("bad_raw_api_bypass.py", 8),
     "host_sync_in_step": ("bad_host_sync_in_step.py", 2),
     "donate_after_use": ("bad_donate_after_use.py", 2),
     "unlocked_shared_state": ("bad_unlocked_shared_state.py", 4),
@@ -156,6 +156,30 @@ class TestRuleEdges:
         vs = lint_source(src, "x.py")
         assert [v.rule for v in vs] == ["raw_api_bypass"]
         assert "compat.shard_map" in vs[0].message
+
+    def test_raw_profiler_start_is_flagged(self):
+        # ISSUE 14 satellite: a raw jax.profiler.start_trace outside
+        # obs/profiling.py fires — the unbounded process-singleton
+        # trace must route through the bounded obs.profiling capture
+        src = (
+            "import jax\n"
+            "def prof(d):\n"
+            "    jax.profiler.start_trace(d)\n"
+        )
+        vs = lint_source(src, "tpu_syncbn/utils/metrics.py")
+        assert [v.rule for v in vs] == ["raw_api_bypass"]
+        assert "obs.profiling" in vs[0].message
+
+    def test_raw_profiler_allowed_in_obs_profiling(self):
+        # ...and obs/profiling.py is the one documented home of the raw
+        # start/stop calls
+        src = (
+            "import jax\n"
+            "def prof(d):\n"
+            "    jax.profiler.start_trace(d)\n"
+            "    jax.profiler.stop_trace()\n"
+        )
+        assert lint_source(src, "tpu_syncbn/obs/profiling.py") == []
 
     def test_host_sync_in_nested_def_reported_once(self):
         src = (

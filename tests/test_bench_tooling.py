@@ -558,6 +558,14 @@ class TestTelemetryBlock:
         # the audit block is always present (the static-analysis layer
         # measured on the run's own program — ISSUE 10)
         self._validate_audit_block(line["audit"])
+        # the memory + compile blocks are always present (the live
+        # memory/compile plane measured on the run's own state, with
+        # the reconciler fed the audit block's pinned peak — ISSUE 14)
+        self._validate_memory_block(
+            line["memory"],
+            audited_peak=line["audit"]["sharding"]["peak_bytes_per_device"],
+        )
+        self._validate_compile_block(line["compile"])
         # the incident block is always present (the flight recorder is
         # armed on every run and a manual bundle is forced — ISSUE 11)
         self._validate_incident_block(line["incident"], steps=3)
@@ -717,6 +725,73 @@ class TestTelemetryBlock:
         assert abs(attr["share_sum"] - 1.0) <= 0.05
 
     @staticmethod
+    def _validate_memory_block(block, *, audited_peak):
+        """The schema-pinned `memory` block (ISSUE 14): live watermarks
+        reconciled against the sharding auditor's pinned per-device
+        peak, sampler cost (memory.sample_cost_s is a BASELINE anchor),
+        the planted mem_pressure drill (exactly one schema-valid bundle
+        with pre-trigger watermark history), and a /profilez round
+        trip."""
+        assert set(block) == {
+            "source", "bytes_in_use", "peak_bytes", "rss_bytes",
+            "cache_bytes_live", "contract_bytes_per_device",
+            "contract_source", "used_frac", "headroom_frac", "samples",
+            "sample_cost_s", "sample_overhead_frac", "pressure",
+            "profilez",
+        }
+        assert block["source"] in ("device", "host")
+        assert block["bytes_in_use"] >= 0
+        assert block["samples"] >= 3  # pre-loop, post-loop, reconcile
+        assert 0 <= block["sample_cost_s"] < 1.0
+        # the ≤2% steady-state bound is gated by the BASELINE anchor
+        # (memory.sample_overhead_frac) on real runs; this tiny-model
+        # run has ~ms steps, so a fixed ~100µs census reads inflated —
+        # the schema test only pins sanity (fraction present, bounded)
+        assert block["sample_overhead_frac"] is not None
+        assert 0 <= block["sample_overhead_frac"] <= 0.5
+        # the reconciler demonstrably used the audited peak
+        assert block["contract_bytes_per_device"] == audited_peak
+        assert block["contract_source"] == "sharding_audit"
+        assert block["used_frac"] is not None
+        assert block["headroom_frac"] is not None
+        assert abs(block["used_frac"]
+                   - block["bytes_in_use"] / audited_peak) < 1e-3
+        assert abs(block["headroom_frac"]
+                   - (1.0 - block["used_frac"])) < 1e-3
+        # planted drill: exactly ONE schema-valid mem_pressure bundle
+        # whose mem ring holds the pre-trigger watermark history
+        drill = block["pressure"]
+        assert drill is not None
+        assert drill["bundles"] == 1
+        assert drill["trigger"] == "mem_pressure"
+        assert drill["ring_mem"] >= 3
+        assert drill["valid"] is True
+        # the /profilez round trip answered with a bounded capture
+        prof = block["profilez"]
+        assert prof is not None
+        assert prof["status"] == 200
+        assert prof["bytes"] > 0
+        assert prof["roundtrip_s"] < 120
+
+    @staticmethod
+    def _validate_compile_block(block):
+        """The schema-pinned `compile` block (ISSUE 14): compile-seam
+        events/time for the run — warmup_s is a BASELINE anchor, the
+        first-dispatch latch must have fired, storms read 0 on a
+        healthy run."""
+        assert set(block) == {
+            "warmup_s", "events_total", "storms", "time_s_count",
+            "time_s_sum", "families",
+        }
+        assert block["warmup_s"] > 0
+        # the headline program's first dispatch is a compile event
+        assert block["events_total"] >= 1
+        assert block["families"].get("train", 0) >= 1
+        assert block["time_s_count"] >= 1
+        assert block["time_s_sum"] > 0
+        assert block["storms"] == 0
+
+    @staticmethod
     def _validate_audit_block(block):
         """The schema-pinned `audit` block (ISSUE 10): the static-
         analysis layer run against the bench's own train-step program.
@@ -732,13 +807,17 @@ class TestTelemetryBlock:
         assert set(sh) == {
             "collectives_explained", "implicit_reshards",
             "replicated_intermediates", "max_replicated_mb",
-            "peak_mb_per_device",
+            "peak_mb_per_device", "peak_bytes_per_device",
         }
         # the paper's program: at least the BN-stat/grad psums explained
         assert sh["collectives_explained"] >= 1
         assert sh["implicit_reshards"] == 0
         assert sh["replicated_intermediates"] == 0
         assert sh["peak_mb_per_device"] > 0
+        # the exact-bytes twin the memory block reconciles against
+        assert sh["peak_bytes_per_device"] > 0
+        assert (round(sh["peak_bytes_per_device"] / 1e6, 3)
+                == sh["peak_mb_per_device"])
 
     def test_scan_flag_emits_fused_block(self, tmp_path, monkeypatch, capsys):
         """--scan K: the fused K-step loop runs and the scan block

@@ -600,6 +600,16 @@ class TestStatusz:
                 "numerics.bn_mean_skew": {"count": 12, "max": 0.5},
             },
             "numerics_counters": {"numerics.samples": 12},
+            "memory": {
+                "mem.device.bytes_in_use": 4096.0,
+                "mem.headroom_frac": 0.25,
+            },
+            "memory_counters": {"mem.samples": 12},
+            "compiles": {
+                "compile.events_total": 3,
+                "compile.storms": 1,
+                "compile.train.events": 2,
+            },
             "last_incident": {
                 "id": "20260804T000000-h0-001-manual",
                 "trigger": "manual", "path": "/tmp/i.json",
@@ -633,6 +643,16 @@ class TestStatusz:
             "  numerics.bn_mean_skew                count=12 max=0.5\n"
             "  numerics.samples                     12\n"
             "\n"
+            "memory\n"
+            "  mem.device.bytes_in_use              4096\n"
+            "  mem.headroom_frac                    0.25\n"
+            "  mem.samples                          12\n"
+            "\n"
+            "compiles\n"
+            "  compile.events_total                 3\n"
+            "  compile.storms                       1\n"
+            "  compile.train.events                 2\n"
+            "\n"
             "last incident\n"
             "  id=20260804T000000-h0-001-manual trigger=manual\n"
             "  path=/tmp/i.json\n"
@@ -643,6 +663,8 @@ class TestStatusz:
         assert "(none registered)" in text
         assert "(no SLO tracker attached)" in text
         assert "(no numerics monitors published)" in text
+        assert "set TPU_SYNCBN_MEMWATCH=1" in text
+        assert "(none observed)" in text
         assert "set TPU_SYNCBN_FLIGHTREC=1" in text
 
     def test_endpoint_serves_live_state(self, tmp_path):
@@ -739,9 +761,12 @@ class TestFlowEvents:
 _DYNAMIC_FAMILIES = (
     (r"^slo\.[a-z0-9_]+\.burn_rate$", "slo.<rule>.burn_rate"),
     (r"^serve\.circuit_state\.[a-z0-9_]+$", "serve.circuit_state.<key>"),
-    (r"^(train|gan|serve)\.program_cache\.(hits|misses|evictions)$",
+    (r"^(train|gan|serve)\.program_cache\."
+     r"(hits|misses|evictions|bytes_live|live|fill_frac)$",
      ".program_cache."),
     (r"^audit\.rule\.[a-z0-9_.]+$", "audit.rule.<rule_id>"),
+    (r"^mem\.device\.(bytes_in_use|peak_bytes)\.d\d+$", "mem.device."),
+    (r"^compile\.[a-z0-9_]+\.events$", "compile.<family>.events"),
 )
 
 
@@ -793,6 +818,38 @@ class TestMetricNameDrift:
             "clip_fraction": 0.9, "overflow_headroom": 0.4,
             "ef_residual_ratio": 0.2,
         })
+        # memory + compile (ISSUE 14): one deterministic sample of each
+        # family — device path (per-device dynamic gauges), host
+        # fallback (census gauges), the reconciler (used_frac /
+        # headroom), a pressure trip, and one timed compile event
+        from tpu_syncbn.obs import memwatch, profiling
+
+        memwatch.MemorySampler(
+            device_reader=lambda: [{
+                "id": 0, "bytes_in_use": 900, "peak_bytes": 950,
+                "limit_bytes": 2000,
+            }],
+            host_reader=lambda cap: {
+                "rss_bytes": 1000, "peak_rss_bytes": 1100,
+                "cache_bytes_live": 10, "arrays_bytes": 500,
+                "arrays_count": 2, "arrays_truncated": False,
+            },
+            contract_bytes_per_device=1000,
+        ).sample()
+        memwatch.MemorySampler(
+            device_reader=lambda: None,
+            host_reader=lambda cap: {
+                "rss_bytes": 1000, "peak_rss_bytes": 1100,
+                "cache_bytes_live": 10, "arrays_bytes": 500,
+                "arrays_count": 2, "arrays_truncated": False,
+            },
+            contract_bytes_per_device=100,  # over: trips the counter
+        ).sample()
+        profiling.note_compile("train", 0.01)
+        telemetry.count("compile.storms", 0)
+        telemetry.count("obs.profilez.captures", 0)
+        telemetry.observe("obs.profilez.capture_s", 0.1)
+        telemetry.set_gauge("obs.profilez.bytes", 1000)
         # audit: the lint layer (pure ast — fast)
         audit_mod.run_audit(contracts=False)
         # incident: a forced bundle

@@ -1,0 +1,563 @@
+"""Memory & compile observability plane (ISSUE 14).
+
+The acceptance regime: the ``mem_pressure`` and ``recompile_storm``
+triggers are each proven end-to-end by a planted fault producing
+exactly ONE schema-valid incident bundle whose rings hold the
+pre-trigger watermark / compile history; the CPU-fallback sampler is
+deterministic under injected readers; ``mem.*`` gauges merge across
+two hosts through the existing ``merge_exports`` path;
+``ProgramCache`` occupancy is a live ``/metrics`` gauge, not just a
+``stats()`` snapshot; ``POST /profilez`` answers 200 with a bounded
+capture when the knob is set and 503 without it; and the raw
+``jax.profiler`` helper in ``utils.metrics`` is a warning-emitting
+alias.
+"""
+
+import glob
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_syncbn.obs import (
+    flightrec,
+    incident,
+    memwatch,
+    profiling,
+    server as obs_server,
+    telemetry,
+    timeseries,
+)
+
+pytestmark = pytest.mark.incident
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Every test starts and ends with no recorder/sampler installed,
+    a default detector, and an empty registry."""
+    def reset():
+        telemetry.set_enabled(None)
+        telemetry.REGISTRY.reset()
+        rec = flightrec.uninstall()
+        if rec is not None:
+            rec.close()
+        sampler = memwatch.uninstall()
+        if sampler is not None:
+            sampler.close()
+        profiling.set_detector(None)
+        obs_server.stop_env_server()
+
+    reset()
+    yield
+    reset()
+
+
+def _fixed_host_reader(cap):
+    return {
+        "rss_bytes": 1_000_000, "peak_rss_bytes": 1_200_000,
+        "cache_bytes_live": 3_000, "arrays_bytes": 500_000,
+        "arrays_count": 7, "arrays_truncated": False,
+    }
+
+
+def _device_reader_two():
+    return [
+        {"id": 0, "bytes_in_use": 800, "peak_bytes": 900,
+         "limit_bytes": 2_000},
+        {"id": 1, "bytes_in_use": 600, "peak_bytes": 1_000,
+         "limit_bytes": 2_000},
+    ]
+
+
+# ------------------------------------------------------------- sampler
+
+
+class TestSampler:
+    def test_cpu_fallback_is_deterministic(self):
+        """Injected readers -> byte-identical snapshots across two
+        fresh registries (the CPU-fallback determinism contract)."""
+        telemetry.set_enabled(True)
+        snaps = []
+        for _ in range(2):
+            reg = telemetry.Registry()
+            s = memwatch.MemorySampler(
+                registry=reg, device_reader=lambda: None,
+                host_reader=_fixed_host_reader,
+                contract_bytes_per_device=1_000_000,
+                now=lambda: 42.0,
+            )
+            r = s.sample()
+            assert r["source"] == "host"
+            # the census (not RSS) is the device-bytes proxy
+            assert r["bytes_in_use"] == 500_000
+            assert r["used_frac"] == 0.5
+            assert r["headroom_frac"] == 0.5
+            assert r["pressure"] is False
+            snap = reg.snapshot()
+            snap["histograms"].pop("mem.sample_s")  # wall-clock timing
+            snaps.append(snap)
+        assert snaps[0] == snaps[1]
+        gauges = snaps[0]["gauges"]
+        assert gauges["mem.device.bytes_in_use"] == 500_000
+        assert gauges["mem.host.rss_bytes"] == 1_000_000
+        assert gauges["mem.cache.bytes_live"] == 3_000
+        assert gauges["mem.arrays.count"] == 7
+        assert gauges["mem.headroom_frac"] == 0.5
+        assert snaps[0]["counters"]["mem.samples"] == 1
+        assert snaps[0]["histograms"]["mem.used_frac"]["count"] == 1
+
+    def test_device_path_publishes_per_device_gauges(self):
+        telemetry.set_enabled(True)
+        reg = telemetry.Registry()
+        s = memwatch.MemorySampler(
+            registry=reg, device_reader=_device_reader_two,
+            host_reader=_fixed_host_reader,
+        )
+        r = s.sample()
+        assert r["source"] == "device"
+        assert r["bytes_in_use"] == 800   # max across devices
+        assert r["peak_bytes"] == 1_000
+        gauges = reg.snapshot()["gauges"]
+        assert gauges["mem.device.bytes_in_use"] == 800
+        assert gauges["mem.device.bytes_in_use.d0"] == 800
+        assert gauges["mem.device.bytes_in_use.d1"] == 600
+        assert gauges["mem.device.peak_bytes.d1"] == 1_000
+        assert gauges["mem.device.limit_bytes"] == 2_000
+
+    def test_disabled_telemetry_publishes_nothing(self):
+        telemetry.set_enabled(False)
+        reg = telemetry.Registry()
+        s = memwatch.MemorySampler(
+            registry=reg, device_reader=lambda: None,
+            host_reader=_fixed_host_reader,
+        )
+        r = s.sample()  # the reading itself still works
+        assert r["bytes_in_use"] == 500_000
+        assert len(reg) == 0
+
+    def test_real_readers_answer_on_this_container(self):
+        """The un-injected readers must not crash (CPU backend:
+        device_readings None, host census present)."""
+        host = memwatch.host_readings()
+        assert host["rss_bytes"] is None or host["rss_bytes"] > 0
+        s = memwatch.MemorySampler()
+        r = s.sample()
+        assert r["source"] in ("device", "host")
+        assert r["bytes_in_use"] >= 0
+
+    def test_bad_contract_rejected(self):
+        with pytest.raises(ValueError):
+            memwatch.MemorySampler(contract_bytes_per_device=0)
+        s = memwatch.MemorySampler()
+        with pytest.raises(ValueError):
+            s.set_contract(0)
+
+    def test_env_gate(self, monkeypatch):
+        monkeypatch.delenv("TPU_SYNCBN_MEMWATCH", raising=False)
+        assert memwatch.install_from_env() is None
+        monkeypatch.setenv("TPU_SYNCBN_MEMWATCH", "1")
+        monkeypatch.setenv("TPU_SYNCBN_MEMWATCH_INTERVAL_S", "0.05")
+        s = memwatch.install_from_env()
+        assert s is not None
+        assert s.interval_s == 0.05
+        assert memwatch.install_from_env() is s  # idempotent
+        s.close()
+
+
+# ----------------------------------------------------- two-host merge
+
+
+class TestTwoHostMerge:
+    def test_mem_gauges_merge_through_merge_exports(self, tmp_path):
+        """ISSUE 14 satellite: per-host mem.* exports ride the ONE
+        existing merge path — counters sum, gauges last-write-wins
+        (point-in-time readings), histograms vector-add."""
+        telemetry.set_enabled(True)
+        paths = []
+        for host, used in enumerate((400_000, 700_000)):
+            reg = telemetry.Registry()
+            s = memwatch.MemorySampler(
+                registry=reg, device_reader=lambda: None,
+                host_reader=lambda cap, used=used: {
+                    **_fixed_host_reader(cap), "arrays_bytes": used,
+                },
+                contract_bytes_per_device=1_000_000,
+            )
+            s.sample()
+            paths.append(reg.export_jsonl(
+                str(tmp_path / f"h{host}.jsonl"), host=host,
+            ))
+        merged = telemetry.merge_exports(paths)
+        assert merged["hosts"] == [0, 1]
+        assert merged["counters"]["mem.samples"] == 2
+        # gauges: last-write-wins in path order (host 1)
+        assert merged["gauges"]["mem.device.bytes_in_use"] == 700_000
+        # histograms: windowed used_frac observations from BOTH hosts
+        assert merged["histograms"]["mem.used_frac"]["count"] == 2
+
+
+# ------------------------------------------------- mem_pressure trigger
+
+
+class TestMemPressureTrigger:
+    def test_planted_pressure_dumps_exactly_one_bundle(self, tmp_path):
+        """Planted fault: samples under contract build ring history,
+        then a shrunken contract trips the trigger -> exactly ONE
+        schema-valid mem_pressure bundle whose mem ring shows the
+        pre-trigger watermarks."""
+        telemetry.set_enabled(True)
+        rec = flightrec.install(flightrec.FlightRecorder(
+            incident_dir=str(tmp_path / "incidents"),
+        ))
+        s = memwatch.MemorySampler(
+            device_reader=lambda: None,
+            host_reader=_fixed_host_reader,
+            contract_bytes_per_device=10_000_000,
+        )
+        s.sample()
+        s.sample()  # pre-trigger history
+        assert glob.glob(os.path.join(rec.incident_dir, "*.json")) == []
+        s.set_contract(100_000, source="test_drill")  # 5x over
+        for _ in range(3):  # stays hot: cooldown must absorb repeats
+            s.sample()
+        paths = glob.glob(os.path.join(rec.incident_dir,
+                                       "incident_*.json"))
+        assert len(paths) == 1
+        bundle = incident.load_bundle(paths[0])  # schema gate
+        assert bundle["trigger"]["kind"] == "mem_pressure"
+        detail = bundle["trigger"]["detail"]
+        assert detail["contract_source"] == "test_drill"
+        assert detail["used_frac"] == 5.0
+        assert detail["threshold"] == memwatch.DEFAULT_PRESSURE_THRESHOLD
+        # pre-trigger watermark history rides the mem ring
+        mem_ring = bundle["rings"]["mem"]
+        assert len(mem_ring) >= 3
+        assert mem_ring[0]["used_frac"] == 0.05  # the healthy samples
+        assert mem_ring[-1]["used_frac"] == 5.0
+        assert telemetry.snapshot()["counters"]["mem.pressure_trips"] == 3
+
+    def test_threshold_none_never_triggers(self, tmp_path):
+        telemetry.set_enabled(True)
+        rec = flightrec.install(flightrec.FlightRecorder(
+            incident_dir=str(tmp_path / "incidents"),
+        ))
+        s = memwatch.MemorySampler(
+            device_reader=lambda: None,
+            host_reader=_fixed_host_reader,
+            contract_bytes_per_device=1,  # wildly over
+            pressure_threshold=None,
+        )
+        r = s.sample()
+        assert r["pressure"] is False
+        assert glob.glob(os.path.join(rec.incident_dir, "*.json")) == []
+
+    def test_mem_rules_fire_on_sustained_pressure(self):
+        """The SLO form: windowed mem.used_frac p99 over threshold in
+        every window -> the mem_pressure rule fires."""
+        from tpu_syncbn.obs import slo as obs_slo
+
+        telemetry.set_enabled(True)
+        agg = timeseries.WindowedAggregator(interval_s=1.0)
+        agg.tick(now=0.0)
+        for _ in range(20):
+            telemetry.REGISTRY.histogram(
+                "mem.used_frac", memwatch.FRAC_BUCKETS
+            ).observe(1.2)
+        agg.tick(now=1.0)
+        tracker = obs_slo.SLOTracker(agg, memwatch.mem_rules(
+            windows_s=(10.0,),
+        ))
+        out = tracker.evaluate(now=1.0)
+        assert out["mem_pressure"]["firing"] is True
+
+
+# ---------------------------------------------- recompile-storm trigger
+
+
+class TestRecompileStorm:
+    def test_bucket_churn_loop_dumps_exactly_one_bundle(self, tmp_path):
+        """Planted fault: a bucket-churn loop — 3 bucket keys rotating
+        through a 2-entry program cache, so the SAME key keeps getting
+        evicted and rebuilt — crosses the per-program storm threshold
+        -> exactly ONE schema-valid recompile_storm bundle whose
+        compile ring shows the pre-trigger compile history."""
+        from tpu_syncbn.parallel import scan_driver
+
+        telemetry.set_enabled(True)
+        rec = flightrec.install(flightrec.FlightRecorder(
+            incident_dir=str(tmp_path / "incidents"),
+        ))
+        profiling.set_detector(profiling.RecompileDetector(
+            window_s=3600.0, threshold=4,
+        ))
+        cache = scan_driver.ProgramCache(name="serve", max_entries=2)
+        for i in range(10):  # 3 keys through 2 slots: every call a miss
+            scan_driver.cached_program(cache, i % 3, lambda: object())
+        paths = glob.glob(os.path.join(rec.incident_dir,
+                                       "incident_*.json"))
+        assert len(paths) == 1
+        bundle = incident.load_bundle(paths[0])  # schema gate
+        assert bundle["trigger"]["kind"] == "recompile_storm"
+        detail = bundle["trigger"]["detail"]
+        assert detail["family"] == "serve"
+        assert detail["program"]  # the churning bucket is named
+        assert detail["compiles"] == 4
+        # pre-trigger compile history rides the compile ring
+        ring = bundle["rings"]["compile"]
+        assert len(ring) >= 4
+        assert all(e["family"] == "serve" for e in ring)
+        assert all("seconds" in e and "program" in e for e in ring)
+        snap = telemetry.snapshot()["counters"]
+        assert snap["compile.events_total"] == 10
+        assert snap["compile.serve.events"] == 10
+        assert snap["compile.storms"] == 1
+        assert snap["serve.program_cache.misses"] == 10
+
+    def test_warming_distinct_buckets_is_not_a_storm(self, tmp_path):
+        """The false-positive budget: engine.warm compiling N distinct
+        buckets back-to-back (a healthy startup) must NOT trip the
+        detector — the window is per (family, program)."""
+        from tpu_syncbn.parallel import scan_driver
+
+        telemetry.set_enabled(True)
+        rec = flightrec.install(flightrec.FlightRecorder(
+            incident_dir=str(tmp_path / "incidents"),
+        ))
+        profiling.set_detector(profiling.RecompileDetector(
+            window_s=3600.0, threshold=4,
+        ))
+        cache = scan_driver.ProgramCache(name="serve", max_entries=16)
+        for bucket in range(8):  # 8 distinct buckets, one compile each
+            scan_driver.cached_program(cache, bucket, lambda: object())
+        assert glob.glob(os.path.join(rec.incident_dir, "*.json")) == []
+        snap = telemetry.snapshot()["counters"]
+        assert snap["compile.serve.events"] == 8
+        assert snap.get("compile.storms", 0) == 0
+
+    def test_slow_compiles_outside_window_stay_quiet(self, tmp_path):
+        rec = flightrec.install(flightrec.FlightRecorder(
+            incident_dir=str(tmp_path / "incidents"),
+        ))
+        clock = [0.0]
+        det = profiling.RecompileDetector(
+            window_s=10.0, threshold=3, now=lambda: clock[0],
+        )
+        for _ in range(6):  # one compile per 20s: never 3 in a window
+            det.note("train")
+            clock[0] += 20.0
+        assert glob.glob(os.path.join(rec.incident_dir, "*.json")) == []
+        assert det.storms == {}
+
+    def test_first_dispatch_latch_counts_once(self):
+        """DataParallel's first train_step is a compile event; later
+        steps are not."""
+        import jax.numpy as jnp
+        import optax
+        from flax import nnx
+
+        from tpu_syncbn import nn as tnn, parallel
+
+        telemetry.set_enabled(True)
+
+        class Net(nnx.Module):
+            def __init__(self, rngs):
+                self.fc = nnx.Linear(4, 4, rngs=rngs)
+
+            def __call__(self, x):
+                return self.fc(x)
+
+        dp = parallel.DataParallel(
+            Net(nnx.Rngs(0)), optax.sgd(0.1),
+            lambda m, b: (m(b) ** 2).mean(),
+        )
+        batch = jnp.ones((8, 4), jnp.float32)
+        dp.train_step(batch)
+        dp.train_step(batch)
+        snap = telemetry.snapshot()["counters"]
+        assert snap["compile.train.events"] == 1
+        hist = telemetry.snapshot()["histograms"]["compile.time_s"]
+        assert hist["count"] == 1 and hist["sum"] > 0
+
+    def test_compile_rules_shape(self):
+        rules = profiling.compile_rules(total="serve.requests")
+        assert [r.name for r in rules] == ["recompile_storm"]
+        assert rules[0].objective.total == "serve.requests"
+        assert rules[0].objective.bad == "compile.events_total"
+
+
+# ----------------------------------------------- program-cache gauges
+
+
+class TestProgramCacheGauges:
+    def test_bytes_live_is_a_live_metrics_gauge(self):
+        """ISSUE 14 satellite: cache occupancy is on /metrics, not just
+        stats() snapshots."""
+        from tpu_syncbn.parallel import scan_driver
+
+        telemetry.set_enabled(True)
+        cache = scan_driver.ProgramCache(name="serve", max_bytes=1_000)
+        scan_driver.cached_program(cache, "a", lambda: object(),
+                                   size_of=lambda fn: 400)
+        gauges = telemetry.snapshot()["gauges"]
+        assert gauges["serve.program_cache.bytes_live"] == 400
+        assert gauges["serve.program_cache.live"] == 1
+        assert gauges["serve.program_cache.fill_frac"] == 0.4
+        # eviction pressure moves the gauge down again
+        scan_driver.cached_program(cache, "b", lambda: object(),
+                                   size_of=lambda fn: 900)
+        gauges = telemetry.snapshot()["gauges"]
+        assert gauges["serve.program_cache.bytes_live"] == 900
+        assert gauges["serve.program_cache.live"] == 1
+        assert cache.evictions == 1
+        body = obs_server.render_prometheus(telemetry.snapshot())
+        assert "tpu_syncbn_serve_program_cache_bytes_live 900" in body
+
+    def test_live_cache_bytes_sums_across_caches(self):
+        from tpu_syncbn.parallel import scan_driver
+
+        before = scan_driver.live_cache_bytes()
+        c1 = scan_driver.ProgramCache()
+        c2 = scan_driver.ProgramCache()
+        scan_driver.cached_program(c1, 1, lambda: object(),
+                                   size_of=lambda fn: 100)
+        scan_driver.cached_program(c2, 1, lambda: object(),
+                                   size_of=lambda fn: 250)
+        assert scan_driver.live_cache_bytes() - before == 350
+        del c2
+        import gc
+
+        gc.collect()
+        assert scan_driver.live_cache_bytes() - before == 100
+
+
+# ------------------------------------------------------------ profilez
+
+
+class TestProfilez:
+    def _post(self, port, query=""):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/profilez{query}",
+            method="POST", data=b"",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_without_knob_503s(self, monkeypatch):
+        monkeypatch.delenv("TPU_SYNCBN_PROFILE_DIR", raising=False)
+        with obs_server.MonitoringServer(port=0, host="127.0.0.1") as srv:
+            status, payload = self._post(srv.port)
+        assert status == 503
+        assert payload["ok"] is False
+        assert "TPU_SYNCBN_PROFILE_DIR" in payload["error"]
+
+    def test_with_knob_200_and_capped_bytes(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_SYNCBN_PROFILE_DIR", str(tmp_path))
+        with obs_server.MonitoringServer(port=0, host="127.0.0.1") as srv:
+            status, payload = self._post(srv.port, "?duration_s=0.05")
+        assert status == 200, payload
+        assert payload["ok"] is True
+        assert payload["path"].startswith(str(tmp_path))
+        assert os.path.isdir(payload["path"])
+        assert 0 < payload["bytes"] <= profiling.DEFAULT_PROFILE_MAX_BYTES
+        # atomic-dir contract: no hidden temp capture left behind
+        assert not [n for n in os.listdir(tmp_path)
+                    if n.startswith(".capture_")]
+
+    def test_bad_duration_400s(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_SYNCBN_PROFILE_DIR", str(tmp_path))
+        with obs_server.MonitoringServer(port=0, host="127.0.0.1") as srv:
+            status, payload = self._post(srv.port, "?duration_s=nope")
+        assert status == 400 and payload["ok"] is False
+
+    def test_duration_clamped_to_cap(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_SYNCBN_PROFILE_MAX_S", "0.05")
+        out = profiling.capture(999.0, log_dir=str(tmp_path))
+        assert out["duration_s"] == 0.05
+
+    def test_back_to_back_captures_do_not_collide(self, tmp_path):
+        """Two captures in the same wall-clock second get distinct
+        final dirs (the per-process sequence suffix) — neither is
+        deleted by an os.replace onto the other."""
+        a = profiling.capture(0.01, log_dir=str(tmp_path))
+        b = profiling.capture(0.01, log_dir=str(tmp_path))
+        assert a["path"] != b["path"]
+        assert os.path.isdir(a["path"]) and os.path.isdir(b["path"])
+
+    def test_capture_without_dir_raises(self, monkeypatch):
+        monkeypatch.delenv("TPU_SYNCBN_PROFILE_DIR", raising=False)
+        with pytest.raises(profiling.ProfilerUnavailable):
+            profiling.capture(0.01)
+
+    def test_over_size_cap_is_deleted(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_SYNCBN_PROFILE_MAX_BYTES", "1")
+        with pytest.raises(ValueError):
+            profiling.capture(0.05, log_dir=str(tmp_path))
+        assert os.listdir(tmp_path) == []  # over-cap capture deleted
+
+
+# ------------------------------------------------------ bundle compat
+
+
+class TestBundleCompat:
+    def test_pre_issue14_bundle_without_new_rings_still_validates(
+        self, tmp_path
+    ):
+        """mem/compile rings are optional within bundle schema 1: a
+        bundle written before ISSUE 14 must keep loading (the
+        upgrade-window post-mortem case)."""
+        rec = flightrec.install(flightrec.FlightRecorder(
+            incident_dir=str(tmp_path / "incidents"),
+        ))
+        path = rec.trigger("manual", force=True)
+        bundle = incident.load_bundle(path)
+        del bundle["rings"]["mem"]
+        del bundle["rings"]["compile"]
+        incident.validate_bundle(bundle)  # must not raise
+
+
+# ------------------------------------------------------- deprecations
+
+
+class TestDeprecatedProfilerTrace:
+    def test_utils_alias_warns_and_delegates(self, tmp_path):
+        from tpu_syncbn import utils
+
+        with pytest.warns(DeprecationWarning, match="obs.profiling"):
+            cm = utils.profiler_trace(str(tmp_path), enabled=False)
+        with cm:
+            pass  # enabled=False: no jax.profiler touched
+
+    def test_obs_profiling_trace_writes_files(self, tmp_path):
+        with profiling.profiler_trace(str(tmp_path)):
+            import jax.numpy as jnp
+
+            (jnp.ones((8,)) + 1).block_until_ready()
+        found = [os.path.join(r, f)
+                 for r, _, fs in os.walk(tmp_path) for f in fs]
+        assert found, "profiler_trace produced no trace files"
+
+
+# ---------------------------------------------------------- /statusz
+
+
+class TestStatuszSections:
+    def test_memory_and_compile_sections_render_live_state(self):
+        telemetry.set_enabled(True)
+        memwatch.MemorySampler(
+            device_reader=lambda: None,
+            host_reader=_fixed_host_reader,
+            contract_bytes_per_device=1_000_000,
+        ).sample()
+        profiling.note_compile("train", 0.25)
+        text = obs_server.render_statusz(obs_server.statusz_report())
+        assert "mem.headroom_frac" in text
+        assert "mem.samples" in text
+        assert "compile.events_total" in text
+        assert "compile.train.events" in text
+        assert "compile.time_s.count" in text

@@ -43,10 +43,14 @@ PREFIX_RE = re.compile(r"^[a-z0-9_]+$")
 #: ``monitor.heartbeat_age_s`` — pinned in obs.server.MONITOR_METRICS);
 #: ``numerics`` is ISSUE 13's drift/compression-health family
 #: (``obs.numerics`` — docs/OBSERVABILITY.md "Numerics & drift").
+#: ``mem`` / ``compile`` are ISSUE 14's memory-and-compile families
+#: (``obs.memwatch`` / ``obs.profiling`` — docs/OBSERVABILITY.md
+#: "Memory & compile").
 KNOWN_METRIC_PREFIXES = frozenset({
-    "audit", "bench", "checkpoint", "collectives", "data", "events",
-    "gan", "incident", "loader", "monitor", "numerics", "obs", "probe",
-    "rendezvous", "resilience", "scan", "serve", "slo", "step", "train",
+    "audit", "bench", "checkpoint", "collectives", "compile", "data",
+    "events", "gan", "incident", "loader", "mem", "monitor", "numerics",
+    "obs", "probe", "rendezvous", "resilience", "scan", "serve", "slo",
+    "step", "train",
 })
 
 _SUPPRESS_RE = re.compile(r"#\s*audit:\s*ok(?:\[([a-z0-9_,\s]+)\])?")
@@ -152,6 +156,11 @@ RAW_APIS: dict[str, str] = {
     "jax.lax.pcast": "collectives.pcast_varying",
     "lax.axis_size": "compat.axis_size",
     "jax.lax.axis_size": "compat.axis_size",
+    # not a compat shim but the same discipline (ISSUE 14): the raw
+    # profiler is a process singleton with no duration/size bound —
+    # obs.profiling owns the bounded, single-flight capture path
+    "jax.profiler.start_trace": "obs.profiling.profiler_trace / .capture",
+    "jax.profiler.stop_trace": "obs.profiling.profiler_trace / .capture",
 }
 
 #: ``from <module> import <name>`` forms of the same bypasses — the
@@ -165,6 +174,10 @@ RAW_IMPORT_FROMS: dict[tuple[str, str], str] = {
     ("jax.lax", "pcast"): "collectives.pcast_varying",
     ("jax.lax", "axis_size"): "compat.axis_size",
     ("flax.nnx", "merge"): "compat.nnx_merge",
+    ("jax.profiler", "start_trace"):
+        "obs.profiling.profiler_trace / .capture",
+    ("jax.profiler", "stop_trace"):
+        "obs.profiling.profiler_trace / .capture",
 }
 
 #: (file suffix, dotted api) pairs allowed to touch the raw API — the
@@ -174,6 +187,10 @@ RAW_API_ALLOW: tuple[tuple[str, str], ...] = (
     ("tpu_syncbn/compat.py", "*"),
     ("tpu_syncbn/parallel/collectives.py", "lax.pcast"),
     ("tpu_syncbn/parallel/collectives.py", "jax.lax.pcast"),
+    # obs/profiling.py is the one documented home of the raw profiler
+    # start/stop (bounded capture + the library context manager)
+    ("tpu_syncbn/obs/profiling.py", "jax.profiler.start_trace"),
+    ("tpu_syncbn/obs/profiling.py", "jax.profiler.stop_trace"),
 )
 
 

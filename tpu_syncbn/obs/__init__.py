@@ -36,6 +36,17 @@ Six modules, one budget rule — near-zero cost when off:
   writer, rank-0 merge (through ``merge_exports``), and the
   explained-step-time attribution report
   (``python -m tpu_syncbn.obs.incident inspect|diff|merge``).
+* :mod:`tpu_syncbn.obs.memwatch` — live device-memory watermarks
+  (env-gated ``TPU_SYNCBN_MEMWATCH`` background sampler; CPU fallback
+  to host RSS + program-cache bytes + a bounded live-array census), the
+  static-vs-live reconciler against the sharding auditor's pinned
+  per-device peak (``mem.headroom_frac``), and the ``mem_pressure``
+  incident trigger + ``mem_rules()`` SLO.
+* :mod:`tpu_syncbn.obs.profiling` — compile-seam observability
+  (``compile.*`` counters/histogram, the recompile-storm detector +
+  ``recompile_storm`` incident trigger + ``compile_rules()`` SLO) and
+  bounded on-demand ``jax.profiler`` capture (``POST /profilez``,
+  env-gated ``TPU_SYNCBN_PROFILE_DIR``).
 
 See docs/OBSERVABILITY.md for knobs, schemas, the Perfetto how-to, and
 the live-monitoring quickstart.
@@ -44,7 +55,9 @@ the live-monitoring quickstart.
 from tpu_syncbn.obs import (  # noqa: F401
     flightrec,
     incident,
+    memwatch,
     numerics,
+    profiling,
     server,
     slo,
     stepstats,
@@ -53,6 +66,8 @@ from tpu_syncbn.obs import (  # noqa: F401
     tracing,
 )
 from tpu_syncbn.obs.flightrec import FlightRecorder  # noqa: F401
+from tpu_syncbn.obs.memwatch import MemorySampler  # noqa: F401
+from tpu_syncbn.obs.profiling import RecompileDetector  # noqa: F401
 from tpu_syncbn.obs.server import MONITOR_METRICS, MonitoringServer  # noqa: F401
 from tpu_syncbn.obs.slo import AlertRule, Availability, SLOTracker  # noqa: F401
 from tpu_syncbn.obs.telemetry import (  # noqa: F401
@@ -76,7 +91,11 @@ __all__ = [
     "slo",
     "flightrec",
     "incident",
+    "memwatch",
+    "profiling",
     "FlightRecorder",
+    "MemorySampler",
+    "RecompileDetector",
     "RingTracer",
     "REGISTRY",
     "Registry",

@@ -25,7 +25,13 @@ circuit breaker opens, the seconds *before* the event are gone. The
 * a ring of recent **serve decisions** — admission sheds, rejections,
   deadline misses, circuit-breaker transitions, recorded by
   :class:`~tpu_syncbn.serve.batcher.DynamicBatcher` and
-  :class:`~tpu_syncbn.serve.admission.CircuitBreaker`.
+  :class:`~tpu_syncbn.serve.admission.CircuitBreaker`;
+* a ring of recent **memory watermarks** — per-sample device/host
+  readings recorded by :class:`~tpu_syncbn.obs.memwatch.MemorySampler`,
+  so an OOM post-mortem has the pre-pressure history;
+* a ring of recent **compile events** — one entry per compile seam
+  (:func:`tpu_syncbn.obs.profiling.note_compile`), the evidence a
+  ``recompile_storm`` bundle names the churning family with.
 
 On a trigger (:meth:`FlightRecorder.trigger` — fired by the SLO
 tracker, the divergence guard, the watchdog, the circuit breaker, or
@@ -129,6 +135,8 @@ class FlightRecorder:
         span_capacity: int = 2048,
         step_capacity: int = 512,
         serve_capacity: int = 512,
+        mem_capacity: int = 512,
+        compile_capacity: int = 256,
         registry: telemetry.Registry | None = None,
         aggregator: timeseries.WindowedAggregator | None = None,
         interval_s: float = 1.0,
@@ -141,6 +149,8 @@ class FlightRecorder:
         for name, v in (("span_capacity", span_capacity),
                         ("step_capacity", step_capacity),
                         ("serve_capacity", serve_capacity),
+                        ("mem_capacity", mem_capacity),
+                        ("compile_capacity", compile_capacity),
                         ("max_bundles", max_bundles)):
             if v < 1:
                 raise ValueError(f"{name} must be >= 1, got {v}")
@@ -166,6 +176,8 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._steps: deque = deque(maxlen=int(step_capacity))
         self._serve: deque = deque(maxlen=int(serve_capacity))
+        self._mem: deque = deque(maxlen=int(mem_capacity))
+        self._compile: deque = deque(maxlen=int(compile_capacity))
         self._contract: dict = {}
         self._seq = 0
         self._last_dump_t: float | None = None
@@ -241,6 +253,21 @@ class FlightRecorder:
         with self._lock:
             self._serve.append(entry)
 
+    def record_mem(self, **reading) -> None:
+        """Append one memory-watermark reading (JSON scalars — the
+        sampler already flattened device stats) to the mem ring."""
+        entry = {"t": self._now(), **reading}
+        with self._lock:
+            self._mem.append(entry)
+
+    def record_compile(self, family: str, seconds=None, **detail) -> None:
+        """Append one compile-seam event to the compile ring."""
+        entry = {"family": str(family), "t": self._now(), **detail}
+        if seconds is not None:
+            entry["seconds"] = round(float(seconds), 6)
+        with self._lock:
+            self._compile.append(entry)
+
     def set_contract(self, **fields) -> None:
         """Merge static program-contract facts into the recorder —
         ``flops_per_step`` (HLO cost analysis),
@@ -264,6 +291,8 @@ class FlightRecorder:
         with self._lock:
             steps = list(self._steps)
             serve = list(self._serve)
+            mem = list(self._mem)
+            compiles = list(self._compile)
         return {
             "steps": [
                 {
@@ -277,6 +306,17 @@ class FlightRecorder:
                 {k: (_scalarize(v) if k != "kind" else v)
                  for k, v in e.items()}
                 for e in serve
+            ],
+            "mem": [
+                {k: (_scalarize(v) if k not in ("source",
+                                                "contract_source") else v)
+                 for k, v in e.items()}
+                for e in mem
+            ],
+            "compile": [
+                {k: (_scalarize(v) if k != "family" else v)
+                 for k, v in e.items()}
+                for e in compiles
             ],
         }
 
@@ -420,6 +460,14 @@ def record_serve(kind: str, **detail) -> None:
     rec = _installed
     if rec is not None:
         rec.record_serve(kind, **detail)
+
+
+def record_compile(family: str, seconds=None, **detail) -> None:
+    """Feed one compile-seam event to the installed recorder (no-op
+    without one)."""
+    rec = _installed
+    if rec is not None:
+        rec.record_compile(family, seconds, **detail)
 
 
 def trigger(

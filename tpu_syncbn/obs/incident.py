@@ -54,7 +54,8 @@ MERGED_KIND = "tpu_syncbn.incident_merged"
 #: yields exactly one schema-valid bundle). Custom kinds are allowed
 #: (schema token form) — these are the wired ones.
 TRIGGER_KINDS = ("slo_alert", "divergence_restore", "watchdog_stall",
-                 "circuit_open", "numerics_drift", "manual")
+                 "circuit_open", "numerics_drift", "mem_pressure",
+                 "recompile_storm", "manual")
 
 _KIND_RE = re.compile(r"^[a-z0-9_]+$")
 
@@ -253,12 +254,25 @@ def validate_bundle(bundle) -> dict:
     for ring in ("steps", "serve"):
         if not isinstance(rings.get(ring), list):
             raise ValueError(f"bundle rings.{ring} must be a list")
+    # mem/compile rings (ISSUE 14) are optional within schema 1: bundles
+    # written before they existed must keep loading — a post-mortem diff
+    # of a pre-upgrade bundle against a post-upgrade one is exactly the
+    # upgrade-window use case
+    for ring in ("mem", "compile"):
+        if ring in rings and not isinstance(rings[ring], list):
+            raise ValueError(f"bundle rings.{ring} must be a list")
     for e in rings["steps"]:
         if not isinstance(e, dict) or not isinstance(e.get("step"), int):
             raise ValueError(f"bundle step-ring entry unusable: {e!r}")
     for e in rings["serve"]:
         if not isinstance(e, dict) or not isinstance(e.get("kind"), str):
             raise ValueError(f"bundle serve-ring entry unusable: {e!r}")
+    for e in rings.get("mem", ()):
+        if not isinstance(e, dict):
+            raise ValueError(f"bundle mem-ring entry unusable: {e!r}")
+    for e in rings.get("compile", ()):
+        if not isinstance(e, dict) or not isinstance(e.get("family"), str):
+            raise ValueError(f"bundle compile-ring entry unusable: {e!r}")
     state = bundle.get("state")
     if not isinstance(state, dict) \
             or not isinstance(state.get("heartbeat_age_s"), dict) \

@@ -221,6 +221,28 @@ def statusz_report(
         name: value for name, value in snap["counters"].items()
         if name.startswith("numerics.")
     }
+    # memory + compile (obs.memwatch / obs.profiling — ISSUE 14): live
+    # watermark gauges vs the pinned contract, and the compile-seam
+    # counters with the time histogram's totals, so recompile churn and
+    # shrinking headroom are on the one-glance page
+    memory = {
+        name: value for name, value in snap["gauges"].items()
+        if name.startswith("mem.")
+    }
+    memory_counters = {
+        name: value for name, value in snap["counters"].items()
+        if name.startswith("mem.")
+    }
+    compiles = {
+        name: value for name, value in snap["counters"].items()
+        if name.startswith("compile.")
+    }
+    compile_hist = snap["histograms"].get("compile.time_s")
+    if compile_hist is not None:
+        compiles["compile.time_s.count"] = compile_hist.get("count")
+        compiles["compile.time_s.sum"] = round(
+            compile_hist.get("sum", 0.0), 4
+        )
     rec = flightrec.get()
     return {
         "heartbeat_age_s": {
@@ -232,6 +254,9 @@ def statusz_report(
         "program_caches": caches,
         "numerics": numerics,
         "numerics_counters": numerics_counters,
+        "memory": memory,
+        "memory_counters": memory_counters,
+        "compiles": compiles,
         "train_step": snap["gauges"].get("train.step"),
         "last_incident": rec.last_incident if rec is not None else None,
         "recorder_installed": rec is not None,
@@ -317,6 +342,27 @@ def render_statusz(report: dict) -> str:
     else:
         lines.append("  (no numerics monitors published)")
     lines.append("")
+    lines.append("memory")
+    memory = report.get("memory") or {}
+    mcounters = report.get("memory_counters") or {}
+    if memory or mcounters:
+        for name, value in sorted(memory.items()):
+            v_s = f"{value:g}" if isinstance(value, (int, float)) else value
+            lines.append(f"  {name:<36} {v_s}")
+        for name, value in sorted(mcounters.items()):
+            lines.append(f"  {name:<36} {value}")
+    else:
+        lines.append("  (no memory telemetry — set TPU_SYNCBN_MEMWATCH=1)")
+    lines.append("")
+    lines.append("compiles")
+    compiles = report.get("compiles") or {}
+    if compiles:
+        for name, value in sorted(compiles.items()):
+            v_s = f"{value:g}" if isinstance(value, (int, float)) else value
+            lines.append(f"  {name:<36} {v_s}")
+    else:
+        lines.append("  (none observed)")
+    lines.append("")
     lines.append("last incident")
     inc = report.get("last_incident")
     if inc:
@@ -383,16 +429,37 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(404, {"error": f"no route {path!r}",
                                   "routes": ["/metrics", "/healthz",
                                              "/readyz", "/statusz",
-                                             "POST /incidentz"]})
+                                             "POST /incidentz",
+                                             "POST /profilez"]})
 
     def do_POST(self):  # noqa: N802 (http.server API)
         from tpu_syncbn.obs import flightrec
 
         telemetry.count("obs.server.requests")
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
+        if path == "/profilez":
+            from urllib.parse import parse_qs
+
+            from tpu_syncbn.obs import profiling
+
+            duration_s = None
+            try:
+                raw = parse_qs(query).get("duration_s")
+                if raw:
+                    duration_s = float(raw[0])
+            except ValueError:
+                self._send_json(400, {
+                    "ok": False,
+                    "error": "duration_s must be a number",
+                })
+                return
+            code, payload = profiling.serve_capture(duration_s)
+            self._send_json(code, payload)
+            return
         if path != "/incidentz":
             self._send_json(404, {"error": f"no POST route {path!r}",
-                                  "routes": ["POST /incidentz"]})
+                                  "routes": ["POST /incidentz",
+                                             "POST /profilez"]})
             return
         rec = flightrec.get()
         if rec is None:

@@ -19,6 +19,7 @@ pmean'd per network (DDP parity), BatchStats broadcast from replica 0.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Callable
 
 import jax
@@ -132,6 +133,9 @@ class GANTrainer:
         self.step_count = 0
         self._donate = bool(donate)
         self._step = self._build_step(donate)
+        # first-dispatch compile latch (obs.profiling — the
+        # DataParallel.train_step precedent)
+        self._first_dispatch_noted = False
         from tpu_syncbn.parallel import scan_driver
 
         # n_steps -> scanned jit (FIFO-bounded, hit/miss/eviction counted)
@@ -342,6 +346,7 @@ class GANTrainer:
                              monitors=monitors)
 
     def train_step(self, real, z_d, z_g) -> GANStepOutput:
+        t0 = time.perf_counter() if not self._first_dispatch_noted else None
         (
             self.g_params, self.g_rest, self.d_params, self.d_rest,
             self.g_opt_state, self.d_opt_state, d_loss, g_loss, metrics,
@@ -350,6 +355,11 @@ class GANTrainer:
             self.g_params, self.g_rest, self.d_params, self.d_rest,
             self.g_opt_state, self.d_opt_state, real, z_d, z_g,
         )
+        if t0 is not None:
+            self._first_dispatch_noted = True
+            from tpu_syncbn.obs import profiling
+
+            profiling.note_compile("gan", time.perf_counter() - t0)
         self.step_count += 1
         if flightrec.get() is not None:
             # step ring (ISSUE 13 satellite): GAN incidents used to dump

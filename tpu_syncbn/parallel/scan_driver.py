@@ -36,6 +36,7 @@ Contract notes:
 
 from __future__ import annotations
 
+import weakref
 from typing import Any, Callable, Sequence
 
 import jax
@@ -46,6 +47,21 @@ from tpu_syncbn.compat import shard_map
 #: Compiled fused programs retained per trainer cache (FIFO beyond this):
 #: each distinct (n_steps, stacked) pair is its own XLA program.
 MAX_CACHED_PROGRAMS = 4
+
+#: Every live ProgramCache, weakly held (keyed by id — a dict subclass
+#: is unhashable) — the memory sampler's CPU fallback
+#: (obs.memwatch.host_readings) sums their ``bytes_live`` without
+#: owning their lifetime.
+_LIVE_CACHES: "weakref.WeakValueDictionary[int, ProgramCache]" = (
+    weakref.WeakValueDictionary()
+)
+
+
+def live_cache_bytes() -> int:
+    """Summed ``bytes_live`` over every live :class:`ProgramCache` in
+    the process — the program-cache term of the memory sampler's host
+    census (docs/OBSERVABILITY.md "Memory & compile")."""
+    return sum(cache.bytes_live for cache in list(_LIVE_CACHES.values()))
 
 
 def stack_batch_spec(spec: P) -> P:
@@ -186,6 +202,7 @@ class ProgramCache(dict):
         self.misses = 0
         self.evictions = 0
         self._sizes: dict = {}  # key -> known size in bytes
+        _LIVE_CACHES[id(self)] = self
 
     def _record(self, event: str) -> None:
         setattr(self, event, getattr(self, event) + 1)
@@ -193,6 +210,27 @@ class ProgramCache(dict):
             from tpu_syncbn.obs import telemetry
 
             telemetry.count(f"{self.name}.program_cache.{event}")
+
+    def _publish_gauges(self) -> None:
+        """Live cache-occupancy gauges (``<name>.program_cache.
+        bytes_live`` / ``.live`` / ``.fill_frac``) — today ``stats()``
+        snapshots are the only view, so one tenant's cache churn is
+        invisible on ``/metrics`` (ROADMAP item 4's shared-budget
+        pre-work). Called on the mutation path (a build); no-op for
+        anonymous caches and when telemetry is off."""
+        if self.name is None:
+            return
+        from tpu_syncbn.obs import telemetry
+
+        bytes_live = self.bytes_live
+        telemetry.set_gauge(f"{self.name}.program_cache.bytes_live",
+                            bytes_live)
+        telemetry.set_gauge(f"{self.name}.program_cache.live", len(self))
+        if self.max_bytes:
+            telemetry.set_gauge(
+                f"{self.name}.program_cache.fill_frac",
+                round(bytes_live / self.max_bytes, 4),
+            )
 
     @property
     def bytes_live(self) -> int:
@@ -259,7 +297,18 @@ def cached_program(cache: dict, key, build: Callable[[], Any],
             cache._touch(key)
             return dict.__getitem__(cache, key)
         cache._record("misses")
-        fn = build()
+        # every miss is a compile-seam event (obs.profiling): counted,
+        # timed (build/trace here; the engine's build is a full AOT
+        # compile), ring-recorded, and fed to the recompile-storm
+        # detector, which windows per (family, program) — REBUILDING
+        # one key is churn, building N distinct keys (engine.warm over
+        # its bucket set) is a healthy startup. Import + token stay on
+        # the miss path: a hit must cost what it always did.
+        from tpu_syncbn.obs import profiling
+
+        with profiling.timed_compile(cache.name or "program",
+                                     program=f"{hash(key) & 0xFFFFFFFF:08x}"):
+            fn = build()
         if key in cache:  # stale stored-None: rebuilt entry goes to
             dict.pop(cache, key)  # the back of the eviction order
             cache._sizes.pop(key, None)
@@ -272,10 +321,16 @@ def cached_program(cache: dict, key, build: Callable[[], Any],
             if size is not None and size > 0:
                 cache._sizes[key] = int(size)
         cache._evict_over_budget()
+        cache._publish_gauges()
         return fn
     fn = cache.get(key)
     if fn is None:
         while len(cache) >= MAX_CACHED_PROGRAMS:
             cache.pop(next(iter(cache)))
-        fn = cache[key] = build()
+        from tpu_syncbn.obs import profiling
+
+        with profiling.timed_compile(
+            "program", program=f"{hash(key) & 0xFFFFFFFF:08x}"
+        ):
+            fn = cache[key] = build()
     return fn

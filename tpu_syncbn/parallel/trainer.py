@@ -39,6 +39,7 @@ tuning.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable
 
 import jax
@@ -495,6 +496,11 @@ class DataParallel:
 
         self._donate = donate
         self._train_step = self._build_train_step(donate)
+        # first-dispatch compile latch (obs.profiling): the jit above
+        # compiles on its first call, which is a compile seam the
+        # recompile-storm detector must see (a hot weight swap that
+        # rebuilds the trainer re-pays it)
+        self._first_dispatch_noted = False
         from tpu_syncbn.parallel import scan_driver
 
         # n_steps -> scanned jit (FIFO-bounded, hit/miss/eviction counted)
@@ -1063,6 +1069,7 @@ class DataParallel:
     def train_step(self, batch) -> StepOutput:
         """One optimizer step on a *global* batch (sharded or shardable
         along axis 0 across the mesh)."""
+        t0 = time.perf_counter() if not self._first_dispatch_noted else None
         (
             self._param_store,
             self.rest,
@@ -1071,6 +1078,13 @@ class DataParallel:
             metrics,
             monitors,
         ) = self._train_step(self._param_store, self.rest, self.opt_state, batch)
+        if t0 is not None:
+            # first dispatch = XLA compile (+ one execution, async on
+            # real hardware): one compile.train event, time tagged
+            self._first_dispatch_noted = True
+            from tpu_syncbn.obs import profiling
+
+            profiling.note_compile("train", time.perf_counter() - t0)
         return StepOutput(loss=loss, metrics=metrics, monitors=monitors)
 
     def eval_step(self, batch) -> StepOutput:

@@ -683,6 +683,13 @@ class ResilientLoop:
         # recent spans/monitors and dumps an incident bundle on a
         # divergence restore, watchdog stall, SLO alert, or /incidentz
         flightrec.install_from_env()
+        # memory watermarks (docs/OBSERVABILITY.md "Memory & compile"):
+        # with TPU_SYNCBN_MEMWATCH set this run samples device/host
+        # memory in the background; pinned-contract pressure dumps a
+        # mem_pressure bundle before the allocator OOMs the loop
+        from tpu_syncbn.obs import memwatch as obs_memwatch
+
+        obs_memwatch.install_from_env()
         obs_server.register_readiness("train", self.readiness)
         wire_tally = DispatchWireTally()
         # numerics drift/compression telemetry (docs/OBSERVABILITY.md

@@ -207,6 +207,13 @@ class DynamicBatcher:
         # transitions) ring-buffer into it; a circuit open dumps a
         # bundle. TPU_SYNCBN_FLIGHTREC is the whole knob.
         flightrec.install_from_env()
+        # memory watermarks (docs/OBSERVABILITY.md "Memory & compile"):
+        # TPU_SYNCBN_MEMWATCH arms the background sampler — bucket churn
+        # evicting programs and a tenant walking toward OOM both become
+        # visible (and incident-triggering) without code changes
+        from tpu_syncbn.obs import memwatch
+
+        memwatch.install_from_env()
         obs_server.register_readiness(self._health_name, self.readiness)
         self._thread = threading.Thread(
             target=self._run, name="dynamic-batcher", daemon=True

@@ -19,8 +19,6 @@ import math
 import os
 import time
 
-import jax
-
 from tpu_syncbn.obs.telemetry import CounterGroup
 
 
@@ -101,20 +99,26 @@ class EventCounter(CounterGroup):
         return f"EventCounter({self.summary()!r})"
 
 
-@contextlib.contextmanager
 def profiler_trace(log_dir: str, *, enabled: bool = True):
-    """``jax.profiler`` trace around a code region (view in TensorBoard /
-    Perfetto). Master host only; no-op when disabled."""
-    from tpu_syncbn.runtime import distributed as dist
+    """Deprecated alias for
+    :func:`tpu_syncbn.obs.profiling.profiler_trace` — the raw
+    ``jax.profiler`` helper now lives in the obs plane (next to the
+    bounded ``POST /profilez`` capture and the compile-seam counters;
+    docs/OBSERVABILITY.md "Memory & compile"), and the
+    ``raw_api_bypass`` lint keeps raw profiler starts out of everything
+    else. Same contract: master host only, no-op when disabled."""
+    import warnings
 
-    if not enabled or not dist.is_master():
-        yield
-        return
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+    warnings.warn(
+        "tpu_syncbn.utils.profiler_trace is deprecated; use "
+        "tpu_syncbn.obs.profiling.profiler_trace (or POST /profilez for "
+        "on-demand capture) instead",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    from tpu_syncbn.obs import profiling
+
+    return profiling.profiler_trace(log_dir, enabled=enabled)
 
 
 @contextlib.contextmanager
