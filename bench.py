@@ -818,8 +818,136 @@ def measure_monitor(agg) -> dict:
     }
 
 
+def measure_pipeline_bubbles(n_chips: int) -> dict | None:
+    """The pipeline sub-block of the ``scan`` block (ISSUE 15):
+    bubble-fraction accounting for the fused pipeline-training
+    schedules, measured on a tiny (data x pipe) mesh.
+
+    For GPipe and 1F1B the same micro-model trains for a few steps and
+    the measured bubble is ``1 − t_dense / t_schedule``, where
+    ``t_dense`` times the SAME compiled tick body on the zero-bubble
+    timing reference (``pipeline_schedule.dense_timing_schedule``: every
+    slot active, ``T = M`` ticks). Predicted is the tick-table
+    arithmetic ``1 − M/T`` — the lockstep-accounting number measured
+    wall time should track (docs/PERFORMANCE.md "Pipeline schedules").
+    A fused K x M chunk (``train_steps_batches``) also runs once,
+    pinning the one-dispatch-per-K-steps claim on a real trace.
+
+    Returns ``None`` on a world the (data x pipe) mesh cannot split
+    (e.g. a single device)."""
+    if n_chips < 2:
+        return None
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpu_syncbn.parallel import pipeline as pp
+    from tpu_syncbn.parallel import pipeline_schedule as ps
+
+    n = 4 if n_chips % 4 == 0 else 2
+    d = n_chips // n
+    m = 2 * n  # the M >= 2N regime the 1F1B-vs-GPipe claim is about
+    feat, per_replica_mb = 16, 2
+
+    def stage_fn(params, x):
+        return jnp.tanh(x @ params["w"] + params["b"])
+
+    def loss_fn(y, t):
+        return ((y - t) ** 2).mean()
+
+    from tpu_syncbn.obs import stepstats
+
+    tallies_before = stepstats.collective_tallies()
+    rng = np.random.default_rng(0)
+    stacked = {
+        "w": jnp.asarray(
+            rng.standard_normal((n, feat, feat)).astype(np.float32) * 0.5
+        ),
+        "b": jnp.asarray(rng.standard_normal((n, feat)).astype(np.float32)),
+    }
+    gmb = per_replica_mb * d
+    x = jnp.asarray(rng.standard_normal((m, gmb, feat)).astype(np.float32))
+    t = jnp.asarray(rng.standard_normal((m, gmb, feat)).astype(np.float32))
+    mesh = pp.pipeline_mesh(n)
+
+    def timed_steps(schedule, reps=3):
+        tr = pp.PipelineTrainer(
+            stage_fn, loss_fn, stacked, optax.sgd(1e-2),
+            num_microbatches=m, schedule=schedule, mesh=mesh,
+        )
+        out = tr.train_step((x, t))  # compile + warm
+        fetch_sync(out.loss)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = tr.train_step((x, t))
+        fetch_sync(out.loss)
+        return tr, (time.perf_counter() - t0) / reps
+
+    _, dense_s = timed_steps(ps.dense_timing_schedule(m, n))
+    schedules = {}
+    fused = None
+    for name in ("gpipe", "1f1b"):
+        sched = ps.get_schedule(name, m, n)
+        tr, step_s = timed_steps(sched)
+        schedules[name] = {
+            "ticks": sched.ticks,
+            "bubble_frac_predicted": round(sched.predicted_bubble_frac, 4),
+            "bubble_frac_measured": round(
+                max(0.0, 1.0 - dense_s / step_s), 4
+            ) if step_s > 0 else None,
+            "step_s": round(step_s, 6),
+        }
+        if name == "1f1b":
+            # the fused K x M chunk: one compiled program, ONE dispatch
+            k = 2
+            chunk = (
+                jnp.broadcast_to(x, (k,) + x.shape).copy(),
+                jnp.broadcast_to(t, (k,) + t.shape).copy(),
+            )
+            chunk = jax.device_put(chunk, tr.scan_batch_sharding)
+            out = tr.train_steps_batches(chunk)  # compile + warm
+            fetch_sync(out.loss)
+            t0 = time.perf_counter()
+            out = tr.train_steps_batches(chunk)
+            fetch_sync(out.loss)
+            fused = {
+                "k": k,
+                "dispatches": 1,  # one python call = one compiled scan
+                "chunk_s": round(time.perf_counter() - t0, 6),
+            }
+    log(
+        f"pipeline: {n} stages x {d} data, M={m} — bubble "
+        f"gpipe {schedules['gpipe']['bubble_frac_measured']} "
+        f"(predicted {schedules['gpipe']['bubble_frac_predicted']}), "
+        f"1f1b {schedules['1f1b']['bubble_frac_measured']} "
+        f"(predicted {schedules['1f1b']['bubble_frac_predicted']})"
+    )
+    # the micro-bench's own trace-time collective inventory (delta over
+    # its compiles): the pipeline programs' ppermute rings, scoped to
+    # THIS block — the headline incident contract keeps the DP
+    # program's tallies (snapshotted before this ran)
+    after = stepstats.collective_tallies()
+    collective_calls = {
+        k.split(".")[1]: int(v - tallies_before.get(k, 0))
+        for k, v in sorted(after.items())
+        if k.endswith(".calls") and v - tallies_before.get(k, 0) > 0
+    }
+    return {
+        "n_stages": n,
+        "data_world": d,
+        "microbatches": m,
+        "dense_step_s": round(dense_s, 6),
+        "canonical_gpipe_bubble": round(ps.canonical_gpipe_bubble(m, n), 4),
+        "schedules": schedules,
+        "fused": fused,
+        "collective_calls": collective_calls,
+    }
+
+
 def measure_incident(recorder, *, steps: int, wall_s: float,
-                     flops_per_step: float | None) -> dict:
+                     flops_per_step: float | None,
+                     tallies: dict | None = None) -> dict:
     """The ``incident`` block of the bench line: the flight recorder +
     incident-bundle path (docs/OBSERVABILITY.md "Incidents & flight
     recorder"), forced on the run's own state.
@@ -851,14 +979,29 @@ def measure_incident(recorder, *, steps: int, wall_s: float,
     # static contract: flops from HLO cost analysis, bytes-on-wire from
     # the trace-time collective inventory (per compiled program = per
     # step), contract identity from the pinned goldens
-    tallies = stepstats.collective_tallies()
+    # ``tallies``: the caller's snapshot of the trace-time collective
+    # inventory scoped to the program this contract describes (main()
+    # snapshots before the pipeline micro-bench traces its ppermute
+    # rings — a contract claiming another program's collectives would
+    # misattribute the wire share). Falls back to the live registry for
+    # direct callers.
+    if tallies is None:
+        tallies = stepstats.collective_tallies()
     bytes_per_step = sum(
         v for k, v in tallies.items() if k.endswith(".bytes")
     ) or None
+    # per-op call counts ride the contract too (ISSUE 15): the
+    # attribution report surfaces them, naming which collective FAMILY
+    # the wire time belongs to, not just how many bytes
+    collective_counts = {
+        k.split(".")[1]: int(v)
+        for k, v in sorted(tallies.items()) if k.endswith(".calls")
+    } or None
     recorder.set_contract(
         name="resnet50_syncbn_dp.train_step",
         flops_per_step=flops_per_step,
         collective_bytes_per_step=bytes_per_step,
+        collective_counts=collective_counts,
         fingerprint=incident_mod.contract_fingerprint(),
     )
     coverage = recorder.ring_coverage()
@@ -901,6 +1044,10 @@ def measure_incident(recorder, *, steps: int, wall_s: float,
             "shares": attr["shares"],
             "share_sum": attr["share_sum"],
             "bytes_source": attr["inputs"]["bytes_source"],
+            # per-family call counts from the static contract: names
+            # WHICH collectives own the wire share (a pipeline-shaped
+            # run shows its ppermute rings here — ISSUE 15)
+            "collective_counts": attr["inputs"]["collective_counts"],
         },
     }
 
@@ -1632,6 +1779,28 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
             f"(per-step loop {gap1}), "
             f"{scan_info['img_per_sec_per_chip']:.1f} img/s/chip fused")
 
+    # pipeline-schedule bubble accounting (ISSUE 15): always measured —
+    # the micro-mesh trainers are tiny — so every line carries the
+    # predicted-vs-measured bubble trajectory; the headline fields are
+    # 1F1B's (the shipped default schedule), the sub-block has both
+    # schedules + the fused K x M chunk. Failure nulls only itself.
+    # BEFORE it traces anything, snapshot the trace-time collective
+    # tallies: everything tallied so far belongs to the headline DP
+    # program, and the incident block's static contract must describe
+    # THAT program — not the micro-bench's ppermute rings.
+    headline_tallies = stepstats.collective_tallies()
+    try:
+        pipeline_info = measure_pipeline_bubbles(n_chips)
+    except Exception as e:
+        log(f"pipeline bubble measurement failed: {type(e).__name__}: {e}")
+        pipeline_info = None
+    one_f1b = (pipeline_info or {}).get("schedules", {}).get("1f1b", {})
+    scan_info.update({
+        "pipeline": pipeline_info,
+        "bubble_frac_predicted": one_f1b.get("bubble_frac_predicted"),
+        "bubble_frac_measured": one_f1b.get("bubble_frac_measured"),
+    })
+
     backend = jax.default_backend()
     flops_source = (f"live-hlo-cost-analysis({backend})"
                     if flops_per_step else None)
@@ -1706,6 +1875,7 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
             incident_info = measure_incident(
                 recorder, steps=steps, wall_s=dt,
                 flops_per_step=flops_per_step,
+                tallies=headline_tallies,
             )
         log(f"incident: bundle {incident_info['bundle_bytes']} bytes in "
             f"{incident_info['dump_s'] * 1e3:.1f} ms, ring "

@@ -132,6 +132,32 @@ class TestProgramContracts:
         )
         assert c.host_callbacks == {}
 
+    def test_pipeline_programs_ride_the_ring(self, live):
+        """ISSUE 15: the forward primitive is psum-free (the one-hot
+        output mask is gone), and the fused training step moves
+        activations/cotangents through exactly two ppermutes — with a
+        schedule-invariant contract (tick tables are scan constants, so
+        gpipe and 1f1b differ ONLY in the 1f1b program's armed guard)."""
+        gp = live["pipeline.gpipe"]
+        assert "psum" not in gp.collectives
+        assert gp.collectives["ppermute"] == 1
+
+        tg = live["pipeline.train_gpipe"]
+        tf = live["pipeline.train_1f1b"]
+        for c in (tg, tf):
+            assert c.collectives["ppermute"] == 2
+            assert "all_gather" not in c.collectives
+            assert "all_to_all" not in c.collectives
+            # full state donated chunk-to-chunk
+            for label in ("params", "opt_state"):
+                assert c.donated_aliased.get(label, 0) > 0
+        assert "pmin" not in tg.collectives          # guard unarmed
+        assert tf.collectives["pmin"] == 1           # guard armed
+        assert tf.collective_bytes["pmin"] == 4      # one exact-fp32 flag
+        # guard aside, the contracts agree: the schedule is data
+        assert {k: v for k, v in tf.collectives.items() if k != "pmin"} \
+            == tg.collectives
+
 
 class TestPlantedMutations:
     """Acceptance: the golden check FAILS when a collective is added to,
@@ -178,6 +204,26 @@ class TestPlantedMutations:
             "dataparallel.scan_k4.train_steps": k4,
         })
         assert "contract.scan_variance" in {v.rule for v in vs}
+
+    def test_pipeline_mask_regression_trips_the_invariant(self, live):
+        """The one-hot psum mask creeping back into pipeline.gpipe is
+        exactly what contract.pipeline_ring exists to catch."""
+        mutated = copy.deepcopy(live["pipeline.gpipe"])
+        mutated.collectives["psum"] = 1
+        vs = jaxpr_audit.check_invariants({mutated.name: mutated})
+        assert "contract.pipeline_ring" in {v.rule for v in vs}
+
+    def test_pipeline_train_gather_trips_the_invariant(self, live):
+        mutated = copy.deepcopy(live["pipeline.train_1f1b"])
+        mutated.collectives["all_gather"] = 1
+        vs = jaxpr_audit.check_invariants({mutated.name: mutated})
+        assert "contract.pipeline_ring" in {v.rule for v in vs}
+
+    def test_pipeline_train_extra_ring_trips_the_invariant(self, live):
+        mutated = copy.deepcopy(live["pipeline.train_gpipe"])
+        mutated.collectives["ppermute"] = 3
+        vs = jaxpr_audit.check_invariants({mutated.name: mutated})
+        assert "contract.pipeline_ring" in {v.rule for v in vs}
 
     def test_world_mismatch_refuses_comparison(self, live):
         c = live["dataparallel.train_step"]

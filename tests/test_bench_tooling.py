@@ -586,12 +586,15 @@ class TestTelemetryBlock:
 
     @staticmethod
     def _validate_scan_block(block, *, k):
-        """The schema-pinned `scan` block (ISSUE 4 satellite): drift
-        here breaks the host-dispatch-gap trajectory across rounds."""
+        """The schema-pinned `scan` block (ISSUE 4 satellite; pipeline
+        bubble fields ISSUE 15): drift here breaks the
+        host-dispatch-gap and bubble-fraction trajectories across
+        rounds."""
         assert set(block) == {
             "k", "chunks", "host_gap_frac", "host_gap_frac_scan1",
             "dispatch_frac", "dispatch_frac_scan1",
             "img_per_sec_per_chip",
+            "pipeline", "bubble_frac_predicted", "bubble_frac_measured",
         }
         assert block["k"] == k
         assert isinstance(block["chunks"], int) and block["chunks"] >= 1
@@ -599,6 +602,39 @@ class TestTelemetryBlock:
                     "dispatch_frac", "dispatch_frac_scan1"):
             assert block[key] is None or 0.0 <= block[key] <= 1.5, key
         assert block["img_per_sec_per_chip"] > 0
+        # pipeline bubble accounting (measured on every line; the
+        # 8-device test mesh always splits into a 2x4 data x pipe mesh)
+        pipe = block["pipeline"]
+        assert pipe is not None
+        assert pipe["n_stages"] >= 2
+        assert pipe["n_stages"] * pipe["data_world"] >= 2
+        assert pipe["microbatches"] == 2 * pipe["n_stages"]
+        assert pipe["dense_step_s"] > 0
+        assert 0.0 < pipe["canonical_gpipe_bubble"] < 1.0
+        assert set(pipe["schedules"]) == {"gpipe", "1f1b"}
+        for name, s in pipe["schedules"].items():
+            assert s["ticks"] > 0 and s["step_s"] > 0
+            assert 0.0 <= s["bubble_frac_predicted"] < 1.0
+            assert s["bubble_frac_measured"] is None \
+                or 0.0 <= s["bubble_frac_measured"] <= 1.0, name
+        # 1F1B's fused steady state needs strictly fewer ticks than
+        # GPipe's flush at M = 2N (the predicted half of the acceptance
+        # bound; the measured half is timing and gated generously by
+        # BASELINE.json's scan.bubble_frac_measured anchor)
+        g, f = pipe["schedules"]["gpipe"], pipe["schedules"]["1f1b"]
+        assert f["ticks"] < g["ticks"]
+        assert f["bubble_frac_predicted"] < g["bubble_frac_predicted"]
+        # the fused K x M chunk ran as ONE compiled program
+        assert pipe["fused"]["k"] >= 2
+        assert pipe["fused"]["dispatches"] == 1
+        assert pipe["fused"]["chunk_s"] > 0
+        # the micro-bench's own traced collectives: the ppermute rings
+        # live HERE, scoped to the pipeline programs (the incident
+        # block's DP contract must not claim them)
+        assert pipe["collective_calls"].get("ppermute", 0) >= 2
+        # headline fields mirror the shipped default schedule (1f1b)
+        assert block["bubble_frac_predicted"] == f["bubble_frac_predicted"]
+        assert block["bubble_frac_measured"] == f["bubble_frac_measured"]
 
     @staticmethod
     def _validate_monitor_block(block, *, steps):
@@ -723,6 +759,13 @@ class TestTelemetryBlock:
         }
         # the attribution acceptance bound: shares sum to 1.0 ± 0.05
         assert abs(attr["share_sum"] - 1.0) <= 0.05
+        # per-family collective counts ride the contract (ISSUE 15) —
+        # and they are SCOPED to the headline DP program (tallies
+        # snapshotted before the pipeline micro-bench traced its
+        # ppermute rings; those live in scan.pipeline.collective_calls)
+        counts = attr["collective_counts"]
+        assert counts and counts.get("psum", 0) >= 1
+        assert "ppermute" not in counts
 
     @staticmethod
     def _validate_memory_block(block, *, audited_peak):

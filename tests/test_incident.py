@@ -444,7 +444,8 @@ class TestBundleMerge:
 
 
 def _synthetic_bundle(*, dispatch_s, data_wait_s, covered_s, steps,
-                      flops_per_step=None, bytes_per_step=None):
+                      flops_per_step=None, bytes_per_step=None,
+                      collective_counts=None):
     """Minimal valid bundle with known timing histograms — the
     attribution math's ground truth."""
     def hist(total, count):
@@ -469,6 +470,7 @@ def _synthetic_bundle(*, dispatch_s, data_wait_s, covered_s, steps,
         "contract": {
             "flops_per_step": flops_per_step,
             "collective_bytes_per_step": bytes_per_step,
+            "collective_counts": collective_counts,
         },
         "registry": {"schema": telemetry.SCHEMA_VERSION, "counters": {},
                      "gauges": {}, "histograms": {}},
@@ -498,6 +500,27 @@ class TestAttribution:
         assert attr["steps"] == 3
         assert attr["split"] == "cost_model"
         assert attr["inputs"]["bytes_source"] == "contract.bytes_per_step"
+
+    def test_collective_counts_ride_the_report(self):
+        """ISSUE 15: per-family call counts from the static contract
+        surface in the report's inputs — a pipeline-shaped program
+        names its ppermute rings next to the psum families, so the
+        collective share is attributable to a FAMILY, not just a byte
+        total. Absent from the contract -> reported None, never
+        invented."""
+        counts = {"ppermute": 2, "psum": 3, "pmin": 1}
+        bundle = _synthetic_bundle(
+            dispatch_s=6.0, data_wait_s=2.0, covered_s=10.0, steps=3,
+            flops_per_step=incident.DEFAULT_FLOP_RATE,
+            bytes_per_step=incident.DEFAULT_WIRE_RATE,
+            collective_counts=counts,
+        )
+        attr = incident.attribution(bundle)
+        assert attr["inputs"]["collective_counts"] == counts
+        bare = _synthetic_bundle(dispatch_s=6.0, data_wait_s=2.0,
+                                 covered_s=10.0, steps=3)
+        assert incident.attribution(bare)["inputs"][
+            "collective_counts"] is None
 
     def test_no_contract_means_all_dispatch_is_compute(self):
         bundle = _synthetic_bundle(dispatch_s=6.0, data_wait_s=2.0,

@@ -57,7 +57,9 @@ def test_forward_matches_sequential(n, m):
         np.random.default_rng(1).standard_normal((m, MB, FEAT)).astype(np.float32)
     )
     f = jax.jit(pp.pipeline_parallel(stage_fn, mesh_of(n)))
-    got = f(stacked, x)
+    # slice the last stage's row OUTSIDE the compiled program (the
+    # sharded-out-spec contract — see pipeline_parallel's docstring)
+    got = pp.last_stage_output(f(stacked, x))
     want = sequential(stacked, x)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
@@ -71,7 +73,7 @@ def test_gradients_match_sequential():
     f = pp.pipeline_parallel(stage_fn, mesh_of(n))
 
     def loss_pp(stacked, x):
-        return jnp.sum(f(stacked, x) ** 2)
+        return jnp.sum(pp.last_stage_output(f(stacked, x)) ** 2)
 
     def loss_seq(stacked, x):
         return jnp.sum(sequential(stacked, x) ** 2)
@@ -106,3 +108,46 @@ def test_schedule_is_one_scan():
     assert hlo_n2.count("collective-permute") == hlo_n8.count(
         "collective-permute"
     )
+
+
+def test_output_extraction_moves_no_bytes():
+    """ISSUE 15 satellite: the wrapper's output extraction rides a
+    P(pipe)-leading out-spec + final-row slice, NOT the historical
+    one-hot psum mask that replicated the full (M, mb, ...) output on
+    every stage — so the compiled program's only collective is the
+    ppermute ring (no all-reduce at all)."""
+    n = 4
+    stacked = make_stacked(n)
+    f = jax.jit(pp.pipeline_parallel(stage_fn, mesh_of(n)))
+    x = jnp.zeros((4, MB, FEAT), jnp.float32)
+    hlo = f.lower(stacked, x).compile().as_text()
+    assert hlo.count("collective-permute") > 0
+    assert "all-reduce" not in hlo
+    assert "all-gather" not in hlo
+
+
+def test_nan_feed_on_inactive_ticks_cannot_corrupt():
+    """Adversarial NaN-feed fixture (ISSUE 15 satellite): inactive
+    ticks run stage_fn on garbage — the zero ring payload. This stage
+    turns exactly that garbage into NaN, so any unmasked leak of an
+    inactive tick into the banked accumulator (or back into the ring)
+    would poison the output. The result must equal the clean
+    sequential reference."""
+
+    def nan_on_garbage_stage(params, x):
+        y = stage_fn(params, x)
+        garbage = jnp.sum(jnp.abs(x)) == 0  # zero ring payload
+        return y + jnp.where(garbage, jnp.nan, 0.0)
+
+    n, m = 4, 6
+    stacked = make_stacked(n)
+    x = jnp.asarray(
+        np.random.default_rng(7).standard_normal(
+            (m, MB, FEAT)
+        ).astype(np.float32)
+    )
+    f = jax.jit(pp.pipeline_parallel(nan_on_garbage_stage, mesh_of(n)))
+    got = np.asarray(pp.last_stage_output(f(stacked, x)))
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got, np.asarray(sequential(stacked, x)),
+                               atol=2e-5)

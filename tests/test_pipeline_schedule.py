@@ -1,0 +1,179 @@
+"""Pipeline schedule tables: legality of the generated GPipe/1F1B tick
+tables across an (M, N) grid, the bubble-fraction arithmetic the bench
+``scan`` block reports, and the validator's mutation matrix (every rule
+proven to fire on a planted illegal table).
+
+Pure host-side numpy — no mesh, no compiles."""
+
+import numpy as np
+import pytest
+
+from tpu_syncbn.parallel import pipeline_schedule as ps
+
+GRID = [(1, 2), (2, 2), (3, 2), (8, 2), (3, 4), (4, 4), (8, 4),
+        (16, 4), (2, 8), (8, 8), (6, 3)]
+
+
+@pytest.mark.parametrize("m,n", GRID)
+def test_generated_tables_are_legal(m, n):
+    ps.validate_schedule(ps.gpipe_schedule(m, n))
+    ps.validate_schedule(ps.one_f1b_schedule(m, n))
+
+
+@pytest.mark.parametrize("m,n", GRID)
+def test_tick_counts(m, n):
+    """GPipe pays the flush: ``2(M+N-1)`` ticks. 1F1B's fused ticks
+    finish in ``M + 2(N-1)`` once M >= N (the steady state runs one
+    forward AND one backward per tick)."""
+    assert ps.gpipe_schedule(m, n).ticks == 2 * (m + n - 1)
+    if m >= n:
+        assert ps.one_f1b_schedule(m, n).ticks == m + 2 * (n - 1)
+
+
+@pytest.mark.parametrize("m,n", GRID)
+def test_predicted_bubble_arithmetic(m, n):
+    g = ps.gpipe_schedule(m, n)
+    f = ps.one_f1b_schedule(m, n)
+    assert g.predicted_bubble_frac == pytest.approx(1 - m / g.ticks)
+    assert f.predicted_bubble_frac == pytest.approx(1 - m / f.ticks)
+    # the textbook one-op-per-tick figure, for the docs/bench cross-ref
+    assert ps.canonical_gpipe_bubble(m, n) == pytest.approx(
+        (n - 1) / (m + n - 1)
+    )
+    # bubbles are fractions
+    for s in (g, f):
+        assert 0.0 <= s.predicted_bubble_frac < 1.0
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_1f1b_beats_gpipe_at_m_ge_2n(n):
+    """The ISSUE acceptance bound: at M >= 2N the 1F1B bubble is below
+    GPipe's — and strictly, since the fused steady state reclaims the
+    backward slots GPipe's flush leaves masked."""
+    for m in (2 * n, 4 * n):
+        f = ps.one_f1b_schedule(m, n)
+        g = ps.gpipe_schedule(m, n)
+        assert f.predicted_bubble_frac < g.predicted_bubble_frac
+        assert f.ticks < g.ticks
+
+
+@pytest.mark.parametrize("m,n", [(8, 2), (16, 4), (8, 4)])
+def test_1f1b_in_flight_is_o_n_not_o_m(m, n):
+    """The memory story: 1F1B holds at most ``2(N-s)-1`` activations in
+    flight per stage (independent of M); GPipe's first stage holds all
+    M through the flush."""
+    f = ps.one_f1b_schedule(m, n)
+    for s, peak in enumerate(f.max_in_flight()):
+        assert peak <= 2 * (n - s) - 1
+    assert ps.gpipe_schedule(m, n).max_in_flight()[0] == m
+
+
+def test_dense_timing_schedule_is_zero_bubble_but_illegal():
+    d = ps.dense_timing_schedule(6, 4)
+    assert d.ticks == 6
+    assert d.predicted_bubble_frac == pytest.approx(0.0)
+    assert (d.fwd != ps.IDLE).all() and (d.bwd != ps.IDLE).all()
+    # it is a timing reference, NOT a runnable pipeline schedule
+    with pytest.raises(ValueError):
+        ps.validate_schedule(d)
+
+
+def test_get_schedule_resolution():
+    s = ps.get_schedule("gpipe", 4, 2)
+    assert s.name == "gpipe" and s.n_microbatches == 4
+    custom = ps.one_f1b_schedule(4, 2)
+    assert ps.get_schedule(custom, 4, 2) is custom
+    with pytest.raises(ValueError, match="trainer wants 8 x 2"):
+        ps.get_schedule(custom, 8, 2)  # shape mismatch
+    with pytest.raises(ValueError, match="unknown schedule"):
+        ps.get_schedule("zigzag", 4, 2)
+
+
+def test_degenerate_sizes_rejected():
+    with pytest.raises(ValueError, match="microbatch"):
+        ps.gpipe_schedule(0, 2)
+    with pytest.raises(ValueError, match="two stages"):
+        ps.one_f1b_schedule(4, 1)
+
+
+# ------------------------------------------------------------- validator
+# mutation matrix: every rule fires on a planted illegal table
+
+
+def _mutated(edit):
+    s = ps.gpipe_schedule(4, 3)
+    fwd, bwd = s.fwd.copy(), s.bwd.copy()
+    edit(fwd, bwd, s)
+    return ps.Schedule(s.name, s.n_stages, s.n_microbatches, fwd, bwd)
+
+
+def test_validator_catches_duplicate_forward():
+    def edit(fwd, bwd, s):
+        t = int(np.argwhere(fwd[:, 1] == ps.IDLE)[0, 0])
+        fwd[t, 1] = 0  # stage 1 forwards microbatch 0 twice
+
+    with pytest.raises(ValueError, match="twice"):
+        ps.validate_schedule(_mutated(edit))
+
+
+def test_validator_catches_missing_backward():
+    def edit(fwd, bwd, s):
+        t = int(np.argwhere(bwd[:, 2] == 3)[0, 0])
+        bwd[t, 2] = ps.IDLE
+
+    with pytest.raises(ValueError, match="never runs bwd"):
+        ps.validate_schedule(_mutated(edit))
+
+
+def test_validator_catches_forward_before_activation_lands():
+    def edit(fwd, bwd, s):
+        # stage 1 forwards microbatch 0 at tick 0 — before stage 0's
+        # activation could possibly have arrived
+        t = int(np.argwhere(fwd[:, 1] == 0)[0, 0])
+        fwd[t, 1] = ps.IDLE
+        fwd[0, 1] = 0
+
+    with pytest.raises(ValueError, match="activation only lands"):
+        ps.validate_schedule(_mutated(edit))
+
+
+def test_validator_catches_backward_before_cotangent_lands():
+    def edit(fwd, bwd, s):
+        # stage 0 backwards microbatch 0 at the same tick stage 1 does
+        t1 = int(np.argwhere(bwd[:, 1] == 0)[0, 0])
+        t0 = int(np.argwhere(bwd[:, 0] == 0)[0, 0])
+        bwd[t0, 0] = ps.IDLE
+        bwd[t1, 0] = 0
+
+    with pytest.raises(ValueError, match="cotangent only lands"):
+        ps.validate_schedule(_mutated(edit))
+
+
+def test_validator_catches_backward_before_own_forward():
+    def edit(fwd, bwd, s):
+        # plant on the LAST stage (its loss-head cotangent is in-tick,
+        # so no earlier rule masks the activation violation): backward
+        # of microbatch 0 lands before the stage ever forwarded it
+        last = s.n_stages - 1
+        t = int(np.argwhere(bwd[:, last] == 0)[0, 0])
+        bwd[t, last] = ps.IDLE
+        bwd[0, last] = 0
+
+    with pytest.raises(ValueError, match="before its own forward"):
+        ps.validate_schedule(_mutated(edit))
+
+
+def test_validator_catches_out_of_range_index():
+    def edit(fwd, bwd, s):
+        fwd[0, 0] = 99
+
+    with pytest.raises(ValueError, match="out of range"):
+        ps.validate_schedule(_mutated(edit))
+
+
+def test_validator_catches_shape_mismatch():
+    s = ps.gpipe_schedule(4, 3)
+    bad = ps.Schedule(s.name, s.n_stages, s.n_microbatches,
+                      s.fwd, s.bwd[:-1])
+    with pytest.raises(ValueError, match="shape"):
+        ps.validate_schedule(bad)

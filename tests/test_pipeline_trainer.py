@@ -1,0 +1,364 @@
+"""PipelineTrainer: fused microbatch pipeline *training* on the 2-D
+(data x pipe) mesh.
+
+The contracts pinned here, per ISSUE 15's acceptance criteria:
+
+* **gradient parity** — one GPipe or 1F1B train step equals a
+  sequential (no-pipeline) pass over the same global batch: same loss,
+  same gradients (recovered through SGD's update), fp32 tolerances
+  pinned; on a data world > 1 the comparison is against the FULL global
+  batch, so the DP-axis composition (grad pmean) is part of the claim;
+* **scan citizenship** — the step body is a stable-carry
+  ``build_scan_steps`` citizen: ``train_steps_batches`` over a K-chunk
+  equals K sequential ``train_step`` calls, and the divergence guard
+  rides the carry (a NaN-poisoned slice mid-chunk is skipped on-device
+  while its neighbors land);
+* **SPMD-lockstep safety** — inactive schedule slots run the stage on
+  masked garbage; the adversarial NaN-feed fixture makes that garbage
+  produce NaN and asserts it can never reach the accumulators;
+* **one compiled program** — HLO size/collective counts are invariant
+  in both M and K (the schedule is tick tables inside one scan, never
+  an unrolled host loop).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh
+
+from tpu_syncbn.mesh_axes import DATA_AXIS, PIPE_AXIS
+from tpu_syncbn.parallel import pipeline as pp
+from tpu_syncbn.parallel import pipeline_schedule as ps
+
+FEAT = 8
+
+
+def stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def loss_fn(y, t):
+    return jnp.mean((y - t) ** 2)
+
+
+def make_params(n_stages, seed=0):
+    r = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(
+            r.standard_normal((n_stages, FEAT, FEAT)).astype(np.float32) * 0.5
+        ),
+        "b": jnp.asarray(
+            r.standard_normal((n_stages, FEAT)).astype(np.float32) * 0.1
+        ),
+    }
+
+
+def make_batch(m, gmb, seed=1):
+    r = np.random.default_rng(seed)
+    x = jnp.asarray(r.standard_normal((m, gmb, FEAT)).astype(np.float32))
+    t = jnp.asarray(r.standard_normal((m, gmb, FEAT)).astype(np.float32))
+    return x, t
+
+
+def mesh_of(data, pipe):
+    devs = np.array(jax.devices()[: data * pipe]).reshape(data, pipe)
+    return Mesh(devs, (DATA_AXIS, PIPE_AXIS))
+
+
+def sequential_loss(stacked, x, t):
+    """The no-pipeline reference: every microbatch through all N stages
+    sequentially, mean loss over microbatches — what the schedule must
+    reproduce exactly (fp32)."""
+    n = stacked["w"].shape[0]
+
+    def run_one(xj, tj):
+        h = xj
+        for s in range(n):
+            h = stage_fn(
+                jax.tree_util.tree_map(lambda p: p[s], stacked), h
+            )
+        return loss_fn(h, tj)
+
+    return jnp.mean(jax.vmap(run_one)(x, t))
+
+
+# ------------------------------------------------------------- parity
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.parametrize("data,pipe,m", [(2, 4, 6), (1, 4, 5), (4, 2, 4)])
+def test_gradient_parity_vs_sequential(schedule, data, pipe, m):
+    """One train step's loss AND gradients (recovered from the SGD
+    update) match the sequential reference over the full global batch —
+    forward and backward, both schedules, with the data axis composed."""
+    params = make_params(pipe)
+    gmb = 2 * data
+    x, t = make_batch(m, gmb)
+    lr = 0.1
+    tr = pp.PipelineTrainer(
+        stage_fn, loss_fn, params, optax.sgd(lr),
+        num_microbatches=m, schedule=schedule, mesh=mesh_of(data, pipe),
+    )
+    out = tr.train_step((x, t))
+
+    want_loss = sequential_loss(params, x, t)
+    want_grads = jax.grad(sequential_loss)(params, x, t)
+    np.testing.assert_allclose(
+        float(out.loss), float(want_loss), rtol=1e-5
+    )
+    got_grads = jax.tree_util.tree_map(
+        lambda p0, p1: (p0 - p1) / lr, params, tr.params
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(got_grads),
+        jax.tree_util.tree_leaves(want_grads),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, rtol=1e-4
+        )
+
+
+def test_schedules_agree_with_each_other_over_steps():
+    """GPipe and 1F1B are the same math on different tick tables: three
+    Adam steps land on identical parameters."""
+    params = make_params(4)
+    trs = {
+        name: pp.PipelineTrainer(
+            stage_fn, loss_fn, params, optax.adam(1e-2),
+            num_microbatches=8, schedule=name, mesh=mesh_of(2, 4),
+        )
+        for name in ("gpipe", "1f1b")
+    }
+    for k in range(3):
+        batch = make_batch(8, 4, seed=10 + k)
+        losses = {n: float(tr.train_step(batch).loss)
+                  for n, tr in trs.items()}
+        assert losses["gpipe"] == pytest.approx(losses["1f1b"], rel=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(trs["gpipe"].params),
+        jax.tree_util.tree_leaves(trs["1f1b"].params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
+# ------------------------------------------------- scan citizenship
+
+
+def test_train_steps_batches_equals_step_loop():
+    """The step body is a legal build_scan_steps citizen: a K-chunk in
+    ONE compiled program reproduces K sequential train_step calls —
+    params, opt state (Adam moments ride the carry), per-step losses."""
+    k, m, data, pipe = 3, 6, 2, 4
+    params = make_params(pipe)
+    xs = jnp.stack([make_batch(m, 4, seed=20 + i)[0] for i in range(k)])
+    ts = jnp.stack([make_batch(m, 4, seed=20 + i)[1] for i in range(k)])
+
+    tr_loop = pp.PipelineTrainer(
+        stage_fn, loss_fn, params, optax.adam(1e-2),
+        num_microbatches=m, schedule="1f1b", mesh=mesh_of(data, pipe),
+    )
+    losses = [float(tr_loop.train_step((xs[i], ts[i])).loss)
+              for i in range(k)]
+
+    tr_fused = pp.PipelineTrainer(
+        stage_fn, loss_fn, params, optax.adam(1e-2),
+        num_microbatches=m, schedule="1f1b", mesh=mesh_of(data, pipe),
+    )
+    out = tr_fused.train_steps_batches((xs, ts))
+    assert out.loss.shape == (k,)
+    np.testing.assert_allclose(np.asarray(out.loss), losses, rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(tr_loop.params),
+        jax.tree_util.tree_leaves(tr_fused.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+        )
+
+
+def test_divergence_guard_skips_poisoned_slice_mid_chunk():
+    """PR 1 semantics ride the carry: a NaN-poisoned slice inside a
+    fused chunk is skipped on-device (world-consensus rollback), its
+    neighbors land, and the guard count persists in opt_state."""
+    k, m = 3, 4
+    params = make_params(4)
+    xs = jnp.stack([make_batch(m, 4, seed=30 + i)[0] for i in range(k)])
+    ts = jnp.stack([make_batch(m, 4, seed=30 + i)[1] for i in range(k)])
+    xs = xs.at[1].set(jnp.nan)
+
+    tr = pp.PipelineTrainer(
+        stage_fn, loss_fn, params, optax.adam(1e-2),
+        num_microbatches=m, schedule="1f1b", mesh=mesh_of(2, 4),
+        divergence_guard="skip_step",
+    )
+    out = tr.train_steps_batches((xs, ts))
+    assert list(np.asarray(out.metrics["nonfinite"])) == [0.0, 1.0, 0.0]
+    for leaf in jax.tree_util.tree_leaves(tr.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+    _, guard = tr.opt_state
+    assert int(guard["nonfinite_count"]) == 1
+
+    # the skipped step is an exact no-op: a clean-run twin that never
+    # saw the poisoned slice lands on the same parameters
+    tr_clean = pp.PipelineTrainer(
+        stage_fn, loss_fn, params, optax.adam(1e-2),
+        num_microbatches=m, schedule="1f1b", mesh=mesh_of(2, 4),
+        divergence_guard="skip_step",
+    )
+    tr_clean.train_step((xs[0], ts[0]))
+    tr_clean.train_step((xs[2], ts[2]))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(tr.params),
+        jax.tree_util.tree_leaves(tr_clean.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+        )
+
+
+# ------------------------------------------- SPMD-lockstep safety
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_nan_feed_on_inactive_ticks_cannot_corrupt(schedule):
+    """Adversarial NaN-feed fixture (ISSUE 15 satellite): inactive
+    ticks run the stage on garbage — zero ring payloads and untouched
+    buffer slots. This stage emits NaN on exactly that garbage (an
+    all-zero input), so ANY unmasked leak of an inactive slot into the
+    accumulators, the loss, or the ring would poison training. The step
+    must stay finite and still match the clean-stage sequential
+    reference bit-for-tolerance."""
+
+    def nan_on_garbage_stage(p, x):
+        y = stage_fn(p, x)
+        # real microbatches are standard-normal: never all-zero. The
+        # zero garbage of an inactive tick turns into NaN everywhere.
+        garbage = jnp.sum(jnp.abs(x)) == 0
+        return y + jnp.where(garbage, jnp.nan, 0.0)
+
+    m, pipe = 6, 4
+    params = make_params(pipe)
+    x, t = make_batch(m, 4)
+    tr = pp.PipelineTrainer(
+        nan_on_garbage_stage, loss_fn, params, optax.sgd(0.1),
+        num_microbatches=m, schedule=schedule, mesh=mesh_of(2, pipe),
+    )
+    out = tr.train_step((x, t))
+    assert np.isfinite(float(out.loss))
+    for leaf in jax.tree_util.tree_leaves(tr.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+    np.testing.assert_allclose(
+        float(out.loss), float(sequential_loss(params, x, t)), rtol=1e-5
+    )
+    want_grads = jax.grad(sequential_loss)(params, x, t)
+    got_grads = jax.tree_util.tree_map(
+        lambda p0, p1: (p0 - p1) / 0.1, params, tr.params
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(got_grads),
+        jax.tree_util.tree_leaves(want_grads),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, rtol=1e-4
+        )
+
+
+# --------------------------------------------- one compiled program
+
+
+def test_program_is_one_scan_invariant_in_m_and_k():
+    """Compile size must be O(1) in the microbatch count AND the fused
+    step count: the whole K x M schedule is nested scans, so the HLO's
+    collective count cannot grow with either."""
+    pipe = 4
+
+    def hlo_for(m, k):
+        params = make_params(pipe)
+        tr = pp.PipelineTrainer(
+            stage_fn, loss_fn, params, optax.sgd(0.1),
+            num_microbatches=m, schedule="1f1b", mesh=mesh_of(2, pipe),
+        )
+        fn = tr._build_train_steps(k, stacked=True)
+        xs = jnp.zeros((k, m, 4, FEAT), jnp.float32)
+        return fn.lower(
+            tr._param_store, tr.opt_state, (xs, xs)
+        ).compile().as_text()
+
+    base = hlo_for(4, 1)
+    assert "while" in base
+    assert base.count("collective-permute") > 0
+    assert "all-gather" not in base
+    for m, k in ((8, 1), (4, 3), (8, 3)):
+        other = hlo_for(m, k)
+        assert other.count("collective-permute") == base.count(
+            "collective-permute"
+        ), (m, k)
+
+
+# ----------------------------------------------------- construction
+
+
+def test_constructor_validates():
+    params = make_params(4)
+    with pytest.raises(ValueError, match="divergence_guard"):
+        pp.PipelineTrainer(
+            stage_fn, loss_fn, params, optax.sgd(0.1),
+            num_microbatches=4, divergence_guard="halve_lr",
+            mesh=mesh_of(2, 4),
+        )
+    with pytest.raises(ValueError, match="same leading stage axis"):
+        bad = dict(params, b=params["b"][:2])
+        pp.PipelineTrainer(
+            stage_fn, loss_fn, bad, optax.sgd(0.1),
+            num_microbatches=4, mesh=mesh_of(2, 4),
+        )
+    with pytest.raises(ValueError, match="pipe.*axis has 2"):
+        pp.PipelineTrainer(
+            stage_fn, loss_fn, params, optax.sgd(0.1),
+            num_microbatches=4, mesh=mesh_of(4, 2),
+        )
+    # hand-built illegal schedules are rejected up front
+    bad_sched = ps.Schedule(
+        "bad", 4, 4,
+        np.zeros((4, 4), np.int32), np.zeros((4, 4), np.int32),
+    )
+    with pytest.raises(ValueError, match="twice"):
+        pp.PipelineTrainer(
+            stage_fn, loss_fn, params, optax.sgd(0.1),
+            num_microbatches=4, schedule=bad_sched, mesh=mesh_of(2, 4),
+        )
+    # global-view optimizers cannot update per-stage shards
+    with pytest.raises(ValueError, match="elementwise"):
+        pp.PipelineTrainer(
+            stage_fn, loss_fn, params,
+            optax.chain(optax.clip_by_global_norm(1.0), optax.sgd(0.1)),
+            num_microbatches=4, mesh=mesh_of(2, 4),
+        )
+
+
+def test_wrong_microbatch_count_raises_at_trace():
+    params = make_params(4)
+    tr = pp.PipelineTrainer(
+        stage_fn, loss_fn, params, optax.sgd(0.1),
+        num_microbatches=4, mesh=mesh_of(2, 4),
+    )
+    x, t = make_batch(6, 4)
+    with pytest.raises(ValueError, match="6 microbatches"):
+        tr.train_step((x, t))
+
+
+def test_split_microbatches_and_mesh_helpers():
+    x = jnp.zeros((12, FEAT))
+    mb = pp.split_microbatches(x, 4)
+    assert mb.shape == (4, 3, FEAT)
+    with pytest.raises(ValueError, match="not divisible"):
+        pp.split_microbatches(x, 5)
+    mesh = pp.pipeline_mesh(4)
+    assert mesh.shape[PIPE_AXIS] == 4
+    assert mesh.shape[DATA_AXIS] == len(jax.devices()) // 4
+    with pytest.raises(ValueError, match="do not split"):
+        pp.pipeline_mesh(3)

@@ -26,9 +26,18 @@ nothing compiles or executes unless the caller asks for the
 * ``tensor.tp_mlp`` — the Megatron MLP pairing (column → gelu → row):
   exactly ONE ``psum`` over the ``model`` axis, weights arriving
   pre-sharded ``P(None,'model')`` / ``P('model',None)``.
-* ``pipeline.gpipe`` — the GPipe microbatch schedule: one ``ppermute``
-  in the scan body (the ring hand-off) plus the last-stage ``psum``
-  mask, stage params ``P('pipe')``.
+* ``pipeline.gpipe`` — the GPipe forward schedule: one ``ppermute`` in
+  the scan body (the ring hand-off) and NOTHING else — the historical
+  last-stage psum mask is gone (ISSUE 15: stage-stacked ``P('pipe')``
+  out-spec; ``contract.pipeline_ring`` pins psum-free).
+* ``pipeline.train_{gpipe,1f1b}`` — the fused pipeline TRAINING step
+  on the 2-D (data x pipe) mesh: exactly two ``ppermute``s in the tick
+  scan body (activations right, cotangents left), the loss psum +
+  data-axis grad pmean, and — on the 1f1b program — the armed
+  divergence guard's ``pmin``. The two contracts differ ONLY in the
+  guard: collectives live in the tick body, so they are
+  schedule-invariant by construction (the GPipe/1F1B tick tables are
+  scan constants).
 * ``expert.switch_moe`` — Switch MoE over the ``expert`` axis: exactly
   two ``all_to_all``s (dispatch + return) and the aux-loss ``pmean``.
 * ``sequence.ring_attention`` — the KV ring: one ``ppermute`` in the
@@ -477,6 +486,61 @@ def _pipeline_gpipe() -> ProgramSpec:
     )
 
 
+def _pipeline_train(schedule: str) -> ProgramSpec:
+    """The fused pipeline-training step (ISSUE 15): forward/backward
+    microbatch rings + grad accumulation + one optimizer update as ONE
+    scanned program on the 2-D (data x pipe) mesh. The 1f1b variant
+    arms the divergence guard, so its contract additionally pins the
+    guard's exact-fp32 ``pmin`` riding next to the rings."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from tpu_syncbn.mesh_axes import DATA_AXIS, PIPE_AXIS
+    from tpu_syncbn.parallel import pipeline
+
+    n, m, mb = 4, 4, 2  # stages, microbatches, per-replica microbatch
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs.reshape(devs.size // n, n), (DATA_AXIS, PIPE_AXIS))
+    d = _FEATURES
+    data_world = int(mesh.shape[DATA_AXIS])
+
+    def stage_fn(params, x):
+        return jnp.tanh(x @ params["w"] + params["b"])
+
+    def loss_fn(y, t):
+        return ((y - t) ** 2).mean()
+
+    rng = np.random.default_rng(0)
+    stacked = {
+        "w": jnp.asarray(rng.standard_normal((n, d, d)).astype(np.float32)),
+        "b": jnp.asarray(rng.standard_normal((n, d)).astype(np.float32)),
+    }
+    tr = pipeline.PipelineTrainer(
+        stage_fn, loss_fn, stacked, optax.sgd(0.1, momentum=0.9),
+        num_microbatches=m, schedule=schedule, mesh=mesh,
+        divergence_guard="skip_step" if schedule == "1f1b" else None,
+    )
+    fn = tr._build_train_steps(1, stacked=False)
+    sds = jax.ShapeDtypeStruct
+    batch = (
+        sds((m, mb * data_world, d), jnp.float32),
+        sds((m, mb * data_world, d), jnp.float32),
+    )
+    return ProgramSpec(
+        name=f"pipeline.train_{schedule}",
+        fn=fn,
+        example_args=(tr._param_store, tr.opt_state, batch),
+        arg_labels=("params", "opt_state", "batch"),
+        declared_donated=("params", "opt_state"),
+        world=devs.size,
+        mesh=mesh,
+        in_specs=(tr._pspec, tr._opt_spec, P(None, DATA_AXIS)),
+    )
+
+
 def _expert_switch_moe() -> ProgramSpec:
     """Switch MoE (expert.py): two all_to_alls move capacity slots to
     their expert's device and back; the aux loss is pmean'd."""
@@ -559,6 +623,8 @@ PROGRAM_BUILDERS: dict[str, Callable[[], ProgramSpec]] = {
     "serve.eval_bucket8": _serve_eval_bucket,
     "tensor.tp_mlp": _tensor_tp_mlp,
     "pipeline.gpipe": _pipeline_gpipe,
+    "pipeline.train_gpipe": lambda: _pipeline_train("gpipe"),
+    "pipeline.train_1f1b": lambda: _pipeline_train("1f1b"),
     "expert.switch_moe": _expert_switch_moe,
     "sequence.ring_attention": _sequence_ring_attention,
 }
@@ -632,6 +698,35 @@ def check_invariants(
         v("contract.tp_one_psum",
           "the Megatron column->row pairing costs exactly ONE psum "
           f"(tensor.py's whole point), found {tp.collectives}")
+
+    gp = contracts.get("pipeline.gpipe")
+    if gp is not None:
+        if gp.collectives.get("psum", 0):
+            v("contract.pipeline_ring",
+              "pipeline.gpipe must be psum-free: the one-hot output mask "
+              "was replaced by a P(pipe)-leading out-spec (ISSUE 15) — "
+              f"found {gp.collectives} (the replication wire cost came "
+              "back)")
+        if not gp.collectives.get("ppermute", 0):
+            v("contract.pipeline_ring",
+              "pipeline.gpipe lost its ppermute ring — activations are "
+              f"moving some other way: {gp.collectives}")
+    for sched in ("gpipe", "1f1b"):
+        c = contracts.get(f"pipeline.train_{sched}")
+        if c is None:
+            continue
+        if c.collectives.get("ppermute", 0) != 2:
+            v("contract.pipeline_ring",
+              f"pipeline.train_{sched} must move activations/cotangents "
+              "through exactly TWO ppermutes per tick (forward ring + "
+              f"backward ring), found {c.collectives}")
+        gathered = {k: n for k, n in c.collectives.items()
+                    if k in ("all_gather", "all_to_all")}
+        if gathered:
+            v("contract.pipeline_ring",
+              f"pipeline.train_{sched} gathers instead of ringing "
+              f"({gathered}) — a stage materialized another stage's "
+              "state")
 
     moe = contracts.get("expert.switch_moe")
     if moe is not None and moe.collectives.get("all_to_all", 0) != 2:

@@ -49,8 +49,8 @@ PREFIX_RE = re.compile(r"^[a-z0-9_]+$")
 KNOWN_METRIC_PREFIXES = frozenset({
     "audit", "bench", "checkpoint", "collectives", "compile", "data",
     "events", "gan", "incident", "loader", "mem", "monitor", "numerics",
-    "obs", "probe", "rendezvous", "resilience", "scan", "serve", "slo",
-    "step", "train",
+    "obs", "pipeline", "probe", "rendezvous", "resilience", "scan",
+    "serve", "slo", "step", "train",
 })
 
 _SUPPRESS_RE = re.compile(r"#\s*audit:\s*ok(?:\[([a-z0-9_,\s]+)\])?")

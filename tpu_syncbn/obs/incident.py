@@ -445,6 +445,11 @@ def attribution(
             "flops_per_step": flops_per_step,
             "collective_bytes": round(bytes_total, 1),
             "bytes_source": bytes_source,
+            # per-op call counts from the static contract (when the
+            # producer recorded them): names the collective FAMILY the
+            # wire share belongs to — a pipeline step shows its two
+            # ppermute rings here next to the psum families (ISSUE 15)
+            "collective_counts": contract.get("collective_counts"),
         },
         "model": {"flop_rate": flop_rate, "wire_rate": wire_rate},
     }
