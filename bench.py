@@ -622,6 +622,13 @@ def measure_serve(dp, batch, *, n_chips: int) -> dict:
     except Exception as e:  # null only this section, keep closed-loop
         log(f"serve open-loop measurement failed: {type(e).__name__}: {e}")
         open_loop = None
+    try:
+        publish = measure_serve_publish(
+            engine, x, gb=gb, max_batch=max_batch, max_wait_ms=max_wait_ms,
+        )
+    except Exception as e:  # null only this section, keep the rest
+        log(f"serve publish measurement failed: {type(e).__name__}: {e}")
+        publish = None
     stats = engine.stats()
     return {
         "buckets": stats["buckets"],
@@ -640,6 +647,161 @@ def measure_serve(dp, batch, *, n_chips: int) -> dict:
         "buckets_compiled": stats["programs_compiled"],
         "drained": bat.drained,
         "open_loop": open_loop,
+        "publish": publish,
+    }
+
+
+def measure_serve_publish(
+    engine, x, *, gb: int, max_batch: int, max_wait_ms: float,
+) -> dict:
+    """The ``publish`` section of the serve block: the zero-downtime
+    weight-swap drill (``serve.publish``, docs/RESILIENCE.md
+    "Zero-downtime publication"), run against the live warmed engine.
+
+    Two identically-loaded closed-loop runs: a baseline (no swap) and a
+    swap run whose midpoint hot-swaps a same-structure new weight
+    version through :class:`~tpu_syncbn.serve.publish.SwapController`
+    while the clients keep submitting — the comparison
+    (``p99_during_swap_ms`` vs ``baseline_p99_ms``, anchored by
+    ``serve.publish.p99_ratio`` in BASELINE.json) is the "zero
+    downtime" claim as a number. The transient double-buffer cost is
+    the incoming replicated state (``double_buffer_peak_bytes``),
+    compared against the installed memwatch contract when one is
+    pinned. The drill closes with a rollback
+    (``rollback_bit_identical``: the restored version's device bytes
+    equal the pre-swap snapshot exactly). Split out so a failure nulls
+    only this section. Schema pinned by tests/test_bench_tooling.py."""
+    import threading
+
+    import numpy as np
+
+    import jax
+    from tpu_syncbn import serve as serve_lib
+    from tpu_syncbn.obs import memwatch
+
+    def run_load(clients, per_client, midpoint=None):
+        """Closed-loop load; optionally fires ``midpoint()`` on the
+        main thread once half the expected requests landed. Returns
+        (latencies, midpoint result)."""
+        bat = serve_lib.DynamicBatcher(
+            engine, max_batch=max_batch, max_wait_ms=max_wait_ms,
+            max_queue=4 * max_batch, health_name="serve_publish",
+        )
+        latencies: list[float] = []
+        lat_lock = threading.Lock()
+        done = threading.Event()
+
+        def client(cid):
+            rng = np.random.RandomState(cid)
+            for _ in range(per_client):
+                i = int(rng.randint(0, gb))
+                t_req = time.perf_counter()
+                try:
+                    bat.submit(x[i:i + 1]).result(timeout=600)
+                except serve_lib.RejectedError:
+                    continue
+                # published per-request (not at client exit): the
+                # midpoint trigger below watches this count to fire
+                # the swap while requests are demonstrably in flight
+                with lat_lock:
+                    latencies.append(time.perf_counter() - t_req)
+
+        threads = [threading.Thread(target=client, args=(c,), daemon=True)
+                   for c in range(clients)]
+        try:
+            for th in threads:
+                th.start()
+            mid = None
+            if midpoint is not None:
+                # wait until load is demonstrably flowing, then swap
+                # with requests in flight
+                deadline = time.monotonic() + 60.0
+                while time.monotonic() < deadline:
+                    with lat_lock:
+                        flowing = len(latencies) >= clients
+                    if flowing:
+                        break
+                    time.sleep(0.005)
+                mid = midpoint(bat)
+            for th in threads:
+                th.join()
+        finally:
+            done.set()
+            bat.close(drain=True)
+        return latencies, mid
+
+    clients = max(2, max_batch)
+    per_client = 8
+    base_lat, _ = run_load(clients, per_client)
+    baseline_p99_ms = round(float(np.percentile(base_lat, 99)) * 1e3, 3)
+
+    # the "new version": same structure, same bytes except one leaf
+    # nudged — structurally identical (zero recompiles), numerically
+    # distinguishable (the rollback bit-identity check has teeth)
+    old_params = engine._params
+    leaves = jax.tree_util.tree_leaves(old_params)
+    probe_old = np.asarray(leaves[0]).copy()
+    bumped = [False]
+
+    def bump(a):
+        if not bumped[0] and np.issubdtype(np.asarray(a).dtype, np.floating):
+            bumped[0] = True
+            return a + np.asarray(1e-3, np.asarray(a).dtype)
+        return a
+    new_params = jax.tree_util.tree_map(bump, old_params)
+    base_version = int(engine.version)
+
+    def do_swap(bat):
+        ctl = serve_lib.SwapController(engine, batcher=bat,
+                                       health_name="publish_drill")
+        try:
+            return ctl.swap(new_params, engine._rest,
+                            version=base_version + 1, source="bench")
+        finally:
+            ctl.close()
+
+    swap_lat, swap_result = run_load(clients, per_client, midpoint=do_swap)
+    p99_during_swap_ms = round(float(np.percentile(swap_lat, 99)) * 1e3, 3)
+    log(f"serve publish: swap {swap_result['swap_s'] * 1e3:.1f} ms, "
+        f"p99 during swap {p99_during_swap_ms} ms "
+        f"(baseline {baseline_p99_ms} ms)")
+
+    # transient double-buffer = the incoming replicated state; compare
+    # against the pinned memwatch contract when one is installed
+    double_buffer = int(engine.params_nbytes())
+    sampler = memwatch.get()
+    contract = (sampler.contract().get("bytes_per_device")
+                if sampler is not None else None)
+    bounded = True if not contract else double_buffer <= contract
+
+    # rollback drill: restore the pre-swap version, prove bit-identity
+    t0 = time.perf_counter()
+    restored = engine.rollback()
+    rollback_s = time.perf_counter() - t0
+    probe_restored = np.asarray(
+        jax.tree_util.tree_leaves(engine._params)[0]
+    )
+    rollback_bit_identical = bool(np.array_equal(probe_old, probe_restored))
+    log(f"serve publish: rollback to v{restored} "
+        f"{rollback_s * 1e3:.1f} ms, bit_identical="
+        f"{rollback_bit_identical}")
+    # leave the engine on its original weights for anything downstream
+    assert restored == base_version
+
+    return {
+        "swap_s": round(swap_result["swap_s"], 6),
+        "commit_s": round(swap_result["commit_s"], 6),
+        "swap_outcome": swap_result["outcome"],
+        "requests_during_swap": len(swap_lat),
+        "baseline_p99_ms": baseline_p99_ms,
+        "p99_during_swap_ms": p99_during_swap_ms,
+        "p99_ratio": round(
+            p99_during_swap_ms / max(baseline_p99_ms, 1e-9), 4),
+        "double_buffer_peak_bytes": double_buffer,
+        "memwatch_contract_bytes": contract,
+        "double_buffer_bounded": bounded,
+        "rollback_s": round(rollback_s, 6),
+        "rollback_bit_identical": rollback_bit_identical,
     }
 
 

@@ -936,6 +936,7 @@ class TestServeBlock:
             "levels", "clients", "requests", "rejected",
             "throughput_rps", "latency_p50_ms", "latency_p99_ms",
             "fill_ratio", "buckets_compiled", "drained", "open_loop",
+            "publish",
         }
         assert isinstance(block["buckets"], list) and block["buckets"]
         assert all(isinstance(b, int) and b >= 1 for b in block["buckets"])
@@ -989,6 +990,30 @@ class TestServeBlock:
         assert ol["p99_bounded"] is True
         assert ol["sheds_rise"] is True
         assert ol["degradation_graceful"] is True
+        # zero-downtime publication drill (null only if that
+        # sub-measurement failed — which is itself a failure here)
+        pub = block["publish"]
+        assert pub is not None
+        assert set(pub) == {
+            "swap_s", "commit_s", "swap_outcome",
+            "requests_during_swap", "baseline_p99_ms",
+            "p99_during_swap_ms", "p99_ratio",
+            "double_buffer_peak_bytes", "memwatch_contract_bytes",
+            "double_buffer_bounded", "rollback_s",
+            "rollback_bit_identical",
+        }
+        assert pub["swap_outcome"] == "swapped"
+        assert 0 < pub["commit_s"] <= pub["swap_s"]
+        assert pub["requests_during_swap"] >= 1
+        assert pub["baseline_p99_ms"] > 0
+        assert pub["p99_during_swap_ms"] > 0
+        assert pub["p99_ratio"] > 0
+        assert pub["double_buffer_peak_bytes"] > 0
+        assert pub["double_buffer_bounded"] is True
+        # rollback restores the pre-swap version bit-identically,
+        # faster than any rebuild could (retained buffers, no compile)
+        assert pub["rollback_s"] > 0
+        assert pub["rollback_bit_identical"] is True
 
     def test_serve_flag_emits_block_and_line_stays_last(
         self, tmp_path, monkeypatch, capsys

@@ -619,6 +619,14 @@ class TestStatusz:
             },
             "circuits": {"serve.circuit_state": 2.0},
             "program_caches": {"serve": {"hits": 4, "misses": 2}},
+            "publication": {
+                "serve.version.active": 7.0,
+                "serve.version.previous": 6.0,
+                "serve.swaps_total": 3,
+                "serve.rollbacks_total": 1,
+                "serve.swap_s.count": 3,
+                "serve.swap_s.sum": 0.0042,
+            },
             "numerics": {
                 "numerics.bn_mean_skew": {"count": 12, "max": 0.5},
             },
@@ -662,6 +670,14 @@ class TestStatusz:
             "program caches\n"
             "  serve    hits=4 misses=2\n"
             "\n"
+            "publication\n"
+            "  serve.rollbacks_total                1\n"
+            "  serve.swap_s.count                   3\n"
+            "  serve.swap_s.sum                     0.0042\n"
+            "  serve.swaps_total                    3\n"
+            "  serve.version.active                 7\n"
+            "  serve.version.previous               6\n"
+            "\n"
             "numerics\n"
             "  numerics.bn_mean_skew                count=12 max=0.5\n"
             "  numerics.samples                     12\n"
@@ -685,6 +701,7 @@ class TestStatusz:
         text = obs_server.render_statusz({})
         assert "(none registered)" in text
         assert "(no SLO tracker attached)" in text
+        assert "(no weight swaps observed)" in text
         assert "(no numerics monitors published)" in text
         assert "set TPU_SYNCBN_MEMWATCH=1" in text
         assert "(none observed)" in text
@@ -814,6 +831,41 @@ class TestMetricNameDrift:
             bat.submit(np.ones((1, 1), np.float32)).result(timeout=10)
         CircuitBreaker(failure_threshold=1, key="tenant_b"
                        ).record_failure()
+        # publication (ISSUE 16): one swap + rollback + rejection on a
+        # duck-typed versioned engine, and one real tiny publication —
+        # produces the serve.version.* / serve.swap* and
+        # checkpoint.publish* families
+        class _FakeVersioned:
+            version = 0
+            previous_version = None
+
+            def swap_params(self, params, rest=None, *, version):
+                old = self.version
+                self.version, self.previous_version = version, old
+                return old
+
+            def rollback(self):
+                self.version, self.previous_version = (
+                    self.previous_version, self.version)
+                return self.version
+
+            def predict(self, batch):
+                return batch
+
+        ctl = serve_lib.SwapController(
+            _FakeVersioned(), health_name="drift_publication"
+        )
+        try:
+            ctl.swap({"w": 1.0}, version=1)
+            ctl.rollback(reason="drift gate drill")
+            ctl._reject(version=2, source="drift", reason="corrupt")
+        finally:
+            ctl.close()
+        from tpu_syncbn.utils import checkpoint as ckpt_mod
+
+        ckpt_mod.publish_version(
+            str(tmp_path / "pub"), 1, {"w": np.zeros(2, np.float32)}
+        )
         # obs/slo/monitor: server probes + one SLO evaluation
         agg = timeseries.WindowedAggregator()
         agg.tick(now=0.0)

@@ -1,0 +1,761 @@
+"""Zero-downtime weight publication (tpu_syncbn.serve.publish +
+utils.checkpoint publication + parallel.redistribute).
+
+Four layers under test, bottom-up:
+
+* the **publication store** (``utils.checkpoint``): versioned
+  manifest-verified payloads behind an atomically-flipped pointer —
+  corruption/skew is rejected at load, the pointer is the authority,
+  pruning never removes the pointed-at version, and the async
+  checkpointer publishes through the same ordered worker as saves;
+* **on-mesh redistribution** (``parallel.redistribute``): ZeRO flat
+  shards → replicated serving tree, bit-identical to the host-gather
+  path (the ``serve.redistribute`` audit golden pins its wire shape);
+* **engine versioning** (``serve.engine``): atomic triple swap with
+  zero recompiles, in-flight version pinning, structure-skew rejection,
+  bit-identical rollback;
+* the **swap controller** (``serve.publish``): drain/readiness window,
+  memwatch-bounded double-buffer, post-swap probe → automatic rollback,
+  and the deterministic chaos matrix over ``testing.faults``'s swap
+  injectors (corrupt publication under live load with zero failed
+  requests, SIGTERM mid-swap, crash-on-new-version, version skew).
+"""
+
+import os
+import signal
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import nnx
+
+from tpu_syncbn import nn as tnn, parallel, serve
+from tpu_syncbn.obs import flightrec, memwatch, telemetry, tracing
+from tpu_syncbn.obs import server as obs_server
+from tpu_syncbn.testing import faults
+from tpu_syncbn.utils import checkpoint as ckpt
+
+pytestmark = pytest.mark.serve
+
+WORLD = 8  # conftest's virtual device count
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """The established obs reset pattern (tests/test_serve.py): every
+    test starts and ends with telemetry at its env default, an empty
+    registry, and no installed tracer/recorder/sampler."""
+    telemetry.set_enabled(None)
+    telemetry.REGISTRY.reset()
+    tracing.uninstall()
+    yield
+    telemetry.set_enabled(None)
+    telemetry.REGISTRY.reset()
+    tracing.uninstall()
+    rec = flightrec.uninstall()
+    if rec is not None:
+        rec.close()
+    sampler = memwatch.uninstall()
+    if sampler is not None:
+        sampler.close()
+
+
+class Net(nnx.Module):
+    def __init__(self, rngs):
+        self.fc = nnx.Linear(4, 6, rngs=rngs)
+        self.bn = tnn.BatchNorm1d(6)
+
+    def __call__(self, x):
+        return self.bn(self.fc(x))
+
+
+def _sq_loss(m, b):
+    return (m(b) ** 2).mean()
+
+
+def _trained_dp(*, zero=False, steps=3):
+    model = tnn.convert_sync_batchnorm(Net(nnx.Rngs(0)))
+    dp = parallel.DataParallel(model, optax.sgd(0.05), _sq_loss, zero=zero)
+    for s in range(steps):
+        dp.train_step(jnp.asarray(
+            np.random.RandomState(s).randn(16, 4).astype(np.float32)
+        ))
+    return dp
+
+
+#: Module-cached trained trainers for tests that only READ the trainer
+#: (build engines, redistribute, publish its current weights) — the
+#: per-test trainer compile is the dominant cost of this file. Tests
+#: that train the trainer further build their own via _trained_dp.
+_DP_CACHE: dict = {}
+
+
+def _shared_dp(*, zero=False):
+    key = bool(zero)
+    if key not in _DP_CACHE:
+        _DP_CACHE[key] = _trained_dp(zero=zero)
+    return _DP_CACHE[key]
+
+
+def _np_tree(seed=0):
+    """A small plain-numpy publication tree: the store layer is
+    model-agnostic, so its tests need no trainer or mesh."""
+    rng = np.random.RandomState(seed)
+    return {
+        "params": {"w": rng.randn(4, 6).astype(np.float32),
+                   "b": rng.randn(6).astype(np.float32)},
+        "rest": {"count": np.int64(3)},
+    }
+
+
+def _x(n, seed=9):
+    return np.random.RandomState(seed).randn(n, 4).astype(np.float32)
+
+
+def _perturbed(params, eps=1e-3):
+    """Same structure, one float leaf nudged — structurally identical
+    (zero-recompile swap), numerically distinguishable."""
+    done = [False]
+
+    def bump(a):
+        arr = np.asarray(a)
+        if not done[0] and np.issubdtype(arr.dtype, np.floating):
+            done[0] = True
+            return jnp.asarray(arr + eps)
+        return a
+
+    return jax.tree_util.tree_map(bump, params)
+
+
+def _leaf0(tree):
+    return np.asarray(jax.tree_util.tree_leaves(tree)[0]).copy()
+
+
+# ------------------------------------------------------- publication store
+
+
+class TestPublicationStore:
+    def test_publish_load_round_trip(self, tmp_path):
+        tree = _np_tree()
+        d = str(tmp_path)
+        path = ckpt.publish_version(d, 7, tree, step=3)
+        assert os.path.exists(path)
+        assert ckpt.published_versions(d) == [7]
+        assert ckpt.published_version(d) == 7
+        manifest = ckpt.read_published_manifest(d, 7)
+        assert manifest["version"] == 7 and manifest["step"] == 3
+        template = jax.tree_util.tree_map(np.zeros_like, tree)
+        loaded, version = ckpt.load_published(d, template)
+        assert version == 7
+        for got, want in zip(jax.tree_util.tree_leaves(loaded),
+                             jax.tree_util.tree_leaves(tree)):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_pointer_is_authority_and_prune_spares_it(self, tmp_path):
+        tree = _np_tree()
+        d = str(tmp_path)
+        for v in (1, 2, 3, 4):
+            ckpt.publish_version(d, v, tree, keep=2)
+        # newest `keep` survive; the pointer names the newest
+        assert ckpt.published_versions(d) == [3, 4]
+        assert ckpt.published_version(d) == 4
+        ptr = ckpt.read_published_pointer(d)
+        assert ptr["version"] == 4 and ptr["tree_hash"]
+
+    def test_corrupt_payload_rejected_pointer_untouched(self, tmp_path):
+        tree = _np_tree()
+        d = str(tmp_path)
+        ckpt.publish_version(d, 1, tree)
+        faults.corrupt_publication(d, "truncate")
+        template = jax.tree_util.tree_map(np.zeros_like, tree)
+        with pytest.raises(ckpt.CheckpointCorruptError):
+            ckpt.load_published(d, template)
+        # the pointer never moved: re-publication can heal in place
+        assert ckpt.published_version(d) == 1
+
+    def test_bitflip_payload_rejected(self, tmp_path):
+        tree = _np_tree()
+        d = str(tmp_path)
+        ckpt.publish_version(d, 1, tree)
+        faults.corrupt_publication(d, "bitflip", seed=5)
+        template = jax.tree_util.tree_map(np.zeros_like, tree)
+        with pytest.raises(ckpt.CheckpointCorruptError):
+            ckpt.load_published(d, template)
+
+    def test_missing_manifest_is_corruption(self, tmp_path):
+        tree = _np_tree()
+        d = str(tmp_path)
+        ckpt.publish_version(d, 1, tree)
+        faults.corrupt_publication(d, target="manifest")
+        template = jax.tree_util.tree_map(np.zeros_like, tree)
+        with pytest.raises(ckpt.CheckpointCorruptError):
+            ckpt.load_published(d, template)
+
+    def test_skew_rejected_before_deserialization(self, tmp_path):
+        tree = _np_tree()
+        d = str(tmp_path)
+        ckpt.publish_version(d, 1, tree)
+        faults.skew_published_manifest(d, seed=3)
+        template = jax.tree_util.tree_map(np.zeros_like, tree)
+        expect = ckpt.tree_structure_hash(
+            jax.device_get(ckpt._purify(template))
+        )
+        with pytest.raises(ckpt.PublicationSkewError):
+            ckpt.load_published(d, template, expect_tree_hash=expect)
+
+    def test_async_publish_through_ordered_worker(self, tmp_path):
+        tree = _np_tree()
+        d = str(tmp_path)
+        with ckpt.AsyncCheckpointer(keep=3) as ac:
+            ac.save(str(tmp_path / "ckpt"), 10, _np_tree(seed=1))
+            ac.publish(d, 11, tree)
+            assert ac.flush(timeout=60)
+        assert ckpt.published_version(d) == 11
+        assert ckpt.available_steps(str(tmp_path / "ckpt")) == [10]
+        template = jax.tree_util.tree_map(np.zeros_like, tree)
+        _, version = ckpt.load_published(d, template)
+        assert version == 11
+
+
+# ---------------------------------------------------------- redistribution
+
+
+class TestRedistribute:
+    def test_matches_host_gather_bit_identical(self):
+        from tpu_syncbn.parallel.zero import unshard_params
+
+        dp = _shared_dp(zero=True)
+        via_mesh = parallel.portable_redistribute(
+            dp._layout, dp._param_store, dp.mesh, dp.axis_name
+        )
+        via_host = unshard_params(dp._layout, dp._param_store)
+        got = jax.tree_util.tree_leaves(via_mesh)
+        want = jax.tree_util.tree_leaves(via_host)
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+    def test_output_replicated_on_mesh(self):
+        dp = _shared_dp(zero=True)
+        out = parallel.portable_redistribute(
+            dp._layout, dp._param_store, dp.mesh, dp.axis_name
+        )
+        for leaf in jax.tree_util.tree_leaves(out):
+            assert leaf.sharding.is_fully_replicated
+
+
+# -------------------------------------------------------- engine versioning
+
+
+class TestEngineSwap:
+    def test_swap_serves_new_version_zero_recompile(self):
+        dp = _shared_dp()
+        eng = serve.InferenceEngine.from_trainer(dp, buckets=(8,))
+        x = _x(8)
+        eng.warm(x[:1])
+        compiled = eng.stats()["programs_compiled"]
+        old_out = eng.predict(x)
+        assert eng.version == 0 and eng.previous_version is None
+        old = eng.swap_params(_perturbed(eng._params), version=1)
+        assert old == 0
+        assert eng.version == 1 and eng.previous_version == 0
+        new_out = eng.predict(x)
+        assert not np.array_equal(old_out, new_out)
+        # the AOT programs took params as runtime args: zero recompiles
+        assert eng.stats()["programs_compiled"] == compiled
+        assert eng.stats()["version"] == 1
+        assert eng.health()["version"] == 1
+
+    def test_structure_skew_rejected_engine_untouched(self):
+        dp = _shared_dp()
+        eng = serve.InferenceEngine.from_trainer(dp, buckets=(8,))
+        x = _x(8)
+        before = eng.predict(x)
+        with pytest.raises(serve.VersionSkewError):
+            eng.swap_params({"wrong": jnp.zeros((3,))}, version=1)
+        assert eng.version == 0
+        np.testing.assert_array_equal(before, eng.predict(x))
+
+    def test_rollback_bit_identical(self):
+        dp = _shared_dp()
+        eng = serve.InferenceEngine.from_trainer(dp, buckets=(8,))
+        x = _x(8)
+        old_leaf = _leaf0(eng._params)
+        old_out = eng.predict(x)
+        eng.swap_params(_perturbed(eng._params), version=1)
+        assert eng.rollback() == 0
+        assert eng.version == 0
+        # the old device arrays were retained, not reconstructed
+        np.testing.assert_array_equal(old_leaf, _leaf0(eng._params))
+        np.testing.assert_array_equal(old_out, eng.predict(x))
+        # the rolled-back-from state stays referenced for post-mortem
+        assert eng.previous_version == 1
+
+    def test_rollback_without_previous_raises(self):
+        dp = _shared_dp()
+        eng = serve.InferenceEngine.from_trainer(dp, buckets=(8,))
+        with pytest.raises(RuntimeError, match="no previous"):
+            eng.rollback()
+        assert eng.version == 0
+
+    def test_engine_owns_buffers_against_trainer_donation(self):
+        """The engine must COPY, not alias, state taken from a live
+        trainer: ``train_step`` donates the trainer's buffers, which
+        would delete an aliased serving state under in-flight
+        requests (regression: BN running stats shared via
+        ``from_trainer``/``swap_params`` no-op ``device_put``)."""
+        dp = _trained_dp()
+        eng = serve.InferenceEngine.from_trainer(dp, buckets=(8,))
+        x = _x(8)
+        before = eng.predict(x)
+        # swap in the trainer's live arrays, then keep training: the
+        # donated originals die, the engine's copies must not
+        ctl = serve.SwapController(eng, health_name="pub_own")
+        try:
+            ctl.swap_from_trainer(dp)
+        finally:
+            ctl.close()
+        swapped = eng.predict(x)
+        for s in range(3, 6):
+            dp.train_step(jnp.asarray(
+                np.random.RandomState(s).randn(16, 4).astype(np.float32)
+            ))
+        np.testing.assert_array_equal(swapped, eng.predict(x))
+
+    def test_inflight_batch_pins_old_version(self, monkeypatch):
+        """A swap landing between program lookup and execution must not
+        touch the in-flight batch: `_run_one` reads the version triple
+        ONCE, so the batch finishes on the version it started on."""
+        dp = _shared_dp()
+        eng = serve.InferenceEngine.from_trainer(dp, buckets=(8,))
+        x = _x(8)
+        eng.warm(x[:1])
+        old_out = eng.predict(x)
+        new_params = _perturbed(eng._params)
+
+        real_program = eng._program
+        swapped = []
+
+        def swapping_program(bucket, batch):
+            # fires after _run_one captured the state triple; the swap
+            # is concurrent with an in-flight request
+            if not swapped:
+                swapped.append(eng.swap_params(new_params, version=1))
+            return real_program(bucket, batch)
+
+        monkeypatch.setattr(eng, "_program", swapping_program)
+        inflight_out = eng.predict(x)
+        # the in-flight batch ran on the OLD weights...
+        np.testing.assert_array_equal(old_out, inflight_out)
+        # ...and the next request runs on the new ones
+        assert eng.version == 1
+        assert not np.array_equal(old_out, eng.predict(x))
+
+
+# --------------------------------------------------------- swap controller
+
+
+class _StubBreaker:
+    """Duck-typed circuit breaker for probe-window tests: `state` is a
+    plain settable attribute."""
+
+    def __init__(self, state="closed"):
+        self.state = state
+
+
+class TestSwapController:
+    def _engine(self, buckets=(8,)):
+        dp = _shared_dp()
+        eng = serve.InferenceEngine.from_trainer(dp, buckets=buckets)
+        eng.warm(_x(1))
+        return dp, eng
+
+    def test_clean_swap_and_telemetry(self):
+        telemetry.set_enabled(True)
+        _, eng = self._engine()
+        x = _x(8)
+        ctl = serve.SwapController(eng, health_name="pub_t1")
+        try:
+            result = ctl.swap(_perturbed(eng._params), version=1,
+                              canary=x[:1])
+            assert result["outcome"] == "swapped"
+            assert result["version"] == 1
+            assert result["previous_version"] == 0
+            assert result["swap_s"] > 0
+            snap = telemetry.REGISTRY.snapshot()
+            assert snap["counters"]["serve.swaps_total"] == 1
+            assert snap["gauges"]["serve.version.active"] == 1
+            assert snap["gauges"]["serve.version.previous"] == 0
+            assert snap["histograms"]["serve.swap_s"]["count"] == 1
+        finally:
+            ctl.close()
+
+    def test_swap_lands_in_flight_recorder(self, tmp_path):
+        rec = flightrec.install(flightrec.FlightRecorder(
+            cooldown_s=0.0, incident_dir=str(tmp_path / "incidents")
+        ))
+        _, eng = self._engine()
+        ctl = serve.SwapController(eng, health_name="pub_rec")
+        try:
+            ctl.swap(_perturbed(eng._params), version=1)
+        finally:
+            ctl.close()
+        kinds = [e["kind"] for e in rec.rings_snapshot()["serve"]]
+        assert "weight_swap" in kinds
+        # the swap also dumped a weight_swap incident bundle
+        assert rec.last_incident is not None
+        assert rec.last_incident["trigger"] == "weight_swap"
+
+    def test_readiness_window_flips_during_swap(self):
+        _, eng = self._engine()
+        seen = {}
+
+        def hook(phase):
+            if phase == "commit":
+                ok, detail = ctl.readiness()
+                seen["commit"] = (ok, detail["swapping"])
+
+        ctl = serve.SwapController(eng, health_name="pub_ready",
+                                   phase_hook=hook)
+        try:
+            ctl.swap(_perturbed(eng._params), version=1)
+            assert seen["commit"] == (False, True)  # not ready mid-swap
+            ok, detail = ctl.readiness()
+            assert ok and not detail["swapping"]
+            assert detail["version"] == 1
+            # the hook is registered on /readyz under health_name
+            _, checks = obs_server.evaluate_readiness()
+            assert "pub_ready" in checks
+        finally:
+            ctl.close()
+        _, checks = obs_server.evaluate_readiness()
+        assert "pub_ready" not in checks  # close() unregisters
+
+    def test_swap_from_trainer_zero_on_mesh(self):
+        dp = _trained_dp(zero=True)
+        eng = serve.InferenceEngine.from_trainer(dp, buckets=(8,))
+        x = _x(8)
+        before = eng.predict(x)
+        # train further: the trainer's weights move past the engine's
+        for s in range(3, 6):
+            dp.train_step(jnp.asarray(
+                np.random.RandomState(s).randn(16, 4).astype(np.float32)
+            ))
+        ctl = serve.SwapController(eng, health_name="pub_tr")
+        try:
+            result = ctl.swap_from_trainer(dp)
+        finally:
+            ctl.close()
+        assert result["outcome"] == "swapped"
+        assert result["source"] == "trainer"
+        after = eng.predict(x)
+        assert not np.array_equal(before, after)
+        # the swapped-in weights ARE the trainer's current ones
+        m = dp.sync_to_model()
+        m.eval()
+        np.testing.assert_allclose(
+            after, np.asarray(m(jnp.asarray(x))), rtol=1e-5, atol=1e-6
+        )
+
+    def test_swap_from_publication_round_trip(self, tmp_path):
+        dp, eng = self._engine()
+        d = str(tmp_path)
+        # publish a perturbed version: the swap must change outputs
+        tree = {"params": _perturbed(eng._params), "rest": eng._rest}
+        ckpt.publish_version(d, 42, tree)
+        x = _x(8)
+        before = eng.predict(x)
+        ctl = serve.SwapController(eng, health_name="pub_pub")
+        try:
+            result = ctl.swap_from_publication(d, canary=x[:1])
+        finally:
+            ctl.close()
+        assert result["outcome"] == "swapped"
+        assert result["version"] == 42
+        assert result["source"] == "publication"
+        assert eng.version == 42
+        assert not np.array_equal(before, eng.predict(x))
+
+    def test_corrupt_publication_rejected_under_live_load(self, tmp_path):
+        """The headline chaos acceptance: a corrupted publication is
+        rejected with ZERO failed requests — the old version serves
+        every in-flight and subsequent request."""
+        dp, eng = self._engine()
+        d = str(tmp_path)
+        ckpt.publish_version(
+            d, 1, {"params": _perturbed(eng._params), "rest": eng._rest}
+        )
+        faults.corrupt_publication(d, "bitflip", seed=7)
+        x = _x(32)
+        failures = []
+        answered = []
+        stop = threading.Event()
+        bat = serve.DynamicBatcher(eng, max_batch=8, max_wait_ms=2,
+                                   max_queue=64, health_name="pub_chaos")
+        try:
+            def client():
+                i = 0
+                while not stop.is_set():
+                    try:
+                        bat.submit(x[i % 32:i % 32 + 1]).result(timeout=60)
+                        answered.append(i)
+                    except Exception as e:  # any failure breaks the claim
+                        failures.append(e)
+                    i += 1
+
+            th = threading.Thread(target=client, daemon=True)
+            th.start()
+            ctl = serve.SwapController(eng, batcher=bat,
+                                       health_name="pub_chaos_ctl")
+            try:
+                while len(answered) < 4:  # load demonstrably flowing
+                    time.sleep(0.005)
+                with pytest.raises(ckpt.CheckpointCorruptError):
+                    ctl.swap_from_publication(d)
+                assert ctl.rejected == 1
+            finally:
+                ctl.close()
+            # keep serving a beat after the rejected swap
+            n_after = len(answered) + 4
+            deadline = time.monotonic() + 30
+            while len(answered) < n_after and time.monotonic() < deadline:
+                time.sleep(0.005)
+            stop.set()
+            th.join(timeout=30)
+        finally:
+            stop.set()
+            bat.close(drain=True)
+        assert not failures
+        assert len(answered) >= 4
+        assert eng.version == 0  # old version never left
+
+    def test_version_skew_swap_rejected(self, tmp_path):
+        dp, eng = self._engine()
+        d = str(tmp_path)
+        ckpt.publish_version(d, 1, {"params": _perturbed(eng._params),
+                                    "rest": eng._rest})
+        faults.skew_published_manifest(d, seed=11)
+        ctl = serve.SwapController(eng, health_name="pub_skew")
+        try:
+            with pytest.raises(ckpt.PublicationSkewError):
+                ctl.swap_from_publication(d)
+            assert ctl.rejected == 1
+        finally:
+            ctl.close()
+        assert eng.version == 0
+
+    def test_canary_failure_auto_rolls_back(self):
+        """Post-swap probe: new weights are structurally fine but the
+        engine crashes serving them — the controller rolls back to the
+        retained previous version automatically."""
+        telemetry.set_enabled(True)
+        _, eng = self._engine()
+        x = _x(8)
+        old_out = eng.predict(x)
+        proxy = faults.crash_engine_on_version(eng, 1)
+        ctl = serve.SwapController(proxy, health_name="pub_crash")
+        try:
+            result = ctl.swap(_perturbed(eng._params), version=1,
+                              canary=x[:1])
+        finally:
+            ctl.close()
+        assert result["outcome"] == "rolled_back"
+        assert result["version"] == 0          # serving the old again
+        assert result["failed_version"] == 1
+        assert eng.version == 0
+        # the proxy serves cleanly once rolled off the bad version
+        np.testing.assert_array_equal(old_out, proxy.predict(x))
+        snap = telemetry.REGISTRY.snapshot()
+        assert snap["counters"]["serve.rollbacks_total"] == 1
+        assert snap["gauges"]["serve.version.active"] == 0
+
+    def test_breaker_open_within_probe_window_rolls_back(self):
+        """The circuit breaker opening on the new version inside
+        ``probe_window_s`` is the async rollback trigger (real traffic
+        failing, not just the canary)."""
+        _, eng = self._engine()
+        breaker = _StubBreaker("closed")
+        ctl = serve.SwapController(eng, breaker=breaker,
+                                   probe_window_s=5.0, probe_poll_s=0.01,
+                                   health_name="pub_brk")
+
+        def open_soon():
+            time.sleep(0.05)
+            breaker.state = "open"
+
+        th = threading.Thread(target=open_soon, daemon=True)
+        try:
+            th.start()
+            t0 = time.monotonic()
+            result = ctl.swap(_perturbed(eng._params), version=1)
+            elapsed = time.monotonic() - t0
+        finally:
+            th.join()
+            ctl.close()
+        assert result["outcome"] == "rolled_back"
+        assert eng.version == 0
+        assert elapsed < 5.0  # rolled back on the open, not the window
+
+    def test_sigterm_mid_swap_aborts_cleanly(self):
+        """Preemption landing inside the critical window (before
+        commit) aborts the swap with the old version serving — a
+        draining process never wedges mid-swap."""
+        from tpu_syncbn.runtime.resilience import PreemptionGuard
+
+        _, eng = self._engine()
+        phases = []
+        hook = faults.signal_at_phase("not_ready", signal.SIGTERM,
+                                      calls=phases)
+        with PreemptionGuard() as guard:
+            ctl = serve.SwapController(eng, guard=guard, phase_hook=hook,
+                                       health_name="pub_term")
+            try:
+                with pytest.raises(serve.SwapAbortedError):
+                    ctl.swap(_perturbed(eng._params), version=1)
+            finally:
+                ctl.close()
+            assert guard.preempted
+        assert eng.version == 0
+        assert eng.previous_version is None  # commit never happened
+        assert phases[:3] == ["verify", "preflight", "not_ready"]
+        assert "commit" not in phases
+
+    def test_preempted_before_swap_never_starts(self):
+        from tpu_syncbn.runtime.resilience import PreemptionGuard
+
+        _, eng = self._engine()
+        with PreemptionGuard() as guard:
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert guard.preempted
+            ctl = serve.SwapController(eng, guard=guard,
+                                       health_name="pub_pre")
+            try:
+                with pytest.raises(serve.SwapAbortedError):
+                    ctl.swap(_perturbed(eng._params), version=1)
+            finally:
+                ctl.close()
+        assert eng.version == 0
+
+    def test_memwatch_contract_aborts_oversized_swap(self, tmp_path):
+        """The double-buffer bound: with a pinned contract the swap
+        cannot fit, the controller fires mem_pressure and aborts
+        cleanly instead of letting the allocator OOM serving."""
+        telemetry.set_enabled(True)
+        rec = flightrec.install(flightrec.FlightRecorder(
+            cooldown_s=0.0, incident_dir=str(tmp_path / "incidents")
+        ))
+        sampler = memwatch.MemorySampler(
+            contract_bytes_per_device=1,  # nothing fits
+            interval_s=3600.0,
+        )
+        memwatch.install(sampler)
+        _, eng = self._engine()
+        assert eng.params_nbytes() > 0
+        ctl = serve.SwapController(eng, health_name="pub_mem")
+        try:
+            with pytest.raises(serve.SwapAbortedError):
+                ctl.swap(_perturbed(eng._params), version=1)
+        finally:
+            ctl.close()
+        assert eng.version == 0
+        snap = telemetry.REGISTRY.snapshot()
+        assert snap["counters"]["serve.swap_rejected_total"] == 1
+        assert rec.last_incident is not None
+        assert rec.last_incident["trigger"] == "mem_pressure"
+
+    def test_manual_rollback(self):
+        _, eng = self._engine()
+        x = _x(8)
+        old_out = eng.predict(x)
+        ctl = serve.SwapController(eng, health_name="pub_man")
+        try:
+            ctl.swap(_perturbed(eng._params), version=1)
+            result = ctl.rollback(reason="operator drill")
+        finally:
+            ctl.close()
+        assert result["outcome"] == "rolled_back"
+        assert eng.version == 0
+        np.testing.assert_array_equal(old_out, eng.predict(x))
+
+    def test_faulted_proxy_stays_swappable(self):
+        """The fault proxies forward the versioned-swap surface, so a
+        chaos test can layer injectors under a SwapController."""
+        _, eng = self._engine()
+        proxy = faults.slow_engine(eng, 0.0)
+        assert proxy.version == 0
+        proxy.swap_params(_perturbed(eng._params), version=3)
+        assert proxy.version == 3 and eng.version == 3
+        assert proxy.rollback() == 0
+        assert proxy.params_nbytes() == eng.params_nbytes()
+
+
+# ----------------------------------------------------- trainer integration
+
+
+class TestTrainerIntegration:
+    def test_from_trainer_warns_toward_publication_path(self):
+        import logging
+
+        # the repo logger is non-propagating (dist.get_logger), so
+        # attach a handler directly rather than going through caplog
+        dp = _shared_dp()
+        records = []
+        handler = logging.Handler()
+        handler.emit = records.append
+        logger = logging.getLogger("tpu_syncbn.serve")
+        logger.addHandler(handler)
+        try:
+            serve.InferenceEngine.from_trainer(dp, buckets=(8,))
+        finally:
+            logger.removeHandler(handler)
+        msgs = [r.getMessage() for r in records
+                if r.levelno >= logging.WARNING]
+        assert any("publication path" in m and "swap_from_trainer" in m
+                   for m in msgs)
+
+    def test_resilient_loop_publishes_at_cadence(self, tmp_path):
+        from tpu_syncbn.runtime.resilience import ResilientLoop
+
+        dp = _trained_dp(steps=0)
+        pub_dir = str(tmp_path / "pub")
+        batches = [
+            jnp.asarray(np.random.RandomState(s).randn(16, 4)
+                        .astype(np.float32))
+            for s in range(4)
+        ]
+        with ResilientLoop(dp, str(tmp_path / "ckpt"), ckpt_every=2,
+                           publish_dir=pub_dir, publish_every=2) as loop:
+            summary = loop.run(iter(batches))
+        assert summary["steps"] == 4
+        assert ckpt.published_versions(pub_dir) == [2, 4]
+        assert ckpt.published_version(pub_dir) == 4
+        # the published tree hot-swaps into an engine built from the
+        # same trainer: the full cross-process path
+        eng = serve.InferenceEngine.from_trainer(dp, buckets=(8,))
+        ctl = serve.SwapController(eng, health_name="pub_loop")
+        try:
+            result = ctl.swap_from_publication(pub_dir)
+        finally:
+            ctl.close()
+        assert result["outcome"] == "swapped" and result["version"] == 4
+
+    def test_resilient_loop_async_publish(self, tmp_path):
+        from tpu_syncbn.runtime.resilience import ResilientLoop
+
+        dp = _trained_dp(steps=0)
+        pub_dir = str(tmp_path / "pub")
+        batches = [
+            jnp.asarray(np.random.RandomState(s).randn(16, 4)
+                        .astype(np.float32))
+            for s in range(2)
+        ]
+        with ResilientLoop(dp, str(tmp_path / "ckpt"), ckpt_every=2,
+                           publish_dir=pub_dir, publish_every=2,
+                           async_checkpoint=True) as loop:
+            loop.run(iter(batches))
+            assert loop.flush_checkpoints(timeout=60)
+        assert ckpt.published_version(pub_dir) == 2

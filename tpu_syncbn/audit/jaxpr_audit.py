@@ -420,6 +420,43 @@ def _serve_eval_bucket() -> ProgramSpec:
     )
 
 
+def _serve_redistribute() -> ProgramSpec:
+    """The publication hot path (parallel.redistribute): ZeRO flat
+    1/world shards → full replicated parameter pytree, entirely on the
+    mesh. The pinned contract is the whole point of the path: one
+    tiled ``all_gather`` per dtype group and NO replicated-input blowup
+    — ``max_replicated_bytes`` stays the *output* tree, not a host
+    gather smuggled back in as a giant constant."""
+    import jax
+    from flax import nnx
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_syncbn.parallel.redistribute import build_redistribute
+    from tpu_syncbn.parallel.zero import FlatLayout
+    from tpu_syncbn.runtime.distributed import DATA_AXIS
+
+    mesh = _axis_mesh(DATA_AXIS)
+    world = int(mesh.shape[DATA_AXIS])
+    model = _tiny_model()
+    params = nnx.state(model, nnx.Param)
+    layout = FlatLayout(params, world)
+    store = jax.device_put(
+        layout.flatten(params),
+        NamedSharding(mesh, P(DATA_AXIS)),
+    )
+    return ProgramSpec(
+        name="serve.redistribute",
+        fn=build_redistribute(layout, mesh),
+        example_args=(store,),
+        arg_labels=("store",),
+        declared_donated=(),
+        world=world,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS),),
+    )
+
+
 def _tensor_tp_mlp() -> ProgramSpec:
     """The Megatron MLP (tensor.py): column → gelu → row, ONE psum."""
     import jax
@@ -621,6 +658,7 @@ PROGRAM_BUILDERS: dict[str, Callable[[], ProgramSpec]] = {
     "syncbn.compressed_stats": _syncbn_compressed_stats,
     "gan.train_step": _gan_train_step,
     "serve.eval_bucket8": _serve_eval_bucket,
+    "serve.redistribute": _serve_redistribute,
     "tensor.tp_mlp": _tensor_tp_mlp,
     "pipeline.gpipe": _pipeline_gpipe,
     "pipeline.train_gpipe": lambda: _pipeline_train("gpipe"),
@@ -681,6 +719,20 @@ def check_invariants(
               "serve eval program must not donate any input "
               f"(batcher/staging may still own the buffers), found "
               f"{serve.donated_aliased}")
+
+    rd = contracts.get("serve.redistribute")
+    if rd is not None:
+        if not rd.collectives.get("all_gather", 0):
+            v("contract.redistribute_gather",
+              "serve.redistribute must move shards with all_gather "
+              f"(the on-mesh layout change), found {rd.collectives} — "
+              "a host gather smuggled back in leaves no collectives")
+        extra = {k: n for k, n in rd.collectives.items()
+                 if k != "all_gather"}
+        if extra:
+            v("contract.redistribute_gather",
+              "serve.redistribute is a pure layout change: all_gather "
+              f"only, found extra collectives {extra}")
 
     k1 = contracts.get("dataparallel.scan_k1.train_steps")
     k4 = contracts.get("dataparallel.scan_k4.train_steps")

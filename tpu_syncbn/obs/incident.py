@@ -55,7 +55,7 @@ MERGED_KIND = "tpu_syncbn.incident_merged"
 #: (schema token form) — these are the wired ones.
 TRIGGER_KINDS = ("slo_alert", "divergence_restore", "watchdog_stall",
                  "circuit_open", "numerics_drift", "mem_pressure",
-                 "recompile_storm", "manual")
+                 "recompile_storm", "weight_swap", "manual")
 
 _KIND_RE = re.compile(r"^[a-z0-9_]+$")
 

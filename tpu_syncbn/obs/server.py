@@ -210,6 +210,23 @@ def statusz_report(
         family, sep, field = name.partition(".program_cache.")
         if sep:
             caches.setdefault(family, {})[field] = value
+    # weight publication (serve.publish): live version pair + swap /
+    # rollback / rejection tallies, so "which weights is this process
+    # serving, and how did they get there" is on the one-glance page
+    publication: dict = {}
+    for name in ("serve.version.active", "serve.version.previous"):
+        if name in snap["gauges"]:
+            publication[name] = snap["gauges"][name]
+    for name in ("serve.swaps_total", "serve.rollbacks_total",
+                 "serve.swap_rejected_total"):
+        if name in snap["counters"]:
+            publication[name] = snap["counters"][name]
+    swap_hist = snap["histograms"].get("serve.swap_s")
+    if swap_hist is not None:
+        publication["serve.swap_s.count"] = swap_hist.get("count")
+        publication["serve.swap_s.sum"] = round(
+            swap_hist.get("sum", 0.0), 4
+        )
     # numerics drift/compression health (obs.numerics — ISSUE 13): the
     # published per-monitor histograms plus the sample/saturation/trip
     # counters, so the drift story is on the one-glance page
@@ -252,6 +269,7 @@ def statusz_report(
         "alerts": obs_slo.tracker_states(),
         "circuits": circuits,
         "program_caches": caches,
+        "publication": publication,
         "numerics": numerics,
         "numerics_counters": numerics_counters,
         "memory": memory,
@@ -326,6 +344,15 @@ def render_statusz(report: dict) -> str:
             lines.append(f"  {family:<8} {stats}")
     else:
         lines.append("  (none)")
+    lines.append("")
+    lines.append("publication")
+    publication = report.get("publication") or {}
+    if publication:
+        for name, value in sorted(publication.items()):
+            v_s = f"{value:g}" if isinstance(value, (int, float)) else value
+            lines.append(f"  {name:<36} {v_s}")
+    else:
+        lines.append("  (no weight swaps observed)")
     lines.append("")
     lines.append("numerics")
     numerics = report.get("numerics") or {}

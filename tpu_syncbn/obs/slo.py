@@ -201,6 +201,31 @@ def serve_overload_rules(
     ]
 
 
+def publication_rules(
+    *,
+    rollback_target: float = 0.99,
+    windows_s: Sequence[float] = (3600.0, 21600.0),
+    burn_threshold: float = 1.0,
+) -> list["AlertRule"]:
+    """The weight-publication health rule (docs/RESILIENCE.md
+    "Zero-downtime publication"): rollbacks
+    (``serve.rollbacks_total``) as a fraction of attempted swaps
+    (``serve.swaps_total + serve.rollbacks_total`` is approximated by
+    the swap counter as the total since both tally per attempt;
+    :class:`SubsetRate` with ``serve.swaps_total`` as the denominator
+    keeps the rate conservative — a rollback storm with few successful
+    swaps saturates at 1.0). Swaps are rare events, so the windows are
+    hours, not minutes, and a single burn fires: one bad publication
+    per window is already worth a page."""
+    return [
+        AlertRule("publication_rollbacks",
+                  SubsetRate(total="serve.swaps_total",
+                             bad="serve.rollbacks_total",
+                             target=rollback_target),
+                  windows_s=windows_s, burn_threshold=burn_threshold),
+    ]
+
+
 # module registry of attached trackers: /statusz and incident bundles
 # read every attached tracker's alert state through tracker_states()
 _attached_lock = threading.Lock()

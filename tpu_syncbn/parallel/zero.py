@@ -81,7 +81,16 @@ def unshard_params(layout: "FlatLayout", store: dict):
     collective communication" (arxiv 2112.01075) at whole-model
     granularity: one gather per dtype group, then the host-side
     unflatten. ``serve.InferenceEngine.from_trainer`` and
-    ``DataParallel.params`` both restore through here."""
+    ``DataParallel.params`` both restore through here.
+
+    This is the *host* path: the full tree materializes in one process
+    (pinned as ``max_replicated_bytes`` in the sharding goldens). The
+    on-mesh alternative — same layout change, device-to-device
+    collectives only, bounded per-device transfer — is
+    :func:`tpu_syncbn.parallel.redistribute.portable_redistribute`
+    (golden-pinned as the ``serve.redistribute`` audit contract), which
+    the zero-downtime publication path
+    (:mod:`tpu_syncbn.serve.publish`) uses for live engine swaps."""
     return layout.unflatten_host(store)
 
 

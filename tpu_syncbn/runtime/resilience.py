@@ -80,10 +80,21 @@ class PreemptionGuard:
     ):
         self._signals = tuple(signals)
         self._callback = callback
+        self._subscribers: list[Callable[[int], None]] = []
         self._event = threading.Event()
         self._prev: dict[int, Any] = {}
         self._received: int | None = None
         self._installed = False
+
+    def subscribe(self, fn: Callable[[int], None]) -> None:
+        """Add a listener invoked (after the construction ``callback``)
+        on the FIRST signal delivery. Lets late-attached components —
+        e.g. a :class:`~tpu_syncbn.serve.publish.SwapController` that
+        must drain a mid-swap engine — hook the same guard the training
+        loop and batcher already share. Listener exceptions are
+        swallowed: a broken subscriber must not turn a polite drain
+        into a crash inside a signal handler."""
+        self._subscribers.append(fn)
 
     # -- handler ----------------------------------------------------------
 
@@ -102,6 +113,9 @@ class PreemptionGuard:
         )
         if self._callback is not None:
             self._callback(signum)
+        for fn in self._subscribers:
+            with contextlib.suppress(Exception):
+                fn(signum)
 
     def __enter__(self) -> "PreemptionGuard":
         for s in self._signals:
@@ -480,6 +494,9 @@ class ResilientLoop:
         counters=None,
         scan_steps: int = 1,
         async_checkpoint: bool = False,
+        publish_dir: str | None = None,
+        publish_every: int | None = None,
+        publish_keep: int = 3,
     ):
         """``scan_steps=K > 1`` drives the fused multi-step path
         (docs/PERFORMANCE.md): ``batches`` must then yield K-stacked
@@ -498,11 +515,27 @@ class ResilientLoop:
         state snapshot; serialization + manifest + atomic write happen
         in a background thread, and the loop **flushes pending writes on
         every exit path** — the PreemptionGuard boundary checkpoint is
-        durable before the process yields to SIGKILL."""
+        durable before the process yields to SIGKILL.
+
+        ``publish_dir`` additionally emits manifest-verified *serving*
+        publications (``utils.checkpoint.publish_version``) every
+        ``publish_every`` steps (default: ``ckpt_every``): a versioned
+        inference tree (``{"params", "rest"}`` — BN running stats ride
+        along) that a serving process hot-swaps in via
+        ``serve.publish.SwapController.swap_from_publication``. Under
+        ``zero=True`` the flat shards are gathered first (the durable
+        cross-process path is host serialization by nature; the
+        no-host-gather on-mesh path is the *in-process*
+        ``swap_from_trainer``). Publications follow the checkpoint
+        transport: async when ``async_checkpoint=True``."""
         if ckpt_every < 1:
             raise ValueError(f"ckpt_every must be >= 1, got {ckpt_every}")
         if scan_steps < 1:
             raise ValueError(f"scan_steps must be >= 1, got {scan_steps}")
+        if publish_every is not None and publish_every < 1:
+            raise ValueError(
+                f"publish_every must be >= 1, got {publish_every}"
+            )
         self.trainer = trainer
         self.ckpt_dir = ckpt_dir
         self.ckpt_every = ckpt_every
@@ -510,6 +543,11 @@ class ResilientLoop:
         self.max_restores = max_restores
         self.step_deadline_s = step_deadline_s
         self.scan_steps = scan_steps
+        self.publish_dir = publish_dir
+        self.publish_every = (
+            int(publish_every) if publish_every is not None else ckpt_every
+        )
+        self.publish_keep = publish_keep
         self.counters = counters if counters is not None else _default_counters()
         self.step = 0
         #: True while a divergence rollback is in flight (restore issued,
@@ -594,6 +632,36 @@ class ResilientLoop:
                 keep=self.keep,
             )
         self.counters.bump("checkpoints")
+
+    def publish(self) -> None:
+        """Emit a manifest-verified serving publication of the current
+        params at ``publish_dir``, versioned by the step counter (no-op
+        without ``publish_dir``). The tree is the inference pair
+        ``{"params", "rest"}``; under ZeRO the flat shards are gathered
+        into the full pytree first (durable host path — the on-mesh
+        redistribution serves the in-process swap instead)."""
+        if self.publish_dir is None:
+            return
+        from tpu_syncbn.utils import checkpoint as ckpt
+
+        trainer = self.trainer
+        if getattr(trainer, "zero", False):
+            from tpu_syncbn.parallel.zero import unshard_params
+
+            params = unshard_params(trainer._layout, trainer._param_store)
+        else:
+            params = trainer._param_store
+        tree = {"params": params, "rest": getattr(trainer, "rest", {})}
+        if self._async is not None:
+            self._async.publish(
+                self.publish_dir, self.step, tree, keep=self.publish_keep,
+            )
+        else:
+            ckpt.publish_version(
+                self.publish_dir, self.step, tree,
+                keep=self.publish_keep, step=self.step,
+            )
+        self.counters.bump("publishes")
 
     def _restore_last_good(self) -> None:
         from tpu_syncbn.parallel.trainer import resume_latest
@@ -803,6 +871,10 @@ class ResilientLoop:
                     if (self.step // self.ckpt_every
                             != (self.step - k) // self.ckpt_every):
                         self.save()
+                    if (self.publish_dir is not None
+                            and self.step // self.publish_every
+                            != (self.step - k) // self.publish_every):
+                        self.publish()
         except BaseException:
             # async writes still get their durability chance, but a
             # flush failure must NOT replace the loop's primary failure

@@ -24,6 +24,12 @@ The first non-training subsystem in the codebase (ROADMAP north star:
   load generation (:class:`OpenLoopLoadGen`): the offered-load-sweep
   harness ``bench --serve`` uses to prove graceful degradation past
   saturation (bounded p99, rising sheds — never queueing collapse).
+* :mod:`tpu_syncbn.serve.publish` — zero-downtime weight publication:
+  :class:`SwapController` hot-swaps manifest-verified published
+  versions (or a live trainer's params, re-sharded on the mesh via
+  ``parallel.redistribute``) into a running engine with drain,
+  memwatch-bounded double-buffering, and automatic rollback
+  (docs/RESILIENCE.md "Zero-downtime publication").
 
 Quickstart::
 
@@ -52,7 +58,15 @@ from tpu_syncbn.serve.admission import (  # noqa: F401
     RejectedError,
 )
 from tpu_syncbn.serve.batcher import DynamicBatcher  # noqa: F401
-from tpu_syncbn.serve.engine import InferenceEngine  # noqa: F401
+from tpu_syncbn.serve.engine import (  # noqa: F401
+    InferenceEngine,
+    VersionSkewError,
+)
+from tpu_syncbn.serve.publish import (  # noqa: F401
+    PublicationError,
+    SwapAbortedError,
+    SwapController,
+)
 from tpu_syncbn.serve.loadgen import (  # noqa: F401
     LoadReport,
     OpenLoopLoadGen,
@@ -74,4 +88,8 @@ __all__ = [
     "poisson_arrivals",
     "trace_arrivals",
     "unshard_params",
+    "SwapController",
+    "PublicationError",
+    "SwapAbortedError",
+    "VersionSkewError",
 ]

@@ -220,6 +220,27 @@ class DynamicBatcher:
         )
         self._thread.start()
 
+    # -- accessors ---------------------------------------------------------
+    # public views for the publication path (serve.publish.SwapController
+    # pulls the breaker as its post-swap health signal and the guard as
+    # its drain signal, and swaps versions on the engine underneath a
+    # running batcher)
+
+    @property
+    def engine(self):
+        """The engine this batcher feeds."""
+        return self._engine
+
+    @property
+    def breaker(self) -> "CircuitBreaker | None":
+        """The admission circuit breaker (None when disabled)."""
+        return self._breaker
+
+    @property
+    def guard(self):
+        """The preemption guard wired at construction (or None)."""
+        return self._guard
+
     # -- admission ---------------------------------------------------------
 
     @property

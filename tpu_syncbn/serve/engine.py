@@ -44,13 +44,23 @@ drain) lives in :mod:`tpu_syncbn.serve.batcher`.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Sequence
 
 import numpy as np
 
 from tpu_syncbn.runtime.distributed import DATA_AXIS
 
-__all__ = ["InferenceEngine"]
+__all__ = ["InferenceEngine", "VersionSkewError"]
+
+
+class VersionSkewError(ValueError):
+    """A proposed weight swap's parameter tree does not match the
+    serving structure (treedef, leaf shapes, or dtypes) — the publisher
+    is running a different model schema than this engine. Rejected
+    *before* any serving state is touched: the compiled bucket programs
+    were lowered against the current structure, so a skewed swap could
+    never reuse them."""
 
 
 def _leading_dim(batch) -> int:
@@ -142,9 +152,19 @@ class InferenceEngine:
         self.batch_sharding = NamedSharding(self.mesh, P(axis_name))
         # restore/reshard once: whatever layout the state arrived in
         # (host pytree from unshard_params, trainer-replicated arrays),
-        # serving storage is replicated on THIS mesh
-        self._params = jax.device_put(params, self._replicated)
-        self._rest = jax.device_put(rest, self._replicated)
+        # serving storage is replicated on THIS mesh.
+        # Versioned storage: ONE attribute holds (version, params, rest)
+        # so a predict call captures a consistent triple with a single
+        # atomic read — in-flight batches finish on the version they
+        # started on while a concurrent swap_params() lands the next one
+        # (the double-buffer half of serve.publish's zero-downtime swap)
+        self._state: tuple[int, Any, Any] = (
+            0,
+            self._own_replicated(params),
+            self._own_replicated(rest),
+        )
+        self._previous: tuple[int, Any, Any] | None = None
+        self._swap_lock = threading.Lock()
         # same interpret-lowering concession as the trainer (see
         # DataParallel.__init__): eval BN on running stats never traces
         # the Pallas train kernels, but track_running_stats=False models
@@ -162,6 +182,123 @@ class InferenceEngine:
         )
         self._programs_compiled = 0
 
+    # -- versioned state ---------------------------------------------------
+
+    @property
+    def _params(self):
+        return self._state[1]
+
+    @property
+    def _rest(self):
+        return self._state[2]
+
+    @property
+    def version(self) -> int:
+        """The weight version new requests run on (0 = as-constructed)."""
+        return self._state[0]
+
+    @property
+    def previous_version(self) -> int | None:
+        """The retained rollback target's version, or None."""
+        prev = self._previous
+        return prev[0] if prev is not None else None
+
+    def _struct_specs(self, tree):
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        # metadata only (shape/dtype attributes) — no host transfer per
+        # leaf on the swap path, and no touching possibly-donated data
+        return treedef, tuple(
+            (tuple(np.shape(l)),
+             str(getattr(l, "dtype", None) or np.asarray(l).dtype))
+            for l in leaves
+        )
+
+    def _own_replicated(self, tree):
+        """``device_put`` to the replicated serving layout, COPYING any
+        leaf the put would merely alias: a no-op ``device_put`` returns
+        the caller's own array object, and a trainer that later donates
+        that buffer (``train_step``) would delete the serving state out
+        from under in-flight requests. The engine owns every buffer it
+        serves."""
+        import jax
+
+        def one(leaf):
+            arr = jax.device_put(leaf, self._replicated)
+            return arr.copy() if arr is leaf else arr
+
+        return jax.tree_util.tree_map(one, tree)
+
+    def params_nbytes(self) -> int:
+        """Per-device bytes of the replicated serving state (params +
+        rest) — what a swap's transient double-buffer adds on top while
+        old and new versions coexist (the ``memwatch`` pre-flight bound
+        in :mod:`tpu_syncbn.serve.publish`)."""
+        import jax
+
+        return sum(
+            int(getattr(l, "nbytes", np.asarray(l).nbytes))
+            for l in jax.tree_util.tree_leaves((self._params, self._rest))
+        )
+
+    def swap_params(self, params, rest=None, *, version: int) -> int:
+        """Atomically replace the serving weights with ``params`` (and
+        ``rest`` — BN running stats etc. — when given), as weight
+        version ``version``. Returns the version swapped out.
+
+        The new state must match the current structure exactly (treedef
+        + per-leaf shape/dtype) — the AOT bucket programs were lowered
+        against that structure and take the state as *runtime
+        arguments*, so a matching swap reuses every compiled program
+        with zero recompiles, while a mismatch raises
+        :class:`VersionSkewError` before anything is touched. The
+        outgoing version is retained as the rollback target
+        (:meth:`rollback`); in-flight batches that already captured the
+        old triple finish on it untouched (the ``_state`` single-read
+        contract)."""
+        import jax
+
+        with self._swap_lock:
+            old = self._state
+            if self._struct_specs(params) != self._struct_specs(old[1]):
+                raise VersionSkewError(
+                    "swap_params: new params tree does not match the "
+                    "serving structure (treedef/shape/dtype) — "
+                    "publisher schema skew; swap rejected"
+                )
+            new_params = self._own_replicated(params)
+            if rest is not None:
+                if self._struct_specs(rest) != self._struct_specs(old[2]):
+                    raise VersionSkewError(
+                        "swap_params: new rest state does not match the "
+                        "serving structure — swap rejected"
+                    )
+                new_rest = self._own_replicated(rest)
+            else:
+                new_rest = old[2]
+            self._previous = old
+            self._state = (int(version), new_params, new_rest)
+            return old[0]
+
+    def rollback(self) -> int:
+        """Restore the retained previous version (bit-identical device
+        arrays — they were never freed). Returns the version now
+        serving; raises ``RuntimeError`` when there is nothing to roll
+        back to."""
+        with self._swap_lock:
+            if self._previous is None:
+                raise RuntimeError(
+                    "rollback: no previous weight version retained"
+                )
+            bad = self._state
+            self._state = self._previous
+            # keep the rolled-back-from state referenced (not serving):
+            # a post-mortem may want it, and re-rolling forward is the
+            # controller's job, not an implicit ping-pong here
+            self._previous = bad
+            return self._state[0]
+
     # -- construction ------------------------------------------------------
 
     @classmethod
@@ -174,7 +311,31 @@ class InferenceEngine:
         ``zero=True`` that is the ``parallel.zero.unshard_params``
         gather of the flat 1/world shards — and the engine re-replicates
         it for eval. The trainer keeps training; the engine owns copies
-        on device."""
+        on device.
+
+        On a multi-device mesh this cold-start gather materializes the
+        whole model in host memory (the ``max_replicated_bytes`` the
+        sharding goldens keep pinned so it cannot silently grow) — use
+        it for the FIRST engine build, then roll new versions in through
+        the publication path (:mod:`tpu_syncbn.serve.publish`), whose
+        on-mesh ``portable_redistribute`` + :meth:`swap_params` never
+        leaves the device fabric; a deprecation-style warning below
+        points there."""
+        from tpu_syncbn.runtime import distributed as dist
+
+        mesh = kwargs.get("mesh", trainer.mesh)
+        axis = kwargs.get("axis_name", getattr(trainer, "axis_name",
+                                               DATA_AXIS))
+        if int(mesh.shape[axis]) > 1:
+            dist.get_logger("tpu_syncbn.serve").warning(
+                "InferenceEngine.from_trainer on a %d-device mesh "
+                "gathers the full parameter tree through host memory — "
+                "a cold-start cost. For rolling weight updates use the "
+                "zero-downtime publication path instead "
+                "(tpu_syncbn.serve.publish.SwapController.swap_from_"
+                "trainer: on-mesh redistribution + hot swap, no host "
+                "gather, no restart).", int(mesh.shape[axis]),
+            )
         model = trainer.sync_to_model()
         kwargs.setdefault("mesh", trainer.mesh)
         kwargs.setdefault("axis_name", getattr(trainer, "axis_name", DATA_AXIS))
@@ -290,7 +451,9 @@ class InferenceEngine:
                     self._params, self._rest, sds
                 ).compile()
             telemetry.count("serve.compiles")
-            self._programs_compiled += 1
+            # int bump on the GIL, read only by stats(); _swap_lock
+            # guards the version triple, not the program cache
+            self._programs_compiled += 1  # audit: ok[unlocked_shared_state]
             return compiled
 
         return scan_driver.cached_program(
@@ -315,6 +478,8 @@ class InferenceEngine:
             "programs_compiled": self._programs_compiled,
             "programs_live": len(self._programs),
             "program_cache": self._programs.stats(),
+            "version": self.version,
+            "previous_version": self.previous_version,
         }
 
     def health(self) -> dict:
@@ -327,6 +492,7 @@ class InferenceEngine:
             "buckets": list(self.buckets),
             "programs_live": len(self._programs),
             "programs_compiled": self._programs_compiled,
+            "version": self.version,
         }
 
     # -- execution ---------------------------------------------------------
@@ -348,6 +514,11 @@ class InferenceEngine:
                 [a, np.zeros((pad,) + a.shape[1:], a.dtype)], axis=0
             )
 
+        # ONE atomic read pins this call's weight version: a concurrent
+        # swap_params() replaces self._state but cannot touch the triple
+        # already captured here — in-flight batches finish on the
+        # version they started on (tests/test_publish.py pins this)
+        _, params, rest = self._state
         fn = self._program(bucket, batch)
         padded = jax.tree_util.tree_map(pad_leaf, batch)
         # level gauge, not set(): concurrent callers each inc/dec their
@@ -358,7 +529,7 @@ class InferenceEngine:
                 "serve.infer", "serve.infer_s", n=n, bucket=bucket
             ):
                 dev = jax.device_put(padded, self.batch_sharding)
-                out = fn(self._params, self._rest, dev)
+                out = fn(params, rest, dev)
                 # gather: host numpy, padding sliced back off — the
                 # engine's callers (the batcher's response path) want
                 # settled bytes

@@ -20,7 +20,18 @@ Every recovery path in the resilience layer (``runtime.resilience``,
   raises for an exact window of batch indices → drives circuit-breaker
   open/half-open/recovery), and :func:`poison_request` +
   :func:`poison_sensitive_engine` (one request whose payload crashes
-  any batch containing it → proves batch-scoped failure isolation).
+  any batch containing it → proves batch-scoped failure isolation);
+* weight-publication faults (the ``serve.publish`` swap chaos matrix,
+  tests/test_publish.py) — :func:`corrupt_publication` (truncate /
+  bitflip the *published* payload or its manifest → verification must
+  reject the swap with the old version still serving),
+  :func:`skew_published_manifest` (intact bytes, wrong declared tree
+  structure → the skew gate must reject before deserialization),
+  :func:`signal_at_phase` (SIGTERM delivered at an exact named swap
+  phase → drain semantics mid-swap), and
+  :func:`crash_engine_on_version` (engine raises on every call while
+  serving an exact weight version → post-swap probe / circuit breaker
+  must auto-roll-back).
 
 Determinism contract: **no wall-clock randomness**. Anything pseudo-random
 (the bit to flip, the byte range to truncate) derives from an explicit
@@ -226,6 +237,27 @@ class _EngineProxy:
         self._before_predict(i, batch)
         return self._engine.predict(batch)
 
+    # versioned-swap surface (serve.publish.SwapController duck-types
+    # the engine, so a faulted proxy must stay swappable)
+
+    @property
+    def version(self):
+        return getattr(self._engine, "version", 0)
+
+    @property
+    def previous_version(self):
+        return getattr(self._engine, "previous_version", None)
+
+    def swap_params(self, params, rest=None, *, version):
+        return self._engine.swap_params(params, rest, version=version)
+
+    def rollback(self):
+        return self._engine.rollback()
+
+    def params_nbytes(self):
+        fn = getattr(self._engine, "params_nbytes", None)
+        return int(fn()) if callable(fn) else 0
+
 
 def slow_engine(engine, delay_s: float, *,
                 at_calls: Iterable[int] | None = None):
@@ -305,6 +337,123 @@ def poison_sensitive_engine(engine):
     return _PoisonSensitive(engine)
 
 
+# ---------------------------------------------------------------------------
+# weight-publication faults (the serve.publish swap chaos matrix)
+
+
+def corrupt_publication(directory: str, mode: str = "truncate", *,
+                        target: str = "payload",
+                        version: int | None = None,
+                        seed: int | None = None):
+    """Corrupt the *published* weight version in place — the pointed-at
+    version by default (the one a serving process would swap in next).
+    ``target='payload'`` hits the versioned weights file,
+    ``target='manifest'`` deletes the manifest outright (mode ignored —
+    a missing manifest must be treated as corruption, never as
+    "verification optional"). The pointer file itself is left intact:
+    the injected state is exactly "the pointer promises bytes the disk
+    can no longer back", which ``load_published`` verification must
+    catch BEFORE any request touches the new weights."""
+    from tpu_syncbn.utils.checkpoint import (
+        _pub_manifest_path, _pub_path, published_version,
+    )
+
+    if version is None:
+        version = published_version(directory)
+    if version is None:
+        raise ValueError(f"no published version in {directory!r}")
+    if target == "manifest":
+        os.unlink(_pub_manifest_path(directory, version))
+        return None
+    if target != "payload":
+        raise ValueError(
+            f"target must be 'payload' or 'manifest', got {target!r}"
+        )
+    path = _pub_path(directory, version)
+    if mode == "truncate":
+        return truncate_file(path)
+    if mode == "bitflip":
+        return bitflip_file(path, seed=seed)
+    raise ValueError(f"mode must be 'truncate' or 'bitflip', got {mode!r}")
+
+
+def skew_published_manifest(directory: str, *,
+                            version: int | None = None,
+                            seed: int | None = None) -> str:
+    """Rewrite the published manifest's declared ``tree_hash`` to a
+    seed-determined wrong value, leaving the payload bytes INTACT — the
+    on-disk signature of a publisher running different code than the
+    server (version skew: bytes are fine, the structure they decode to
+    is not). ``load_published(expect_tree_hash=...)`` must reject this
+    with :class:`~tpu_syncbn.utils.checkpoint.PublicationSkewError`
+    *before* attempting deserialization. Returns the bogus hash."""
+    import json
+
+    from tpu_syncbn.utils.checkpoint import (
+        _pub_manifest_path, published_version,
+    )
+
+    if version is None:
+        version = published_version(directory)
+    if version is None:
+        raise ValueError(f"no published version in {directory!r}")
+    rng = random.Random(fault_seed() if seed is None else seed)
+    bogus = f"{rng.getrandbits(64):016x}"
+    path = _pub_manifest_path(directory, version)
+    with open(path, "r", encoding="utf-8") as f:
+        manifest = json.load(f)
+    manifest["tree_hash"] = bogus
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(manifest, f)
+    return bogus
+
+
+def signal_at_phase(at_phase: str, sig: int = _signal.SIGTERM,
+                    *, calls: list | None = None) -> Callable[[str], None]:
+    """A ``SwapController(phase_hook=...)`` that delivers ``sig`` to
+    this process the first time the swap crosses ``at_phase`` — the
+    preemption notice landing at an exact, reproducible point of the
+    swap's critical window (phase names:
+    ``serve.publish.SWAP_PHASES``). ``calls`` (optional list) collects
+    every phase crossing for assertion."""
+    from tpu_syncbn.serve.publish import SWAP_PHASES
+
+    if at_phase not in SWAP_PHASES:
+        raise ValueError(
+            f"at_phase must be one of {SWAP_PHASES}, got {at_phase!r}"
+        )
+    fired = [False]
+
+    def hook(phase: str) -> None:
+        if calls is not None:
+            calls.append(phase)
+        if phase == at_phase and not fired[0]:
+            fired[0] = True
+            os.kill(os.getpid(), sig)
+
+    return hook
+
+
+def crash_engine_on_version(engine, version: int, *, exc_factory=None):
+    """Wrap ``engine`` so ``predict`` raises on EVERY call made while
+    the engine serves weight version ``version`` — the new weights are
+    structurally valid but behaviorally broken (the failure mode
+    verification cannot catch). Under a :class:`~tpu_syncbn.serve.
+    publish.SwapController` probe this deterministically fails the
+    canary / opens the circuit breaker, which must auto-roll-back to
+    the previous version — after which the same proxy serves cleanly."""
+    make_exc = exc_factory if exc_factory is not None else (
+        lambda: RuntimeError(f"injected crash on weight version {version}")
+    )
+
+    class _CrashOnVersion(_EngineProxy):
+        def _before_predict(self, i, batch):
+            if getattr(self._engine, "version", None) == version:
+                raise make_exc()
+
+    return _CrashOnVersion(engine)
+
+
 class FaultInjector:
     """Seeded façade over the module functions for multi-fault scripts:
     one ``FaultInjector(seed)`` gives a reproducible *sequence* of
@@ -328,3 +477,15 @@ class FaultInjector:
                            mode: str | None = None):
         m = self._rng.choice(["truncate", "bitflip"]) if mode is None else mode
         return corrupt_checkpoint(directory, step, m, seed=self.next_seed())
+
+    def corrupt_publication(self, directory: str, mode: str | None = None,
+                            *, target: str = "payload",
+                            version: int | None = None):
+        m = self._rng.choice(["truncate", "bitflip"]) if mode is None else mode
+        return corrupt_publication(directory, m, target=target,
+                                   version=version, seed=self.next_seed())
+
+    def skew_published_manifest(self, directory: str,
+                                version: int | None = None) -> str:
+        return skew_published_manifest(directory, version=version,
+                                       seed=self.next_seed())

@@ -42,9 +42,17 @@ from tpu_syncbn.obs import telemetry, tracing
 from tpu_syncbn.runtime import distributed as dist
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
+_PUB_RE = re.compile(r"^weights_v(\d+)\.msgpack$")
 
 #: Bump when the manifest schema changes incompatibly.
 MANIFEST_FORMAT = 1
+
+#: The atomically-renamed pointer file naming the currently published
+#: weight version (serve-side consumers resolve through it, never by
+#: directory listing — a half-written version is unreachable until the
+#: pointer lands, and the pointer lands only after read-back
+#: verification).
+PUBLISHED_POINTER = "published.json"
 
 #: Payloads up to this size also get a CRC32 (serial, ~1 GB/s); above it
 #: only the vectorized ``sum64`` checksum is computed, keeping manifest
@@ -79,6 +87,14 @@ def payload_sum64(data: bytes) -> str:
 class CheckpointCorruptError(RuntimeError):
     """Raised when an explicitly requested checkpoint (or every available
     candidate) fails integrity verification or deserialization."""
+
+
+class PublicationSkewError(RuntimeError):
+    """Raised when a published weight version's recorded tree structure
+    (manifest ``tree_hash``) does not match what the consumer expects —
+    a publisher running ahead of (or behind) the server's model schema.
+    Distinct from :class:`CheckpointCorruptError`: the bytes are intact,
+    the *shape* is wrong, and retrying the read cannot help."""
 
 
 def _purify(tree: Any) -> Any:
@@ -494,6 +510,224 @@ def snapshot_to_host(tree: Any) -> Any:
     return jax.tree_util.tree_map(own, jax.device_get(_purify(tree)))
 
 
+# ---------------------------------------------------------------------------
+# weight publication (serve-side versioned hot swap — docs/RESILIENCE.md
+# "Zero-downtime publication")
+
+
+def _pub_path(directory: str, version: int) -> str:
+    return os.path.join(directory, f"weights_v{version}.msgpack")
+
+
+def _pub_manifest_path(directory: str, version: int) -> str:
+    return os.path.join(directory, f"weights_v{version}.manifest.json")
+
+
+def _pointer_path(directory: str) -> str:
+    return os.path.join(directory, PUBLISHED_POINTER)
+
+
+def published_versions(directory: str) -> list[int]:
+    """Ascending weight versions present on disk (payload files — some
+    may be unverified leftovers; the pointer is the authority)."""
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for name in os.listdir(directory):
+        m = _PUB_RE.match(name)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def read_published_pointer(directory: str) -> dict | None:
+    """The parsed ``published.json`` pointer, or None when absent or
+    unreadable (no version has ever been successfully published)."""
+    try:
+        with open(_pointer_path(directory)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def published_version(directory: str) -> int | None:
+    """The currently published weight version number, or None."""
+    ptr = read_published_pointer(directory)
+    if ptr is None or not isinstance(ptr.get("version"), int):
+        return None
+    return ptr["version"]
+
+
+def read_published_manifest(directory: str, version: int) -> dict | None:
+    try:
+        with open(_pub_manifest_path(directory, version)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _publish_host_tree(
+    directory: str, version: int, host_tree: Any, *, keep: int, step=None,
+) -> str:
+    """The publication write half (already-host-resident pure tree):
+    payload + manifest exactly like a checkpoint (atomic, payload before
+    manifest), then a **read-back verification** of the just-landed
+    payload against its manifest, and only then the atomic
+    ``published.json`` pointer flip. A writer killed at ANY byte — or a
+    disk that corrupted the payload in flight — leaves the pointer on
+    the previous good version; a consumer can never resolve to a
+    truncated or bit-flipped publication."""
+    os.makedirs(directory, exist_ok=True)
+    data = serialization.to_bytes(host_tree)
+    _atomic_write(directory, _pub_path(directory, version), data)
+    manifest = {
+        "format": MANIFEST_FORMAT,
+        "version": int(version),
+        "nbytes": len(data),
+        "sum64": payload_sum64(data),
+        "crc32": (zlib.crc32(data) & 0xFFFFFFFF)
+        if len(data) <= _CRC32_MAX_BYTES else None,
+        "tree_hash": tree_structure_hash(host_tree),
+    }
+    if step is not None:
+        manifest["step"] = int(step)
+    _atomic_write(
+        directory, _pub_manifest_path(directory, version),
+        json.dumps(manifest).encode(),
+    )
+    # read-back verification: re-read what the filesystem actually holds
+    # (not the bytes still in our hands) before making it reachable
+    with open(_pub_path(directory, version), "rb") as f:
+        landed = f.read()
+    if not _payload_matches(manifest, landed):
+        telemetry.count("checkpoint.verify_failures")
+        raise CheckpointCorruptError(
+            f"publication v{version} failed read-back verification in "
+            f"{directory!r} (wrote {len(data)} bytes, read back "
+            f"{len(landed)}) — pointer NOT updated"
+        )
+    pointer = {
+        "format": MANIFEST_FORMAT,
+        "version": int(version),
+        "path": os.path.basename(_pub_path(directory, version)),
+        "tree_hash": manifest["tree_hash"],
+        "nbytes": len(data),
+    }
+    if step is not None:
+        pointer["step"] = int(step)
+    _atomic_write(
+        directory, _pointer_path(directory), json.dumps(pointer).encode()
+    )
+    if keep > 0:
+        # prune to the newest `keep`, never the version the pointer
+        # names (a rollback target must stay loadable); manifest first,
+        # same interrupted-prune reasoning as the checkpoint pruner
+        current = pointer["version"]
+        for old in published_versions(directory)[:-keep]:
+            if old == current:
+                continue
+            with contextlib.suppress(FileNotFoundError):
+                os.unlink(_pub_manifest_path(directory, old))
+            with contextlib.suppress(FileNotFoundError):
+                os.unlink(_pub_path(directory, old))
+    return _pub_path(directory, version)
+
+
+def publish_version(
+    directory: str,
+    version: int,
+    tree: Any,
+    *,
+    keep: int = 3,
+    step: int | None = None,
+) -> str | None:
+    """Atomically publish ``tree`` as weight version ``version`` —
+    master host only (others return None). The pointer flip happens
+    only after the payload passes read-back verification against its
+    freshly written manifest, so :func:`load_published` either sees the
+    previous good version or this one, never a torn write. Latency
+    rides ``checkpoint.publish_s`` + ``checkpoint.publishes``."""
+    if not dist.is_master():
+        return None
+    t0 = time.perf_counter()
+    with tracing.span("checkpoint_publish", version=int(version)):
+        host_tree = jax.device_get(_purify(tree))
+        path = _publish_host_tree(
+            directory, version, host_tree, keep=keep, step=step
+        )
+    telemetry.observe("checkpoint.publish_s", time.perf_counter() - t0)
+    telemetry.count("checkpoint.publishes")
+    return path
+
+
+def load_published(
+    directory: str,
+    target: Any,
+    *,
+    expect_tree_hash: str | None = None,
+):
+    """Resolve the ``published.json`` pointer and load that weight
+    version into ``target``'s structure. Returns ``(tree, version)``.
+
+    Verification is mandatory, not best-effort: a missing manifest, a
+    payload failing its checksums, or a deserialization error raises
+    :class:`CheckpointCorruptError` — the caller keeps serving its
+    current version (there is no silent fallback walk here; the pointer
+    names ONE version and a corrupt publication must be *rejected*, not
+    papered over). ``expect_tree_hash`` (the consumer's own
+    ``tree_structure_hash`` of its template) additionally rejects a
+    structurally skewed publication with
+    :class:`PublicationSkewError` before deserialization is attempted."""
+    ptr = read_published_pointer(directory)
+    if ptr is None or not isinstance(ptr.get("version"), int):
+        raise FileNotFoundError(
+            f"no published version in {directory!r} (missing or "
+            f"unreadable {PUBLISHED_POINTER})"
+        )
+    version = ptr["version"]
+    manifest = read_published_manifest(directory, version)
+    if manifest is None:
+        telemetry.count("checkpoint.verify_failures")
+        raise CheckpointCorruptError(
+            f"published v{version} in {directory!r} has no readable "
+            "manifest — cannot certify the payload"
+        )
+    if expect_tree_hash is not None \
+            and manifest.get("tree_hash") != expect_tree_hash:
+        raise PublicationSkewError(
+            f"published v{version} tree_hash "
+            f"{manifest.get('tree_hash')!r} != expected "
+            f"{expect_tree_hash!r} — publisher and server disagree on "
+            "the model structure (schema skew)"
+        )
+    try:
+        with open(_pub_path(directory, version), "rb") as f:
+            data = f.read()
+    except OSError as e:
+        telemetry.count("checkpoint.verify_failures")
+        raise CheckpointCorruptError(
+            f"published v{version} payload unreadable in {directory!r}: "
+            f"{e}"
+        ) from e
+    if not _payload_matches(manifest, data):
+        telemetry.count("checkpoint.verify_failures")
+        raise CheckpointCorruptError(
+            f"published v{version} in {directory!r} fails manifest "
+            f"verification (expected {manifest.get('nbytes')} bytes "
+            f"sum64={manifest.get('sum64')}, got {len(data)} bytes "
+            f"sum64={payload_sum64(data)})"
+        )
+    pure_target = _purify(target)
+    try:
+        pure = serialization.from_bytes(pure_target, data)
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"published v{version} in {directory!r} failed to "
+            f"deserialize ({type(e).__name__}: {e})"
+        ) from e
+    return _unpurify(target, pure), version
+
+
 class AsyncCheckpointer:
     """Checkpoint writes off the training hot path
     (docs/PERFORMANCE.md).
@@ -557,16 +791,29 @@ class AsyncCheckpointer:
             item = self._queue.get()  # audit: ok[unbounded_blocking]
             if item is None:
                 return
-            directory, step, host_tree, keep = item
+            op, directory, number, host_tree, keep = item
             t0 = time.perf_counter()
             try:
-                with tracing.span("checkpoint_save", step=int(step),
-                                  mode="async"):
-                    _write_host_tree(directory, step, host_tree, keep=keep)
-                telemetry.observe(
-                    "checkpoint.save_s", time.perf_counter() - t0
-                )
-                telemetry.count("checkpoint.saves")
+                if op == "publish":
+                    with tracing.span("checkpoint_publish",
+                                      version=int(number), mode="async"):
+                        _publish_host_tree(
+                            directory, number, host_tree, keep=keep
+                        )
+                    telemetry.observe(
+                        "checkpoint.publish_s", time.perf_counter() - t0
+                    )
+                    telemetry.count("checkpoint.publishes")
+                else:
+                    with tracing.span("checkpoint_save", step=int(number),
+                                      mode="async"):
+                        _write_host_tree(
+                            directory, number, host_tree, keep=keep
+                        )
+                    telemetry.observe(
+                        "checkpoint.save_s", time.perf_counter() - t0
+                    )
+                    telemetry.count("checkpoint.saves")
             except BaseException as e:  # surface at next save()/flush()
                 with self._cond:
                     self._errors.append(e)
@@ -615,7 +862,31 @@ class AsyncCheckpointer:
         # blocking here IS the documented max_pending backpressure, and
         # the single worker can only stop via close()'s sentinel (its
         # loop catches BaseException per item), so the put always drains
-        self._queue.put((directory, int(step), host_tree,  # audit: ok[unbounded_blocking]
+        self._queue.put(("save", directory, int(step), host_tree,  # audit: ok[unbounded_blocking]
+                         self.keep if keep is None else keep))
+
+    def publish(self, directory: str, version: int, tree: Any,
+                *, keep: int | None = None) -> None:
+        """Snapshot ``tree`` now and schedule an atomic weight
+        *publication* (:func:`publish_version`: payload + manifest +
+        read-back verification + pointer flip) through the same ordered
+        worker as :meth:`save` — so a ``save(step=N)`` followed by a
+        ``publish(version=N)`` certifies in submission order and a
+        ``flush()`` covers both. Same backpressure, master-host-only,
+        and error-surfacing contracts as :meth:`save`."""
+        self._raise_pending_error()
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        if not dist.is_master():
+            return
+        t0 = time.perf_counter()
+        host_tree = snapshot_to_host(tree)
+        telemetry.observe(
+            "checkpoint.async_snapshot_s", time.perf_counter() - t0
+        )
+        with self._cond:
+            self._pending += 1
+        self._queue.put(("publish", directory, int(version), host_tree,  # audit: ok[unbounded_blocking]
                          self.keep if keep is None else keep))
 
     def flush(self, timeout: float | None = None) -> bool:
