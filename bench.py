@@ -1324,6 +1324,180 @@ def measure_numerics(publisher, monitors, *, steps: int, wall_s: float) -> dict:
     }
 
 
+def measure_autopilot(*, n_chips: int) -> dict:
+    """The ``autopilot`` block of the bench line: the closed-loop
+    controller A/B (docs/OBSERVABILITY.md "Autopilot") under an
+    injected numerics fault, run on a SCRATCH registry so its planted
+    ``numerics.*`` series never contaminate the run's own numerics
+    block or SLO evaluations.
+
+    Two arms train the same tiny regression (identical init, data, and
+    learning rate). The model carries a ``fault`` parameter whose L1
+    penalty puts a constant huge gradient (``FAULT_GAIN``, three
+    orders of magnitude above the real gradients) into the SAME
+    256-element quantization chunk as every real weight, so the shared
+    int8 world range pins all real gradient elements to the clip
+    boundary — ``clip_fraction`` ≈ 1, the injected fault:
+
+    * **static int8** (no error feedback): the real signal never
+      reaches the wire and the dequantized bias degrades the loss;
+    * **autopilot**: the same trainer plus an ``Autopilot`` on the
+      ``numerics_rules()`` SLOs — ``numerics_clip`` burns, the
+      controller escalates off int8 within one evaluation window
+      (``autopilot.escalate_within_chunks``, a BASELINE.json
+      ``--check-regression`` anchor), and the arm converges.
+      ``autopilot.advantage_ratio`` (static final eval MSE over the
+      autopilot arm's) is the other anchor.
+
+    Each actuation must dump a schema-valid ``autopilot`` incident
+    bundle naming the triggering signal (``bundles.valid``); clamps
+    land in the flight-recorder ring only. The controller clock is
+    injected (30 s per chunk), so the state machine is deterministic.
+    Schema pinned by tests/test_bench_tooling.py."""
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax import nnx
+
+    from tpu_syncbn import parallel
+    from tpu_syncbn.obs import (
+        flightrec, incident as incident_mod, numerics as obs_numerics,
+        telemetry, timeseries,
+    )
+    from tpu_syncbn.runtime import autopilot as autopilot_mod
+
+    FAULT_GAIN, FEATURES, OUT, STEPS, LR = 1000.0, 8, 4, 36, 0.2
+    B = 2 * n_chips
+    rng = np.random.RandomState(0)
+    xs = rng.randn(B, FEATURES).astype(np.float32)
+    w_true = (0.7 * rng.randn(FEATURES, OUT)).astype(np.float32)
+    ys = xs @ w_true
+
+    class FaultyNet(nnx.Module):
+        def __init__(self, rngs):
+            self.fc = nnx.Linear(FEATURES, OUT, rngs=rngs)
+            # inert wrt predictions; only the loss's L1 term sees it
+            self.fault = nnx.Param(jnp.ones((1,), jnp.float32))
+
+        def __call__(self, x):
+            return self.fc(x)
+
+    def loss_fn(m, batch):
+        bx, by, flag = batch
+        mse = ((m(bx) - by) ** 2).mean()
+        return mse + flag.mean() * jnp.abs(m.fault.value).sum()
+
+    flag_on = np.full((B,), FAULT_GAIN, np.float32)
+    flag_off = np.zeros((B,), np.float32)
+    train_batch = (xs, ys, flag_on)
+    eval_batch = (xs, ys, flag_off)  # fault term off: pure MSE
+
+    def make_arm():
+        return parallel.DataParallel(
+            FaultyNet(nnx.Rngs(0)), optax.sgd(LR), loss_fn,
+            compress="int8", error_feedback=False, monitors=True,
+        )
+
+    def eval_mse(dp):
+        return round(float(np.asarray(dp.eval_step(eval_batch).loss)), 6)
+
+    live_registry = telemetry.REGISTRY
+    rec = flightrec.get()
+    ap_dir = prev_dir = prev_cooldown = None
+    if rec is not None:
+        ap_dir = tempfile.mkdtemp(prefix="bench_autopilot_")
+        prev_dir, prev_cooldown = rec.incident_dir, rec.cooldown_s
+        rec.incident_dir, rec.cooldown_s = ap_dir, 0.0
+    try:
+        telemetry.REGISTRY = scratch = telemetry.Registry()
+
+        # static arm: int8 all the way down
+        dp_static = make_arm()
+        initial_mse = eval_mse(dp_static)
+        for _ in range(STEPS):
+            dp_static.train_step(train_batch)
+        static_final = eval_mse(dp_static)
+
+        # autopilot arm: same trainer + the controller on numerics SLOs
+        dp_auto = make_arm()
+        agg = timeseries.WindowedAggregator(scratch)
+        clock = {"t": 0.0}
+        pilot = autopilot_mod.Autopilot(
+            dp_auto, aggregator=agg,
+            rules=obs_numerics.numerics_rules(),
+            modes=("int8", "bf16", "none"),
+            window_s=60.0, healthy_for_s=1e9,  # escalation-only A/B
+            now=lambda: clock["t"],
+        )
+        publisher = obs_numerics.NumericsPublisher(thresholds={})
+        decisions: list[dict] = []
+        for i in range(STEPS):
+            out = dp_auto.train_step(train_batch)
+            publisher.publish(i, out.monitors)
+            publisher.flush()
+            clock["t"] = 30.0 * (i + 1)
+            agg.tick(now=clock["t"])
+            decisions += pilot.on_chunk(step=i)
+        auto_final = eval_mse(dp_auto)
+    finally:
+        telemetry.REGISTRY = live_registry
+        bundles = None
+        if rec is not None:
+            rec.incident_dir, rec.cooldown_s = prev_dir, prev_cooldown
+            # with cooldown 0 the tracker's own slo_alert transition
+            # bundles land here too — only the autopilot-kind ones are
+            # under test (every actuation must dump one, naming its
+            # triggering signal, with the decision ring attached)
+            signals, n_autopilot, valid, other = [], 0, True, 0
+            for name in sorted(os.listdir(ap_dir)):
+                if not name.endswith(".json"):
+                    continue
+                b = incident_mod.load_bundle(  # schema-validates
+                    os.path.join(ap_dir, name))
+                if b["trigger"]["kind"] != "autopilot":
+                    other += 1
+                    continue
+                n_autopilot += 1
+                signals.append(b["trigger"]["detail"].get("signal"))
+                valid = valid and (
+                    bool(b["trigger"]["detail"].get("signal"))
+                    and len(b["rings"].get("autopilot", ())) > 0
+                )
+            bundles = {"count": n_autopilot,
+                       "valid": valid and n_autopilot > 0,
+                       "signals": signals, "other_kinds": other}
+            shutil.rmtree(ap_dir, ignore_errors=True)
+    escalations = [d for d in decisions if d["action"] == "escalate"]
+    first_escalate = escalations[0] if escalations else None
+    return {
+        "steps": STEPS,
+        "fault_gain": FAULT_GAIN,
+        "initial_mse": initial_mse,
+        "static_final_mse": static_final,
+        "autopilot_final_mse": auto_final,
+        # the A/B verdict: how much worse the uncontrolled arm ends up
+        "advantage_ratio": round(static_final / max(auto_final, 1e-9), 3),
+        # chunk index (1-based) of the first escalation — "within one
+        # evaluation window" is escalate_within_chunks <= 2 (window_s /
+        # 30 s-per-chunk)
+        "escalate_within_chunks": (
+            first_escalate["chunk"] if first_escalate else None
+        ),
+        "first_signal": (
+            first_escalate["signal"] if first_escalate else None
+        ),
+        "modes_visited": ["int8"] + [d["to"] for d in escalations],
+        "final_mode": pilot.state()["compress"],
+        "actuations": pilot.state()["actuations"],
+        "clamped": pilot.state()["clamped"],
+        "suppressed": pilot.state()["suppressed"],
+        "bundles": bundles,
+    }
+
+
 def measure_audit(dp, batch) -> dict:
     """The ``audit`` block of the bench line: the static-analysis layer
     (docs/STATIC_ANALYSIS.md) run against THIS process — the package
@@ -2029,6 +2203,24 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
         log(f"numerics measurement failed: {type(e).__name__}: {e}")
         numerics_info = None
 
+    # closed-loop autopilot A/B under an injected numerics fault
+    # (docs/OBSERVABILITY.md "Autopilot") — an annotation, never fatal
+    # to the metric. Runs between the numerics and incident blocks: it
+    # temporarily zeroes the recorder cooldown (restored after), so it
+    # must not precede the numerics block's non-forced drift trigger
+    try:
+        with stepstats.timed_span("autopilot_bench", "bench.autopilot_s"):
+            autopilot_info = measure_autopilot(n_chips=n_chips)
+        log(f"autopilot: escalated at chunk "
+            f"{autopilot_info['escalate_within_chunks']} on "
+            f"{autopilot_info['first_signal']}, final mode "
+            f"{autopilot_info['final_mode']}, advantage "
+            f"{autopilot_info['advantage_ratio']}x, bundles "
+            f"valid={(autopilot_info['bundles'] or {}).get('valid')}")
+    except Exception as e:
+        log(f"autopilot measurement failed: {type(e).__name__}: {e}")
+        autopilot_info = None
+
     # flight recorder + incident bundle measured on the run's own state
     # (docs/OBSERVABILITY.md "Incidents & flight recorder") — an
     # annotation, never fatal to the metric
@@ -2194,6 +2386,13 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
         # numerics_drift bundle proof; schema pinned by
         # tests/test_bench_tooling.py
         "numerics": numerics_info,
+        # docs/OBSERVABILITY.md "Autopilot": the closed-loop controller
+        # A/B under an injected numerics fault — escalation latency and
+        # final-loss advantage vs a static int8 arm
+        # (autopilot.escalate_within_chunks / autopilot.advantage_ratio
+        # are BASELINE anchors), plus the per-actuation incident-bundle
+        # proof; schema pinned by tests/test_bench_tooling.py
+        "autopilot": autopilot_info,
         # a fallback line is a liveness smoke signal, not a measurement
         # of anything the project tracks — cross-round diffs of it are
         # meaningless and tagged as such

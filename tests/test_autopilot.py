@@ -1,0 +1,722 @@
+"""Autopilot state machine (ISSUE 17): the closed-loop controller that
+turns the observability plane's own knobs at fused-chunk boundaries.
+
+Everything runs under injected clocks and manually-ticked windowed
+aggregators, so every transition — escalation on planted drift within
+one evaluation window, cooldowns, the sustained-healthy de-escalation
+hysteresis, clamping at the candidate-set edge, suppression during
+divergence recovery — replays deterministically. The flight-recorder /
+incident-bundle tests prove "every decision observable"; the
+ResilientLoop tests pin the chunk-boundary wiring (watchdog deadline
+follows the live K, rollback probation suppresses actuation).
+"""
+
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+from tpu_syncbn.obs import (
+    flightrec,
+    incident,
+    memwatch,
+    numerics as obs_numerics,
+    server as obs_server,
+    slo,
+    telemetry,
+    timeseries,
+    tracing,
+)
+from tpu_syncbn.runtime.autopilot import (
+    COMPRESS_LADDER,
+    DEFAULT_RULE_FAMILIES,
+    Autopilot,
+    chunked_batches,
+)
+
+pytestmark = pytest.mark.monitor
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """Every test starts and ends with telemetry on, an empty registry,
+    no recorder, no tracer (a recorder's start() installs one), and no
+    leftover heartbeats (the loop tests beat the process-wide table)."""
+    def reset(enabled):
+        telemetry.set_enabled(enabled)
+        telemetry.REGISTRY.reset()
+        rec = flightrec.uninstall()
+        if rec is not None:
+            rec.close()
+        tracing.uninstall()
+        obs_server.HEARTBEATS.clear()
+
+    reset(True)
+    yield
+    reset(None)
+
+
+class StubTrainer:
+    """The DataParallel knob surface the compression actuator needs."""
+
+    def __init__(self, compress="int8"):
+        self.compress = compress
+        self.program_caches = ()
+        self.switches = []
+
+    def set_compress(self, mode):
+        self.switches.append(mode)
+        self.compress = mode
+        return True
+
+
+def plant_numerics_burn(agg, *, t0=0.0, t1=5.0, n=20):
+    """Frames carrying an EF residual ratio far over the 0.5 SLO —
+    ``numerics_residual`` burns ~100x budget in every window with data."""
+    agg.tick(now=t0)
+    for _ in range(n):
+        telemetry.observe("numerics.ef_residual_ratio", 0.9,
+                          buckets=(0.1, 0.5, 1.0))
+    agg.tick(now=t1)
+
+
+def plant_mem_burn(agg, *, t0=0.0, t1=5.0, n=20):
+    """Frames with used_frac over the 0.9 pressure SLO."""
+    agg.tick(now=t0)
+    for _ in range(n):
+        telemetry.observe("mem.used_frac", 0.95, buckets=(0.5, 0.9, 1.0))
+    agg.tick(now=t1)
+
+
+# ---------------------------------------------------------------------------
+# standard_rules aggregator (satellite: obs.slo.standard_rules)
+
+
+class TestStandardRules:
+    FULL = [
+        "numerics_residual", "numerics_skew", "numerics_clip",
+        "mem_pressure", "recompile_storm", "serve_latency",
+        "serve_overload", "publication_rollbacks",
+    ]
+
+    def test_full_set_in_family_order(self):
+        assert [r.name for r in slo.standard_rules()] == self.FULL
+
+    def test_family_subset(self):
+        names = [r.name for r in slo.standard_rules(("mem", "serve"))]
+        assert names == ["mem_pressure", "serve_latency", "serve_overload"]
+
+    def test_unknown_family_rejected(self):
+        with pytest.raises(ValueError, match="unknown rule families"):
+            slo.standard_rules(("numerics", "gpu"))
+
+    def test_override_for_unrequested_family_rejected(self):
+        with pytest.raises(ValueError, match="not requested"):
+            slo.standard_rules(("numerics",), serve={"burn_threshold": 1.0})
+
+    def test_overrides_forwarded_to_owning_factory(self):
+        rules = slo.standard_rules(
+            ("numerics",), numerics={"clip_target": 0.9}
+        )
+        clip = {r.name: r for r in rules}["numerics_clip"]
+        assert clip.objective.target == 0.9
+
+    def test_autopilot_default_families_are_training_side(self):
+        agg = timeseries.WindowedAggregator()
+        pilot = Autopilot(None, aggregator=agg, modes=("none",))
+        assert DEFAULT_RULE_FAMILIES == ("numerics", "mem", "compile")
+        assert [r.name for r in pilot.tracker.rules] == [
+            "numerics_residual", "numerics_skew", "numerics_clip",
+            "mem_pressure", "recompile_storm",
+        ]
+
+
+# ---------------------------------------------------------------------------
+# constructor validation: the pre-audited candidate sets
+
+
+class TestConstructorValidation:
+    def _pilot(self, **kw):
+        kw.setdefault("aggregator", timeseries.WindowedAggregator())
+        kw.setdefault("rules", [])
+        return Autopilot(**kw)
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match="audited ladder"):
+            self._pilot(modes=("int8", "fp8"))
+
+    def test_ladder_order_enforced(self):
+        with pytest.raises(ValueError, match="ladder order"):
+            self._pilot(modes=("bf16", "int8"))
+
+    def test_empty_modes_rejected(self):
+        with pytest.raises(ValueError, match="at least one rung"):
+            self._pilot(modes=())
+
+    def test_trainer_outside_candidate_set_rejected(self):
+        with pytest.raises(ValueError, match="outside the"):
+            self._pilot(trainer=StubTrainer("int8"),
+                        modes=("bf16", "none"))
+
+    def test_default_modes_start_at_trainer_rung(self):
+        pilot = self._pilot(trainer=StubTrainer("bf16"))
+        assert pilot.modes == ("bf16", "none")
+        assert pilot.compress_rung == 0
+        trainerless = self._pilot()
+        assert trainerless.modes == COMPRESS_LADDER
+
+    def test_k_candidates_must_ascend(self):
+        for bad in ((4, 2), (2, 2, 4), (0, 1)):
+            with pytest.raises(ValueError, match="ascending positive"):
+                self._pilot(modes=("none",), k_candidates=bad)
+
+    def test_initial_k_must_be_a_candidate(self):
+        with pytest.raises(ValueError, match="not in k_candidates"):
+            self._pilot(modes=("none",), k_candidates=(1, 2),
+                        initial_k=3)
+
+    def test_cache_bounds_validated(self):
+        for bad in ((0, 100), (200, 100)):
+            with pytest.raises(ValueError, match="cache_bytes_bounds"):
+                self._pilot(modes=("none",), cache_bytes_bounds=bad)
+
+    def test_policy_timing_validated(self):
+        with pytest.raises(ValueError, match="window_s"):
+            self._pilot(modes=("none",), window_s=0.0)
+        with pytest.raises(ValueError, match="window_s"):
+            self._pilot(modes=("none",), healthy_for_s=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# the compression knob: escalation / cooldown / clamp / hysteresis
+
+
+class TestCompressPolicy:
+    def _pilot(self, trainer, agg, nows, **kw):
+        kw.setdefault("modes", ("int8", "bf16"))
+        kw.setdefault("window_s", 4.0)
+        kw.setdefault("healthy_for_s", 30.0)
+        kw.setdefault("rules", obs_numerics.numerics_rules())
+        return Autopilot(trainer, aggregator=agg,
+                         now=iter(nows).__next__, **kw)
+
+    def test_escalates_on_planted_drift_within_one_window(self):
+        trainer = StubTrainer("int8")
+        agg = timeseries.WindowedAggregator()
+        plant_numerics_burn(agg)
+        pilot = self._pilot(trainer, agg, [10.0])
+        decisions = pilot.on_chunk(step=7)
+        assert len(decisions) == 1
+        d = decisions[0]
+        assert d["knob"] == "compress"
+        assert d["action"] == "escalate"
+        assert (d["frm"], d["to"]) == ("int8", "bf16")
+        # the triggering signal is quoted, with its windowed burns
+        assert d["signal"] == "numerics_residual"
+        assert set(d["burns"]) == {"60.0", "300.0"}
+        assert all(b > 2.0 for b in d["burns"].values())
+        assert d["step"] == 7 and d["chunk"] == 1
+        assert trainer.compress == "bf16"
+        snap = telemetry.snapshot()
+        assert snap["gauges"]["autopilot.compress_rung"] == 1.0
+        assert snap["counters"]["autopilot.actuations"] == 1
+        assert "autopilot.decision_s" in snap["histograms"]
+
+    def test_full_lifecycle_cooldown_clamp_and_hysteresis(self):
+        trainer = StubTrainer("int8")
+        agg = timeseries.WindowedAggregator()
+        plant_numerics_burn(agg)
+        # chunk clocks: burn, cooldown, clamp, cooldown, then a long
+        # quiet gap (rule resolves after clear_for=2 clean evals), a
+        # not-yet-healthy probe, the de-escalation, and two no-flap
+        # probes after it
+        pilot = self._pilot(
+            trainer, agg,
+            [10.0, 12.0, 20.0, 21.0, 400.0, 405.0, 431.0, 432.0, 436.0],
+        )
+        acts = [
+            [d["action"] for d in pilot.on_chunk(step=i)]
+            for i in range(9)
+        ]
+        assert acts == [
+            ["escalate"],   # planted drift: int8 -> bf16
+            [],             # still burning, but inside the cooldown
+            ["clamp"],      # burning at the top rung: nowhere to go
+            [],             # clamp spent the cooldown too
+            ["clamp"],      # rule still firing (clear_for hysteresis)
+            [],             # resolved, but not healthy_for_s yet
+            ["deescalate"],  # sustained-healthy: bf16 -> int8
+            [],             # cooldown
+            [],             # already at the most-compressed rung
+        ]
+        assert trainer.switches == ["bf16", "int8"]
+        d = pilot.last_decision
+        assert d["signal"] == "numerics_healthy"
+        assert d["healthy_for_s"] == 30.0
+        st = pilot.state()
+        assert st["compress"] == "int8"
+        assert st["actuations"] == 2
+        assert st["clamped"] == 2
+        assert st["suppressed"] == 0
+        assert st["chunks"] == 9
+        snap = telemetry.snapshot()
+        assert snap["gauges"]["autopilot.compress_rung"] == 0.0
+        assert snap["counters"]["autopilot.clamped"] == 2
+
+    def test_recovering_suppresses_every_knob(self):
+        trainer = StubTrainer("int8")
+        agg = timeseries.WindowedAggregator()
+        plant_numerics_burn(agg)
+        pilot = self._pilot(trainer, agg, [10.0, 11.0])
+        [d] = pilot.on_chunk(step=3, recovering=True)
+        assert d["action"] == "suppress"
+        assert d["knob"] == "all"
+        assert d["signal"] == "divergence_recovery"
+        assert trainer.compress == "int8"  # nothing actuated
+        assert pilot.state()["suppressed"] == 1
+        # suppression is not a decision clock: the next healthy-state
+        # chunk escalates immediately (no cooldown was spent)
+        [d] = pilot.on_chunk(step=4)
+        assert d["action"] == "escalate"
+
+    def test_shadow_mode_records_without_a_trainer(self):
+        agg = timeseries.WindowedAggregator()
+        plant_numerics_burn(agg)
+        pilot = self._pilot(None, agg, [10.0], modes=("int8", "bf16"))
+        [d] = pilot.on_chunk(step=1)
+        assert d["action"] == "escalate"
+        assert pilot.state()["compress"] == "bf16"
+
+
+# ---------------------------------------------------------------------------
+# the scan-K knob
+
+
+class TestKPolicy:
+    def _pilot(self, agg, nows, **kw):
+        kw.setdefault("modes", ("none",))  # compress knob disabled
+        kw.setdefault("rules", memwatch.mem_rules())
+        kw.setdefault("window_s", 60.0)
+        kw.setdefault("healthy_for_s", 20.0)
+        return Autopilot(None, aggregator=agg,
+                         now=iter(nows).__next__, **kw)
+
+    def test_mem_pressure_lowers_k(self):
+        agg = timeseries.WindowedAggregator()
+        plant_mem_burn(agg)
+        calls = []
+        pilot = self._pilot(agg, [10.0], k_candidates=(1, 2, 4),
+                            initial_k=4, set_scan_k=calls.append)
+        [d] = pilot.on_chunk(step=1)
+        assert d["knob"] == "scan_k"
+        assert d["action"] == "lower"
+        assert (d["frm"], d["to"]) == (4, 2)
+        assert d["signal"] == "mem_pressure"
+        assert calls == [2] and pilot.scan_k == 2
+        assert telemetry.snapshot()["gauges"]["autopilot.scan_k"] == 2.0
+
+    def test_mem_pressure_at_floor_clamps(self):
+        agg = timeseries.WindowedAggregator()
+        plant_mem_burn(agg)
+        calls = []
+        pilot = self._pilot(agg, [10.0], k_candidates=(1, 2, 4),
+                            initial_k=1, set_scan_k=calls.append)
+        [d] = pilot.on_chunk(step=1)
+        assert d["action"] == "clamp" and d["frm"] == 1
+        assert calls == [] and pilot.scan_k == 1
+        assert pilot.state()["clamped"] == 1
+
+    def test_host_gap_with_headroom_raises_k_after_healthy_window(self):
+        agg = timeseries.WindowedAggregator()
+        agg.tick(now=0.0)
+        telemetry.set_gauge("mem.headroom_frac", 0.6)
+        agg.tick(now=5.0)  # no dispatch hists: host_gap = 1.0
+        calls = []
+        pilot = self._pilot(agg, [10.0, 31.0, 100.0, 170.0],
+                            k_candidates=(1, 2, 4), initial_k=1,
+                            set_scan_k=calls.append)
+        assert pilot.on_chunk(step=1) == []  # first chunk anchors health
+        [d] = pilot.on_chunk(step=2)
+        assert d["action"] == "raise" and (d["frm"], d["to"]) == (1, 2)
+        assert d["signal"] == "host_gap"
+        assert d["host_gap_frac"] == 1.0
+        assert d["headroom_frac"] == 0.6
+        agg.tick(now=95.0)   # keep the window covered
+        [d] = pilot.on_chunk(step=3)
+        assert d["action"] == "raise" and d["to"] == 4
+        agg.tick(now=165.0)
+        [d] = pilot.on_chunk(step=4)
+        assert d["action"] == "clamp" and d["frm"] == 4  # at the ceiling
+        assert calls == [2, 4]
+
+    def test_no_raise_without_headroom_signal(self):
+        agg = timeseries.WindowedAggregator()
+        agg.tick(now=0.0)
+        telemetry.count("loader.batches")  # a frame, but no headroom gauge
+        agg.tick(now=5.0)
+        pilot = self._pilot(agg, [10.0, 31.0], k_candidates=(1, 2),
+                            initial_k=1)
+        assert pilot.on_chunk(step=1) == []
+        assert pilot.on_chunk(step=2) == []  # healthy, but no evidence
+        assert pilot.scan_k == 1
+
+
+# ---------------------------------------------------------------------------
+# the program-cache budget knob
+
+
+class TestCachePolicy:
+    def _cache(self, name, entries, **kw):
+        from tpu_syncbn.parallel import scan_driver
+
+        cache = scan_driver.ProgramCache(name=name, **kw)
+        for key, size in entries:
+            cache[key] = object()
+            cache._sizes[key] = size
+        return cache
+
+    def _pilot(self, agg, nows, caches, **kw):
+        kw.setdefault("modes", ("none",))
+        kw.setdefault("rules", memwatch.mem_rules())
+        kw.setdefault("window_s", 60.0)
+        kw.setdefault("healthy_for_s", 20.0)
+        kw.setdefault("cache_bytes_bounds", (256, 2048))
+        return Autopilot(None, aggregator=agg, extra_caches=caches,
+                         now=iter(nows).__next__, **kw)
+
+    def test_mem_pressure_halves_budget_and_evicts(self):
+        cache = self._cache("ap0", [("a", 600), ("b", 600)])
+        agg = timeseries.WindowedAggregator()
+        plant_mem_burn(agg)
+        pilot = self._pilot(agg, [10.0], (cache,))
+        [d] = pilot.on_chunk(step=1)
+        assert d["knob"] == "cache_bytes"
+        assert d["action"] == "shrink"
+        # no budget set yet: the ceiling is the starting point
+        assert (d["frm"], d["to"]) == (2048, 1024)
+        assert d["signal"] == "mem_pressure"
+        assert cache.max_bytes == 1024
+        assert list(cache) == ["b"]  # 1200 live > 1024: oldest evicted
+        assert cache.evictions == 1
+        snap = telemetry.snapshot()
+        assert snap["gauges"]["autopilot.cache_max_bytes"] == 1024.0
+
+    def test_mem_pressure_at_floor_clamps(self):
+        cache = self._cache("ap1", [("a", 100)], max_bytes=256)
+        agg = timeseries.WindowedAggregator()
+        plant_mem_burn(agg)
+        pilot = self._pilot(agg, [10.0], (cache,))
+        [d] = pilot.on_chunk(step=1)
+        assert d["action"] == "clamp" and d["frm"] == 256
+        assert cache.max_bytes == 256
+
+    def test_budget_regrows_after_sustained_healthy_window(self):
+        cache = self._cache("ap2", [("a", 100)], max_bytes=512)
+        agg = timeseries.WindowedAggregator()
+        agg.tick(now=0.0)
+        agg.tick(now=5.0)  # frames exist, but no mem signal ever burns
+        pilot = self._pilot(agg, [10.0, 31.0, 32.0, 100.0, 200.0],
+                            (cache,))
+        assert pilot.on_chunk(step=1) == []   # health anchor
+        [d] = pilot.on_chunk(step=2)
+        assert d["action"] == "grow" and (d["frm"], d["to"]) == (512, 1024)
+        assert d["signal"] == "mem_healthy"
+        assert pilot.on_chunk(step=3) == []   # cooldown
+        [d] = pilot.on_chunk(step=4)
+        assert d["to"] == 2048
+        assert pilot.on_chunk(step=5) == []   # at the ceiling: no churn
+        assert cache.max_bytes == 2048
+        assert pilot.state()["actuations"] == 2
+
+    def test_set_max_bytes_evicts_and_validates(self):
+        cache = self._cache("ap3", [("a", 600), ("b", 600)])
+        assert cache.set_max_bytes(700) == 600
+        assert list(cache) == ["b"]
+        assert cache.evictions == 1
+        with pytest.raises(ValueError, match="max_bytes"):
+            cache.set_max_bytes(0)
+        assert cache.set_max_bytes(None) == 600  # budget removed
+        assert cache.max_bytes is None
+
+
+# ---------------------------------------------------------------------------
+# every decision observable: flight-recorder ring + incident bundles
+
+
+class TestDecisionObservability:
+    def _install(self, tmp_path, **kw):
+        kw.setdefault("incident_dir", str(tmp_path / "incidents"))
+        kw.setdefault("cooldown_s", 0.0)
+        return flightrec.install(flightrec.FlightRecorder(**kw))
+
+    def _bundles(self, rec):
+        import glob
+
+        paths = sorted(glob.glob(os.path.join(rec.incident_dir,
+                                              "incident_*.json")))
+        return [incident.load_bundle(p) for p in paths]
+
+    def test_every_decision_lands_in_the_ring(self, tmp_path):
+        rec = self._install(tmp_path)
+        trainer = StubTrainer("int8")
+        agg = timeseries.WindowedAggregator()
+        plant_numerics_burn(agg)
+        pilot = Autopilot(trainer, aggregator=agg,
+                          rules=obs_numerics.numerics_rules(),
+                          modes=("int8", "bf16"), window_s=4.0,
+                          now=iter([10.0, 11.0, 20.0]).__next__)
+        pilot.on_chunk(step=1, recovering=True)
+        pilot.on_chunk(step=2)
+        pilot.on_chunk(step=3)
+        ring = rec.rings_snapshot()["autopilot"]
+        assert [e["action"] for e in ring] == ["suppress", "escalate",
+                                               "clamp"]
+        assert [e["knob"] for e in ring] == ["all", "compress", "compress"]
+        assert all(isinstance(e["t"], float) for e in ring)
+
+    def test_actuation_dumps_schema_valid_autopilot_bundle(self, tmp_path):
+        rec = self._install(tmp_path)
+        trainer = StubTrainer("int8")
+        agg = timeseries.WindowedAggregator()
+        plant_numerics_burn(agg)
+        pilot = Autopilot(trainer, aggregator=agg,
+                          rules=obs_numerics.numerics_rules(),
+                          modes=("int8", "bf16"), window_s=4.0,
+                          now=iter([10.0, 20.0]).__next__)
+        pilot.on_chunk(step=1)   # escalate -> autopilot bundle
+        pilot.on_chunk(step=2)   # clamp -> ring only, no bundle
+        bundles = self._bundles(rec)  # load_bundle schema-validates
+        by_kind = {}
+        for b in bundles:
+            by_kind.setdefault(b["trigger"]["kind"], []).append(b)
+        # the rule transition itself also dumped an slo_alert bundle
+        # (cooldown 0); exactly ONE autopilot bundle — the actuation
+        assert len(by_kind["autopilot"]) == 1
+        ap = by_kind["autopilot"][0]
+        detail = ap["trigger"]["detail"]
+        assert detail["action"] == "escalate"
+        assert detail["signal"] == "numerics_residual"
+        assert detail["burns"]
+        ring = ap["rings"]["autopilot"]
+        assert ring and all(isinstance(e["knob"], str) for e in ring)
+
+    def test_bundle_validation_rejects_knobless_ring_entry(self, tmp_path):
+        rec = self._install(tmp_path)
+        rec.record_autopilot("compress", action="escalate")
+        path = rec.trigger("manual", force=True)
+        bundle = incident.load_bundle(path)
+        bundle["rings"]["autopilot"] = [{"action": "escalate"}]
+        with pytest.raises(ValueError, match="autopilot-ring"):
+            incident.validate_bundle(bundle)
+
+    def test_ring_is_bounded_and_scalarized(self):
+        rec = flightrec.FlightRecorder(autopilot_capacity=3)
+        for i in range(7):
+            rec.record_autopilot("compress", idx=i, burn=np.float32(1.5))
+        ring = rec.rings_snapshot()["autopilot"]
+        assert [e["idx"] for e in ring] == [4, 5, 6]  # oldest dropped
+        assert ring[0]["burn"] == 1.5
+        assert type(ring[0]["burn"]) is float
+        with pytest.raises(ValueError, match="autopilot_capacity"):
+            flightrec.FlightRecorder(autopilot_capacity=0)
+
+    def test_statusz_renders_controller_counters(self, tmp_path):
+        self._install(tmp_path)
+        agg = timeseries.WindowedAggregator()
+        plant_numerics_burn(agg)
+        pilot = Autopilot(StubTrainer("int8"), aggregator=agg,
+                          rules=obs_numerics.numerics_rules(),
+                          modes=("int8", "bf16"), window_s=4.0,
+                          now=iter([10.0]).__next__)
+        pilot.on_chunk(step=1)
+        text = obs_server.render_statusz(
+            obs_server.statusz_report(registry=telemetry.REGISTRY)
+        )
+        assert "autopilot" in text
+        assert "autopilot.actuations" in text
+
+
+# ---------------------------------------------------------------------------
+# the data-side K actuator
+
+
+class TestChunkedBatches:
+    def test_rereads_live_k_and_emits_tail(self):
+        agg = timeseries.WindowedAggregator()
+        pilot = Autopilot(None, aggregator=agg, rules=[],
+                          modes=("none",), k_candidates=(2, 4),
+                          initial_k=2)
+        batches = [np.full((3,), i, np.float32) for i in range(5)]
+        gen = chunked_batches(batches, pilot)
+        first = next(gen)
+        assert first.shape == (2, 3)
+        pilot.scan_k = 4  # an actuation landing mid-stream
+        tail = next(gen)
+        assert tail.shape == (3, 3)  # only 3 batches left
+        with pytest.raises(StopIteration):
+            next(gen)
+
+
+# ---------------------------------------------------------------------------
+# the trainer-side actuator surface: DataParallel.set_compress
+
+
+def _make_dp(**kw):
+    import optax
+    from flax import nnx
+
+    from tpu_syncbn import nn as tnn, parallel
+
+    class TinyNet(nnx.Module):
+        def __init__(self, rngs):
+            self.fc = nnx.Linear(4, 4, rngs=rngs)
+            self.bn = tnn.BatchNorm1d(4)
+
+        def __call__(self, x):
+            return self.bn(self.fc(x))
+
+    def loss_fn(m, batch):
+        x, y = batch
+        return ((m(x) - y) ** 2).mean()
+
+    model = tnn.convert_sync_batchnorm(TinyNet(nnx.Rngs(0)))
+    return parallel.DataParallel(model, optax.adam(1e-2), loss_fn, **kw)
+
+
+def _make_batch(seed=0):
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.randn(16, 4), jnp.float32),
+        jnp.asarray(rng.randn(16, 4), jnp.float32),
+    )
+
+
+class TestSetCompress:
+    def test_same_mode_is_a_noop(self):
+        dp = _make_dp(compress="int8")
+        assert dp.set_compress("int8") is False
+
+    def test_invalid_mode_rejected(self):
+        dp = _make_dp(compress="int8")
+        with pytest.raises(ValueError, match="compression mode"):
+            dp.set_compress("fp8")
+
+    def test_legacy_hook_rejected(self):
+        dp = _make_dp(grad_compression="bf16")
+        with pytest.raises(ValueError, match="legacy"):
+            dp.set_compress("bf16")
+
+    def test_switch_parks_and_recalls_programs(self):
+        dp = _make_dp(compress="int8")
+        batch = _make_batch()
+        dp.train_step(batch)
+        step_int8 = dp._train_step
+        cache_int8 = dp._train_steps_cache
+        assert dp.set_compress("bf16") is True
+        assert dp.compress == "bf16"
+        assert dp._train_step is not step_int8
+        assert len(dp.program_caches) == 2  # live + parked int8
+        dp.train_step(batch)
+        # switching back recalls the parked program objects verbatim —
+        # the recompile-storm detector stays quiet under mode flapping
+        assert dp.set_compress("int8") is True
+        assert dp._train_step is step_int8
+        assert dp._train_steps_cache is cache_int8
+        assert len(dp.program_caches) == 2
+
+    def test_switch_zeroes_residual_and_keeps_structure(self):
+        import jax
+
+        dp = _make_dp(compress="int8")  # error feedback defaults on
+        batch = _make_batch()
+        dp.train_step(batch)
+        structure = jax.tree_util.tree_structure(dp.opt_state)
+        dp.set_compress("bf16")
+        # fixed pytree across rungs: checkpoints/donation see one shape
+        assert jax.tree_util.tree_structure(dp.opt_state) == structure
+        _, residual = dp.opt_state
+        assert all(
+            not np.any(np.asarray(leaf))
+            for leaf in jax.tree_util.tree_leaves(residual)
+        )
+        out = dp.train_step(batch)  # healthy on the new wire
+        assert np.isfinite(float(out.loss))
+
+
+# ---------------------------------------------------------------------------
+# ResilientLoop wiring: suppression under rollback, live watchdog deadline
+
+
+@pytest.mark.fault
+class TestResilientLoopIntegration:
+    def test_divergence_rollback_suppresses_actuation(self, tmp_path):
+        from tpu_syncbn.runtime import resilience
+        from tpu_syncbn.testing import faults
+
+        dp = _make_dp(divergence_guard="restore_last_good")
+        agg = timeseries.WindowedAggregator()
+        agg.tick(now=0.0)
+        pilot = Autopilot(None, aggregator=agg, modes=("none",),
+                          rules=obs_numerics.numerics_rules())
+        batch = _make_batch()
+        loop = resilience.ResilientLoop(dp, str(tmp_path / "ck"),
+                                        ckpt_every=2, autopilot=pilot)
+        try:
+            loop.run(iter([batch] * 4))
+            loop.run(faults.poison_nan(iter([batch] * 3), 1))
+        finally:
+            loop.close()
+        assert loop.counters.count("divergence_restores") == 1
+        st = pilot.state()
+        # the guard owned the rollback chunk: the policy step was
+        # suppressed (and recorded as such), nothing actuated
+        assert st["suppressed"] == 1
+        assert st["actuations"] == 0
+        assert st["last_decision"]["action"] == "suppress"
+        assert st["last_decision"]["signal"] == "divergence_recovery"
+
+    def test_watchdog_deadline_follows_live_k(self, tmp_path, monkeypatch):
+        from tpu_syncbn.runtime import resilience
+
+        created = []
+        real_watchdog = resilience.Watchdog
+
+        class CapturingWatchdog(real_watchdog):
+            def __init__(self, *args, **kw):
+                super().__init__(*args, **kw)
+                created.append(self)
+
+        monkeypatch.setattr(resilience, "Watchdog", CapturingWatchdog)
+        dp = _make_dp(compress="none")
+        agg = timeseries.WindowedAggregator()
+        plant_mem_burn(agg)
+        clock = itertools.count(10, 100)
+        pilot = Autopilot(None, aggregator=agg,
+                          rules=memwatch.mem_rules(), modes=("none",),
+                          k_candidates=(1, 2), initial_k=2,
+                          window_s=60.0, healthy_for_s=1e9,
+                          now=lambda: float(next(clock)))
+        batch = _make_batch()
+        loop = resilience.ResilientLoop(dp, str(tmp_path / "ck"),
+                                        ckpt_every=100, scan_steps=2,
+                                        step_deadline_s=30.0,
+                                        autopilot=pilot)
+        try:
+            loop.run(chunked_batches(iter([batch] * 6), pilot),
+                     max_steps=6)
+        finally:
+            loop.close()
+        assert loop.step == 6
+        # first chunk burned mem_pressure: K lowered 2 -> 1, the loop
+        # mirrored it, and the data side emitted 1-step chunks after
+        assert pilot.scan_k == 1
+        assert loop.scan_steps == 1
+        assert pilot.state()["actuations"] == 1
+        # the per-chunk recompute: the watchdog was built at 30 * 2 but
+        # must end at 30 * 1 — a stale deadline would mask real stalls
+        # for 2x too long after a K actuation
+        assert len(created) == 1
+        assert created[0].deadline_s == 30.0

@@ -575,6 +575,9 @@ class TestTelemetryBlock:
         # the numerics block is always present (the drift/compression-
         # health monitors published through the timed loop — ISSUE 13)
         self._validate_numerics_block(line["numerics"], steps=3)
+        # the autopilot block is always present (the closed-loop
+        # controller A/B under an injected numerics fault — ISSUE 17)
+        self._validate_autopilot_block(line["autopilot"])
         # the serve block is null unless --serve ran the sweep
         assert line["serve"] is None
         # the --trace file is valid Chrome trace JSON with the three
@@ -727,6 +730,43 @@ class TestTelemetryBlock:
         assert block["rules"] == [
             "numerics_residual", "numerics_skew", "numerics_clip",
         ]
+
+    @staticmethod
+    def _validate_autopilot_block(block):
+        """The schema-pinned `autopilot` block (ISSUE 17): the
+        injected-fault A/B — the controller must escalate off int8
+        within one evaluation window (2 chunks at the injected 30s
+        clock; escalate_within_chunks and advantage_ratio are BASELINE
+        anchors), converge while the static arm degrades, and every
+        actuation must dump a schema-valid autopilot bundle naming the
+        triggering signal."""
+        assert block is not None
+        assert set(block) == {
+            "steps", "fault_gain", "initial_mse", "static_final_mse",
+            "autopilot_final_mse", "advantage_ratio",
+            "escalate_within_chunks", "first_signal", "modes_visited",
+            "final_mode", "actuations", "clamped", "suppressed",
+            "bundles",
+        }
+        # the controller reacted within one evaluation window...
+        assert block["escalate_within_chunks"] is not None
+        assert 1 <= block["escalate_within_chunks"] <= 2
+        assert block["first_signal"] == "numerics_clip"
+        # ...escaped int8 (ladder order preserved)...
+        assert block["modes_visited"][0] == "int8"
+        assert block["final_mode"] in ("bf16", "none")
+        assert block["actuations"] >= 1
+        # ...and the A/B verdict holds: the controlled arm converges
+        # below its start while the static int8 arm ends up clearly
+        # worse (the injected fault quantizes its real gradients away)
+        assert block["autopilot_final_mse"] < block["initial_mse"]
+        assert block["advantage_ratio"] >= 2.0
+        # every actuation dumped a schema-valid autopilot bundle
+        # quoting the triggering signal
+        bundles = block["bundles"]
+        assert bundles is not None and bundles["valid"] is True
+        assert bundles["count"] == block["actuations"]
+        assert all(s == "numerics_clip" for s in bundles["signals"])
 
     @staticmethod
     def _validate_incident_block(block, *, steps):

@@ -641,6 +641,12 @@ class TestStatusz:
                 "compile.storms": 1,
                 "compile.train.events": 2,
             },
+            "autopilot": {
+                "autopilot.compress_rung": 1.0,
+                "autopilot.scan_k": 4.0,
+                "autopilot.actuations": 2,
+                "autopilot.clamped": 1,
+            },
             "last_incident": {
                 "id": "20260804T000000-h0-001-manual",
                 "trigger": "manual", "path": "/tmp/i.json",
@@ -692,6 +698,12 @@ class TestStatusz:
             "  compile.storms                       1\n"
             "  compile.train.events                 2\n"
             "\n"
+            "autopilot\n"
+            "  autopilot.actuations                 2\n"
+            "  autopilot.clamped                    1\n"
+            "  autopilot.compress_rung              1\n"
+            "  autopilot.scan_k                     4\n"
+            "\n"
             "last incident\n"
             "  id=20260804T000000-h0-001-manual trigger=manual\n"
             "  path=/tmp/i.json\n"
@@ -705,6 +717,7 @@ class TestStatusz:
         assert "(no numerics monitors published)" in text
         assert "set TPU_SYNCBN_MEMWATCH=1" in text
         assert "(none observed)" in text
+        assert "(no autopilot attached)" in text
         assert "set TPU_SYNCBN_FLIGHTREC=1" in text
 
     def test_endpoint_serves_live_state(self, tmp_path):
@@ -922,6 +935,32 @@ class TestMetricNameDrift:
         ).sample()
         profiling.note_compile("train", 0.01)
         telemetry.count("compile.storms", 0)
+        # autopilot (ISSUE 17): one suppressed, one escalated, and one
+        # clamped policy step on a stub trainer — produces the full
+        # autopilot.* family (state gauges, decision counters, the
+        # decision_s histogram) without jax
+        from tpu_syncbn.runtime.autopilot import Autopilot
+
+        class _StubTrainer:
+            compress = "int8"
+            program_caches = ()
+
+            def set_compress(self, mode):
+                self.compress = mode
+                return True
+
+        ap_agg = timeseries.WindowedAggregator()
+        ap_agg.tick(now=0.0)
+        for _ in range(20):
+            telemetry.observe("numerics.ef_residual_ratio", 0.9,
+                              buckets=(0.1, 0.5, 1.0))
+        ap_agg.tick(now=5.0)
+        ap = Autopilot(_StubTrainer(), aggregator=ap_agg,
+                       modes=("int8", "bf16"), window_s=4.0,
+                       now=iter([10.0, 11.0, 20.0]).__next__)
+        ap.on_chunk(step=1, recovering=True)   # suppressed
+        ap.on_chunk(step=2)                    # escalate int8 -> bf16
+        ap.on_chunk(step=3)                    # still burning: clamp
         telemetry.count("obs.profilez.captures", 0)
         telemetry.observe("obs.profilez.capture_s", 0.1)
         telemetry.set_gauge("obs.profilez.bytes", 1000)

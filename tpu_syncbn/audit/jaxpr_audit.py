@@ -327,6 +327,42 @@ def _dp_compressed_train_step(mode: str) -> ProgramSpec:
     )
 
 
+def _autopilot_train_step(mode: str) -> ProgramSpec:
+    """ISSUE 17: the autopilot's selectable compress-mode trio, pinned
+    exactly as the controller runs them — ONE trainer constructed at
+    the lossiest rung with error feedback on, then ``set_compress``ed
+    to the target rung. The EF residual therefore rides opt_state in
+    all three programs (fixed pytree structure across actuations —
+    checkpoints, donation aliases, and scan carries survive a
+    mid-training mode switch), including the exact fp32 wire where
+    ``ef_compressed_pmean(mode="none")`` passes it through untouched.
+    Distinct from the ISSUE 12 trio above, which pins each mode at its
+    *construction-time* default EF setting."""
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_syncbn import parallel
+
+    dp = parallel.DataParallel(
+        _compress_mlp(), optax.sgd(0.1, momentum=0.9), _mse,
+        compress="int8", error_feedback=True,
+        divergence_guard="skip_step", monitors=False,
+    )
+    dp.set_compress("none" if mode == "fp32" else mode)
+    return ProgramSpec(
+        name=f"autopilot.compressed_{mode}.train_step",
+        fn=dp._train_step,
+        example_args=(dp._param_store, dp.rest, dp.opt_state,
+                      _batch_struct(_GLOBAL_BATCH)),
+        arg_labels=("params", "rest", "opt_state", "batch"),
+        declared_donated=("params", "opt_state"),
+        world=dp.world,
+        mesh=dp.mesh,
+        in_specs=(dp._pspec, dp._rest_spec, dp._opt_spec,
+                  P(dp.axis_name)),
+    )
+
+
 def _syncbn_compressed_stats() -> ProgramSpec:
     """The compressed SyncBN moment reduction in isolation: (sum, sumsq)
     ride the bf16 wire, the count census stays an exact fp32 psum — the
@@ -655,6 +691,12 @@ PROGRAM_BUILDERS: dict[str, Callable[[], ProgramSpec]] = {
         lambda: _dp_compressed_train_step("bf16"),
     "dataparallel.compressed_int8.train_step":
         lambda: _dp_compressed_train_step("int8"),
+    "autopilot.compressed_fp32.train_step":
+        lambda: _autopilot_train_step("fp32"),
+    "autopilot.compressed_bf16.train_step":
+        lambda: _autopilot_train_step("bf16"),
+    "autopilot.compressed_int8.train_step":
+        lambda: _autopilot_train_step("int8"),
     "syncbn.compressed_stats": _syncbn_compressed_stats,
     "gan.train_step": _gan_train_step,
     "serve.eval_bucket8": _serve_eval_bucket,
@@ -786,17 +828,21 @@ def check_invariants(
           "expert-parallel MoE relocates compute with exactly TWO "
           f"all_to_alls (dispatch + return), found {moe.collectives}")
 
-    fp32c = contracts.get("dataparallel.compressed_fp32.train_step")
-    if fp32c is not None:
+    # the same floors bind the autopilot's actuation trio (ISSUE 17):
+    # every rung the controller can select is ratio- and guard-checked
+    for fam in ("dataparallel", "autopilot"):
+        fp32c = contracts.get(f"{fam}.compressed_fp32.train_step")
+        if fp32c is None:
+            continue
         lossy_bytes = lossy_collective_bytes
         for mode, factor in (("bf16", 2.0), ("int8", 3.5)):
-            c = contracts.get(f"dataparallel.compressed_{mode}.train_step")
+            c = contracts.get(f"{fam}.compressed_{mode}.train_step")
             if c is None:
                 continue
             ratio = lossy_bytes(fp32c) / max(1, lossy_bytes(c))
             if ratio < factor:
                 v("contract.compression_ratio",
-                  f"compressed_{mode} train step puts "
+                  f"{fam} compressed_{mode} train step puts "
                   f"{lossy_bytes(c)} lossy-eligible bytes on the wire vs "
                   f"{lossy_bytes(fp32c)} fp32 — ratio {ratio:.2f} < the "
                   f"ISSUE 12 floor {factor}× (quantization stopped "
@@ -806,7 +852,7 @@ def check_invariants(
                     or c.collective_bytes.get("pmin", 0) !=
                     fp32c.collective_bytes.get("pmin", 0)):
                 v("contract.guard_stays_fp32",
-                  f"compressed_{mode} train step's divergence-guard "
+                  f"{fam} compressed_{mode} train step's divergence-guard "
                   f"pmin ({c.collectives.get('pmin', 0)} call(s), "
                   f"{c.collective_bytes.get('pmin', 0)} B) differs from "
                   f"the fp32 program's — the finiteness consensus must "

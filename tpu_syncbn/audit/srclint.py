@@ -46,11 +46,13 @@ PREFIX_RE = re.compile(r"^[a-z0-9_]+$")
 #: ``mem`` / ``compile`` are ISSUE 14's memory-and-compile families
 #: (``obs.memwatch`` / ``obs.profiling`` — docs/OBSERVABILITY.md
 #: "Memory & compile").
+#: ``autopilot`` is ISSUE 17's closed-loop controller family
+#: (``runtime.autopilot`` — docs/OBSERVABILITY.md "Autopilot").
 KNOWN_METRIC_PREFIXES = frozenset({
-    "audit", "bench", "checkpoint", "collectives", "compile", "data",
-    "events", "gan", "incident", "loader", "mem", "monitor", "numerics",
-    "obs", "pipeline", "probe", "rendezvous", "resilience", "scan",
-    "serve", "slo", "step", "train",
+    "audit", "autopilot", "bench", "checkpoint", "collectives", "compile",
+    "data", "events", "gan", "incident", "loader", "mem", "monitor",
+    "numerics", "obs", "pipeline", "probe", "rendezvous", "resilience",
+    "scan", "serve", "slo", "step", "train",
 })
 
 _SUPPRESS_RE = re.compile(r"#\s*audit:\s*ok(?:\[([a-z0-9_,\s]+)\])?")

@@ -31,7 +31,11 @@ circuit breaker opens, the seconds *before* the event are gone. The
   so an OOM post-mortem has the pre-pressure history;
 * a ring of recent **compile events** — one entry per compile seam
   (:func:`tpu_syncbn.obs.profiling.note_compile`), the evidence a
-  ``recompile_storm`` bundle names the churning family with.
+  ``recompile_storm`` bundle names the churning family with;
+* a ring of recent **autopilot decisions** — every knob turn (and
+  every clamped or suppressed attempt) the closed-loop controller
+  (:mod:`tpu_syncbn.runtime.autopilot`) makes, with the triggering
+  signal quoted, so a post-mortem can replay the policy history.
 
 On a trigger (:meth:`FlightRecorder.trigger` — fired by the SLO
 tracker, the divergence guard, the watchdog, the circuit breaker, or
@@ -137,6 +141,7 @@ class FlightRecorder:
         serve_capacity: int = 512,
         mem_capacity: int = 512,
         compile_capacity: int = 256,
+        autopilot_capacity: int = 256,
         registry: telemetry.Registry | None = None,
         aggregator: timeseries.WindowedAggregator | None = None,
         interval_s: float = 1.0,
@@ -151,6 +156,7 @@ class FlightRecorder:
                         ("serve_capacity", serve_capacity),
                         ("mem_capacity", mem_capacity),
                         ("compile_capacity", compile_capacity),
+                        ("autopilot_capacity", autopilot_capacity),
                         ("max_bundles", max_bundles)):
             if v < 1:
                 raise ValueError(f"{name} must be >= 1, got {v}")
@@ -178,6 +184,7 @@ class FlightRecorder:
         self._serve: deque = deque(maxlen=int(serve_capacity))
         self._mem: deque = deque(maxlen=int(mem_capacity))
         self._compile: deque = deque(maxlen=int(compile_capacity))
+        self._autopilot: deque = deque(maxlen=int(autopilot_capacity))
         self._contract: dict = {}
         self._seq = 0
         self._last_dump_t: float | None = None
@@ -268,6 +275,16 @@ class FlightRecorder:
         with self._lock:
             self._compile.append(entry)
 
+    def record_autopilot(self, knob: str, **detail) -> None:
+        """Append one autopilot decision (escalate / de-escalate /
+        retune / clamp / suppress, per knob) to the autopilot ring —
+        every policy step lands here whether or not it also dumped an
+        incident bundle, so a post-mortem can replay the controller's
+        recent history."""
+        entry = {"knob": str(knob), "t": self._now(), **detail}
+        with self._lock:
+            self._autopilot.append(entry)
+
     def set_contract(self, **fields) -> None:
         """Merge static program-contract facts into the recorder —
         ``flops_per_step`` (HLO cost analysis),
@@ -293,6 +310,7 @@ class FlightRecorder:
             serve = list(self._serve)
             mem = list(self._mem)
             compiles = list(self._compile)
+            autopilot = list(self._autopilot)
         return {
             "steps": [
                 {
@@ -317,6 +335,13 @@ class FlightRecorder:
                 {k: (_scalarize(v) if k != "family" else v)
                  for k, v in e.items()}
                 for e in compiles
+            ],
+            # decision fields (knob/action/signal/from/to) are strings
+            # by construction; scalarize only the numeric payload
+            "autopilot": [
+                {k: (v if isinstance(v, str) else _scalarize(v))
+                 for k, v in e.items()}
+                for e in autopilot
             ],
         }
 
@@ -468,6 +493,14 @@ def record_compile(family: str, seconds=None, **detail) -> None:
     rec = _installed
     if rec is not None:
         rec.record_compile(family, seconds, **detail)
+
+
+def record_autopilot(knob: str, **detail) -> None:
+    """Feed one autopilot decision to the installed recorder (no-op
+    without one)."""
+    rec = _installed
+    if rec is not None:
+        rec.record_autopilot(knob, **detail)
 
 
 def trigger(

@@ -55,7 +55,7 @@ MERGED_KIND = "tpu_syncbn.incident_merged"
 #: (schema token form) — these are the wired ones.
 TRIGGER_KINDS = ("slo_alert", "divergence_restore", "watchdog_stall",
                  "circuit_open", "numerics_drift", "mem_pressure",
-                 "recompile_storm", "weight_swap", "manual")
+                 "recompile_storm", "weight_swap", "autopilot", "manual")
 
 _KIND_RE = re.compile(r"^[a-z0-9_]+$")
 
@@ -254,11 +254,11 @@ def validate_bundle(bundle) -> dict:
     for ring in ("steps", "serve"):
         if not isinstance(rings.get(ring), list):
             raise ValueError(f"bundle rings.{ring} must be a list")
-    # mem/compile rings (ISSUE 14) are optional within schema 1: bundles
-    # written before they existed must keep loading — a post-mortem diff
-    # of a pre-upgrade bundle against a post-upgrade one is exactly the
-    # upgrade-window use case
-    for ring in ("mem", "compile"):
+    # mem/compile (ISSUE 14) and autopilot (ISSUE 17) rings are optional
+    # within schema 1: bundles written before they existed must keep
+    # loading — a post-mortem diff of a pre-upgrade bundle against a
+    # post-upgrade one is exactly the upgrade-window use case
+    for ring in ("mem", "compile", "autopilot"):
         if ring in rings and not isinstance(rings[ring], list):
             raise ValueError(f"bundle rings.{ring} must be a list")
     for e in rings["steps"]:
@@ -273,6 +273,11 @@ def validate_bundle(bundle) -> dict:
     for e in rings.get("compile", ()):
         if not isinstance(e, dict) or not isinstance(e.get("family"), str):
             raise ValueError(f"bundle compile-ring entry unusable: {e!r}")
+    for e in rings.get("autopilot", ()):
+        if not isinstance(e, dict) or not isinstance(e.get("knob"), str):
+            raise ValueError(
+                f"bundle autopilot-ring entry unusable: {e!r}"
+            )
     state = bundle.get("state")
     if not isinstance(state, dict) \
             or not isinstance(state.get("heartbeat_age_s"), dict) \

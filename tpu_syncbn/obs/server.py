@@ -260,6 +260,18 @@ def statusz_report(
         compiles["compile.time_s.sum"] = round(
             compile_hist.get("sum", 0.0), 4
         )
+    # autopilot (runtime.autopilot — ISSUE 17): per-knob state gauges
+    # and the actuation/clamp/suppression tallies, read from the
+    # registry (no runtime import — the controller publishes, /statusz
+    # renders), so "is something turning my knobs, and where are they"
+    # is on the one-glance page
+    autopilot: dict = {}
+    for name, value in snap["gauges"].items():
+        if name.startswith("autopilot."):
+            autopilot[name] = value
+    for name, value in snap["counters"].items():
+        if name.startswith("autopilot."):
+            autopilot[name] = value
     rec = flightrec.get()
     return {
         "heartbeat_age_s": {
@@ -275,6 +287,7 @@ def statusz_report(
         "memory": memory,
         "memory_counters": memory_counters,
         "compiles": compiles,
+        "autopilot": autopilot,
         "train_step": snap["gauges"].get("train.step"),
         "last_incident": rec.last_incident if rec is not None else None,
         "recorder_installed": rec is not None,
@@ -389,6 +402,15 @@ def render_statusz(report: dict) -> str:
             lines.append(f"  {name:<36} {v_s}")
     else:
         lines.append("  (none observed)")
+    lines.append("")
+    lines.append("autopilot")
+    autopilot = report.get("autopilot") or {}
+    if autopilot:
+        for name, value in sorted(autopilot.items()):
+            v_s = f"{value:g}" if isinstance(value, (int, float)) else value
+            lines.append(f"  {name:<36} {v_s}")
+    else:
+        lines.append("  (no autopilot attached)")
     lines.append("")
     lines.append("last incident")
     inc = report.get("last_incident")

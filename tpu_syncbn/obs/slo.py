@@ -226,6 +226,68 @@ def publication_rules(
     ]
 
 
+#: rule families :func:`standard_rules` knows how to build, in the
+#: order they are emitted. Training-side families first, serving-side
+#: last — callers slice by name, not position.
+STANDARD_RULE_FAMILIES = (
+    "numerics", "mem", "compile", "serve", "publication",
+)
+
+
+def standard_rules(
+    families: Sequence[str] = STANDARD_RULE_FAMILIES,
+    **overrides,
+) -> list["AlertRule"]:
+    """One-call aggregation of the rule factories scattered across the
+    observability plane, so ResilientLoop and the autopilot attach the
+    full SLO set with ``SLOTracker(agg, standard_rules()).attach()``
+    instead of five imports:
+
+    * ``"numerics"`` — :func:`tpu_syncbn.obs.numerics.numerics_rules`
+      (EF residual ratio, BN mean skew, clip saturation);
+    * ``"mem"`` — :func:`tpu_syncbn.obs.memwatch.mem_rules`
+      (live-bytes-over-contract pressure);
+    * ``"compile"`` — :func:`tpu_syncbn.obs.profiling.compile_rules`
+      (recompile-storm budget);
+    * ``"serve"`` — :func:`serve_overload_rules` (latency + overload);
+    * ``"publication"`` — :func:`publication_rules` (rollback budget).
+
+    ``overrides`` are per-family kwarg dicts forwarded to the matching
+    factory (``standard_rules(("numerics",), numerics={"clip_target":
+    0.9})``) — shared knobs like ``windows_s`` stay with the factory
+    that owns them. Unknown families and overrides for families not
+    requested raise, so a typo cannot silently drop a rule set."""
+    known = set(STANDARD_RULE_FAMILIES)
+    requested = list(families)
+    unknown = [f for f in requested if f not in known]
+    if unknown:
+        raise ValueError(
+            f"unknown rule families {unknown}; expected a subset of "
+            f"{STANDARD_RULE_FAMILIES}"
+        )
+    stray = [k for k in overrides if k not in requested]
+    if stray:
+        raise ValueError(
+            f"overrides for families not requested: {stray} "
+            f"(families={requested})"
+        )
+    # training-side factories live with their signal producers; import
+    # lazily at call time (they import slo the same way)
+    from tpu_syncbn.obs import memwatch, numerics, profiling
+
+    factories = {
+        "numerics": numerics.numerics_rules,
+        "mem": memwatch.mem_rules,
+        "compile": profiling.compile_rules,
+        "serve": serve_overload_rules,
+        "publication": publication_rules,
+    }
+    rules: list[AlertRule] = []
+    for fam in requested:
+        rules.extend(factories[fam](**overrides.get(fam, {})))
+    return rules
+
+
 # module registry of attached trackers: /statusz and incident bundles
 # read every attached tracker's alert state through tracker_states()
 _attached_lock = threading.Lock()

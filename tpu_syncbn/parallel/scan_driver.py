@@ -254,6 +254,20 @@ class ProgramCache(dict):
             self._sizes.pop(oldest, None)
             self._record("evictions")
 
+    def set_max_bytes(self, max_bytes: int | None) -> int:
+        """Retune the byte budget in place — the autopilot's cache
+        actuator (budgets shrink under memory pressure, regrow after a
+        sustained-healthy window). Evicts immediately down to the new
+        budget (a mutated attribute alone would only take effect at the
+        next build) and republishes the occupancy gauges; returns the
+        bytes still live. ``None`` removes the budget."""
+        if max_bytes is not None and max_bytes < 1:
+            raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
+        self.max_bytes = max_bytes
+        self._evict_over_budget()
+        self._publish_gauges()
+        return self.bytes_live
+
     def stats(self) -> dict:
         """Accounting snapshot: programs currently live plus lifetime
         hits/misses/evictions (hit rate = hits / (hits + misses)) and

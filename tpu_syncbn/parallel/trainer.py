@@ -505,6 +505,10 @@ class DataParallel:
 
         # n_steps -> scanned jit (FIFO-bounded, hit/miss/eviction counted)
         self._train_steps_cache = scan_driver.ProgramCache(name="train")
+        # compress mode -> parked (jit, scan cache, compile latch):
+        # set_compress() swaps whole program sets so a mode revisited
+        # mid-run reuses its already-compiled executables
+        self._mode_programs: dict[str, tuple] = {}
         self._eval_step = self._build_eval_step()
 
     # -- step builders ----------------------------------------------------
@@ -1064,6 +1068,71 @@ class DataParallel:
         inner, ef = self.opt_state
         zero = jax.tree_util.tree_map(jnp.zeros_like, ef)
         self.opt_state = (inner, jax.device_put(zero, self._per_replica))
+        return True
+
+    @property
+    def program_caches(self) -> tuple:
+        """Every scan :class:`~tpu_syncbn.parallel.scan_driver.ProgramCache`
+        this trainer owns — the live mode's first, then any parked by
+        :meth:`set_compress`. The autopilot's cache-budget actuator
+        adjusts ``max_bytes`` on all of them so a parked mode cannot
+        hold memory the pressure signal asked back."""
+        parked = [
+            cache for (_step, cache, _noted) in self._mode_programs.values()
+            if cache is not self._train_steps_cache
+        ]
+        return (self._train_steps_cache, *parked)
+
+    def set_compress(self, mode: str) -> bool:
+        """Switch the collective compression wire format at a step
+        boundary; returns whether anything changed. The autopilot's
+        compression actuator — but equally a manual knob.
+
+        The optimizer-state *structure* is pinned at construction:
+        ``self._ef`` (whether an error-feedback residual rides in
+        ``opt_state``) never changes here, so checkpoints, fused-scan
+        carries, and donation all see one stable pytree across mode
+        switches. Under exact modes the residual passes through
+        untouched (:func:`collectives.ef_compressed_pmean` with
+        ``mode="none"`` degrades to the exact pmean) — construct the
+        trainer at the lossiest rung you intend to select (e.g.
+        ``compress="int8"``) so the residual exists on every rung.
+
+        Each mode's programs (the per-step jit and the fused-scan
+        cache) are parked on switch-away and recalled on switch-back:
+        a mode revisited recompiles nothing, which is what keeps the
+        recompile-storm detector quiet while the autopilot moves
+        between golden-pinned variants. The residual *content* is
+        wire-format-specific (int8 quantization error replayed onto a
+        bf16 wire is just noise), so it is zeroed at every switch."""
+        collectives.check_compress_mode(mode)
+        if self.grad_compression is not None:
+            raise ValueError(
+                "set_compress does not apply to the legacy "
+                "grad_compression hook — construct with compress= instead"
+            )
+        if mode == self.compress:
+            return False
+        self._mode_programs[self.compress] = (
+            self._train_step,
+            self._train_steps_cache,
+            self._first_dispatch_noted,
+        )
+        self.compress = mode
+        parked = self._mode_programs.get(mode)
+        if parked is not None:
+            (
+                self._train_step,
+                self._train_steps_cache,
+                self._first_dispatch_noted,
+            ) = parked
+        else:
+            from tpu_syncbn.parallel import scan_driver
+
+            self._train_step = self._build_train_step(self._donate)
+            self._train_steps_cache = scan_driver.ProgramCache(name="train")
+            self._first_dispatch_noted = False
+        self.reset_compression_residual()
         return True
 
     def train_step(self, batch) -> StepOutput:

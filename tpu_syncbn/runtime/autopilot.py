@@ -1,0 +1,511 @@
+"""Closed-loop autopilot: the observability plane turns its own knobs.
+
+PRs 11–14 made degradation *visible* — numerics drift (EF residual
+ratio, clip saturation, BN mean skew), memory watermarks vs the pinned
+contract, recompile storms, explained step time — but every monitor was
+read-only: a human translated alerts into knob turns. The
+:class:`Autopilot` closes that loop in the dynamic-quantization stance
+of EQuARX (PAPERS.md, arXiv:2506.17615) and with the GSPMD line's
+contract-costed-candidate discipline (arXiv:2004.13336): it consumes
+the live signals the stack already publishes and actuates, **only at
+fused-chunk boundaries and only within pre-audited bounds**, the knobs
+the stack already exposes:
+
+* **compression precision** — escalate int8 → bf16 → fp32 when the
+  ``numerics_rules()`` SLOs burn (quantization drowning the signal),
+  de-escalate one rung at a time after a sustained-healthy hysteresis
+  window (:meth:`DataParallel.set_compress` — the EF residual rides
+  opt_state with a *fixed* pytree structure across every rung, and each
+  rung's programs are parked/recalled, never recompiled);
+* **scan chunk length K** — raise it while the windowed attribution
+  says host-gap/dispatch overhead dominates and ``mem.headroom_frac``
+  allows; lower it when ``mem_pressure`` fires (the loop's per-chunk
+  watchdog deadline follows the live K);
+* **program-cache byte budgets** — shrink under memory pressure,
+  regrow after the healthy window
+  (:meth:`~tpu_syncbn.parallel.scan_driver.ProgramCache.set_max_bytes`).
+
+Safety is the existing machinery, by construction:
+
+* every selectable (compress-mode, K) variant is golden-pinned up
+  front (``python -m tpu_syncbn.audit`` — the ``autopilot.*`` program
+  contracts), so the controller can only move between
+  contract-verified programs, and the recompile-storm detector proves
+  mode flapping compiles nothing new;
+* every decision — actuations, but also **clamped** attempts (the
+  policy wanted to leave the candidate set) and **suppressed** ones
+  (cooldown, divergence recovery in flight) — lands in the flight
+  recorder's ``autopilot`` ring with the triggering signal and window
+  quoted, and every actuation additionally fires the ``autopilot``
+  incident-bundle trigger;
+* the divergence guard + ``restore_last_good`` bound the blast radius
+  of a bad policy step: :meth:`on_chunk` suppresses all actuation
+  while the loop is recovering, and both rollback and mode switches
+  zero the EF residual so stale wire-format error never replays.
+
+Telemetry (all under the ``autopilot.`` family —
+docs/OBSERVABILITY.md "Autopilot"): ``autopilot.actuations`` /
+``autopilot.suppressed`` / ``autopilot.clamped`` counters, per-knob
+state gauges ``autopilot.compress_rung`` / ``autopilot.scan_k`` /
+``autopilot.cache_max_bytes``, and the ``autopilot.decision_s``
+histogram (policy-evaluation cost per chunk boundary).
+
+Clocks are injectable (``now=``) and the SLO tracker is evaluated with
+the same timestamp, so the whole state machine is deterministic under
+test.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Callable, Sequence
+
+from tpu_syncbn.obs import flightrec, slo, telemetry, tracing
+
+#: The compression ladder, most- to least-compressed. ``escalate``
+#: moves right (toward the exact fp32 wire — the tentpole's
+#: "int8 → bf16 → fp32"), ``deescalate`` moves left. Construct the
+#: trainer at the leftmost rung you include so the EF residual exists
+#: on every rung (opt_state structure is fixed at construction).
+COMPRESS_LADDER = ("int8", "bf16", "none")
+
+#: Default SLO families the autopilot watches. Serving-side families
+#: exist (:func:`tpu_syncbn.obs.slo.standard_rules`) but none of the
+#: training knobs answers to them.
+DEFAULT_RULE_FAMILIES = ("numerics", "mem", "compile")
+
+_COMPRESS_KNOB = "compress"
+_K_KNOB = "scan_k"
+_CACHE_KNOB = "cache_bytes"
+
+
+def _dispatch_seconds(snap: dict) -> float:
+    """Summed in-dispatch seconds in a windowed snapshot — the same
+    histogram families the incident attribution report counts as
+    device-bound step time."""
+    from tpu_syncbn.obs import incident
+
+    hists = snap.get("histograms", {})
+    return sum(
+        hists[name]["sum"] for name in incident._DISPATCH_HISTS
+        if name in hists
+    )
+
+
+def chunked_batches(batches, autopilot: "Autopilot"):
+    """Adapt a per-STEP batch stream into K-stacked chunks whose K is
+    the autopilot's live ``scan_k``, re-read at every chunk boundary —
+    the data-side half of the K actuator (the trainer side needs
+    nothing: ``train_steps_batches`` keys its scan cache by K, so every
+    candidate's program is retained once compiled). The tail chunk is
+    emitted at whatever length remains."""
+    from tpu_syncbn.parallel import scan_driver
+
+    it = iter(batches)
+    while True:
+        k = max(1, int(autopilot.scan_k))
+        chunk = list(itertools.islice(it, k))
+        if not chunk:
+            return
+        yield scan_driver.stack_batches(chunk)
+
+
+class Autopilot:
+    """The policy engine. One instance per training process; drive
+    :meth:`on_chunk` at every fused-chunk boundary
+    (``ResilientLoop(autopilot=...)`` does).
+
+    ``trainer`` needs the :class:`~tpu_syncbn.parallel.trainer.DataParallel`
+    knob surface (``compress``, ``set_compress``, ``program_caches``);
+    pass ``None`` to run the compression knob open-loop (decisions are
+    still recorded — a shadow-mode dry run). ``aggregator`` is the
+    :class:`~tpu_syncbn.obs.timeseries.WindowedAggregator` the signals
+    live in; ``rules`` defaults to
+    ``slo.standard_rules(DEFAULT_RULE_FAMILIES)``.
+
+    Knob bounds — the pre-audited candidate sets:
+
+    * ``modes`` — orderable subset of :data:`COMPRESS_LADDER`
+      (ladder order enforced); a burn at the top rung is *clamped*,
+      counted, never an error;
+    * ``k_candidates`` — ascending scan-K set; empty disables the K
+      knob. ``set_scan_k`` is the actuation callback (the loop wires
+      its chunk source through it);
+    * ``cache_bytes_bounds`` — ``(floor, ceiling)`` for every cache in
+      ``trainer.program_caches`` (plus ``extra_caches``); ``None``
+      disables the knob.
+
+    Policy timing: ``window_s`` is the evaluation window (signals are
+    read over it; at most one actuation per knob per window —
+    escalation latency is therefore bounded by one window), and
+    ``healthy_for_s`` the de-escalation/regrow hysteresis (that long
+    with no burn on the relevant family, measured from the *last* burn
+    or actuation, whichever is later — a controller that just moved
+    must re-observe before moving back, which is what prevents
+    flapping)."""
+
+    def __init__(
+        self,
+        trainer=None,
+        *,
+        aggregator,
+        rules: Sequence | None = None,
+        modes: Sequence[str] | None = None,
+        k_candidates: Sequence[int] = (),
+        set_scan_k: Callable[[int], None] | None = None,
+        initial_k: int | None = None,
+        cache_bytes_bounds: tuple[int, int] | None = None,
+        extra_caches: Sequence = (),
+        window_s: float = 60.0,
+        healthy_for_s: float = 300.0,
+        host_gap_threshold: float = 0.3,
+        headroom_min: float = 0.25,
+        now=time.monotonic,
+    ):
+        if modes is None:
+            modes = COMPRESS_LADDER if trainer is None else tuple(
+                m for m in COMPRESS_LADDER
+                if COMPRESS_LADDER.index(m)
+                >= COMPRESS_LADDER.index(trainer.compress)
+            )
+        modes = tuple(modes)
+        unknown = [m for m in modes if m not in COMPRESS_LADDER]
+        if unknown:
+            raise ValueError(
+                f"modes {unknown} not in the audited ladder "
+                f"{COMPRESS_LADDER}"
+            )
+        if list(modes) != sorted(modes, key=COMPRESS_LADDER.index):
+            raise ValueError(
+                f"modes must follow ladder order {COMPRESS_LADDER}, "
+                f"got {modes}"
+            )
+        if not modes:
+            raise ValueError("modes must name at least one rung")
+        if trainer is not None and trainer.compress not in modes:
+            raise ValueError(
+                f"trainer is at {trainer.compress!r}, outside the "
+                f"candidate set {modes}"
+            )
+        ks = tuple(int(k) for k in k_candidates)
+        if list(ks) != sorted(set(ks)) or any(k < 1 for k in ks):
+            raise ValueError(
+                f"k_candidates must be ascending positive ints, got "
+                f"{k_candidates}"
+            )
+        if cache_bytes_bounds is not None:
+            floor, ceiling = cache_bytes_bounds
+            if not 1 <= floor <= ceiling:
+                raise ValueError(
+                    f"cache_bytes_bounds needs 1 <= floor <= ceiling, "
+                    f"got {cache_bytes_bounds}"
+                )
+        if window_s <= 0 or healthy_for_s <= 0:
+            raise ValueError(
+                "window_s and healthy_for_s must be > 0, got "
+                f"{window_s}/{healthy_for_s}"
+            )
+        self.trainer = trainer
+        self.aggregator = aggregator
+        self.tracker = slo.SLOTracker(
+            aggregator,
+            list(rules) if rules is not None
+            else slo.standard_rules(DEFAULT_RULE_FAMILIES),
+        )
+        self.modes = modes
+        self.k_candidates = ks
+        self._set_scan_k = set_scan_k
+        self.cache_bytes_bounds = cache_bytes_bounds
+        self.extra_caches = tuple(extra_caches)
+        self.window_s = float(window_s)
+        self.healthy_for_s = float(healthy_for_s)
+        self.host_gap_threshold = float(host_gap_threshold)
+        self.headroom_min = float(headroom_min)
+        self._now = now
+        self.counters = telemetry.CounterGroup(prefix="autopilot")
+        # knob state
+        self.compress_rung = (
+            modes.index(trainer.compress) if trainer is not None else 0
+        )
+        if initial_k is None:
+            initial_k = ks[0] if ks else 1
+        if ks and initial_k not in ks:
+            raise ValueError(
+                f"initial_k {initial_k} not in k_candidates {ks}"
+            )
+        self.scan_k = int(initial_k)
+        # per-knob last-actuation clocks (None = never): hysteresis
+        # anchors — only real knob turns move them
+        self._last_actuation: dict[str, float | None] = {
+            _COMPRESS_KNOB: None, _K_KNOB: None, _CACHE_KNOB: None,
+        }
+        # per-knob last-decision clocks: the cooldown — clamps count
+        # too, so a sustained burn at a bound writes one ring entry per
+        # window, not one per chunk
+        self._last_decision_t: dict[str, float | None] = {
+            _COMPRESS_KNOB: None, _K_KNOB: None, _CACHE_KNOB: None,
+        }
+        # last time the knob's driving family burned (None = never seen
+        # burning — de-escalation then keys off the first chunk's clock)
+        self._last_numerics_burn: float | None = None
+        self._last_mem_burn: float | None = None
+        self._first_chunk_t: float | None = None
+        self.last_decision: dict | None = None
+        self.chunks = 0
+        self._export_gauges()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _caches(self) -> tuple:
+        trainer_caches = (
+            tuple(self.trainer.program_caches)
+            if self.trainer is not None
+            and hasattr(self.trainer, "program_caches") else ()
+        )
+        return trainer_caches + self.extra_caches
+
+    def _cache_budget(self) -> int | None:
+        """Current per-cache budget: the max over live budgets (they
+        move in lockstep), or the ceiling when none is set yet."""
+        if self.cache_bytes_bounds is None:
+            return None
+        budgets = [
+            c.max_bytes for c in self._caches() if c.max_bytes is not None
+        ]
+        return max(budgets) if budgets else self.cache_bytes_bounds[1]
+
+    def _healthy_since(self, knob: str, last_burn: float | None,
+                       now: float) -> bool:
+        """Sustained-healthy hysteresis: ``healthy_for_s`` elapsed since
+        the later of (last burn on the driving family, this knob's last
+        actuation, the first observed chunk)."""
+        anchors = [
+            t for t in (last_burn, self._last_actuation[knob],
+                        self._first_chunk_t)
+            if t is not None
+        ]
+        if not anchors:
+            return False
+        return now - max(anchors) >= self.healthy_for_s
+
+    def _in_cooldown(self, knob: str, now: float) -> bool:
+        last = self._last_decision_t[knob]
+        return last is not None and now - last < self.window_s
+
+    def _record(self, decision: dict, now: float) -> dict:
+        """Every decision — actuation, clamp, or suppression — lands in
+        the ring; actuations also fire the incident trigger (the
+        recorder's cooldown bounds bundle frequency, the ring does not
+        drop anything). Returns the enriched decision (t_mono, chunk)
+        — what callers hand back from :meth:`on_chunk`."""
+        decision = dict(decision, t_mono=round(now, 6),
+                        chunk=self.chunks)
+        self.last_decision = decision
+        flightrec.record_autopilot(**decision)
+        tracing.instant("autopilot", **{
+            k: v for k, v in decision.items()
+            if isinstance(v, (str, int, float, bool))
+        })
+        action = decision["action"]
+        knob = decision["knob"]
+        if action == "clamp":
+            self.counters.bump("clamped")
+            self._last_decision_t[knob] = now
+        elif action == "suppress":
+            self.counters.bump("suppressed")
+        else:
+            self.counters.bump("actuations")
+            self._last_actuation[knob] = now
+            self._last_decision_t[knob] = now
+            flightrec.trigger("autopilot", decision)
+        return decision
+
+    def _export_gauges(self) -> None:
+        telemetry.set_gauge("autopilot.compress_rung", self.compress_rung)
+        telemetry.set_gauge("autopilot.scan_k", self.scan_k)
+        budget = self._cache_budget()
+        if budget is not None:
+            telemetry.set_gauge("autopilot.cache_max_bytes", budget)
+
+    @staticmethod
+    def _quote(state: dict, rule: str) -> dict:
+        """The triggering signal's evidence, quoted into the decision:
+        rule name plus its per-window burn rates."""
+        burns = state.get(rule, {}).get("burns", {})
+        return {str(w): (round(b, 4) if b is not None else None)
+                for w, b in burns.items()}
+
+    # -- the policy step ---------------------------------------------------
+
+    def on_chunk(self, *, step: int | None = None, k: int | None = None,
+                 recovering: bool = False) -> list[dict]:
+        """One policy evaluation at a fused-chunk boundary; returns the
+        decisions made (possibly empty). ``recovering=True`` (a
+        divergence rollback is being re-validated) suppresses all
+        actuation — the guard owns the process until the probation
+        window passes."""
+        t0 = time.perf_counter()
+        now = self._now()
+        self.chunks += 1
+        if self._first_chunk_t is None:
+            self._first_chunk_t = now
+        decisions: list[dict] = []
+        if recovering:
+            d = self._record({"knob": "all", "action": "suppress",
+                              "signal": "divergence_recovery",
+                              "step": step}, now)
+            decisions.append(d)
+            telemetry.observe("autopilot.decision_s",
+                              time.perf_counter() - t0)
+            return decisions
+        state = self.tracker.evaluate(now=now)
+        snap = self.aggregator.windowed_snapshot(self.window_s, now=now)
+        numerics_firing = [
+            r for r in state
+            if r.startswith("numerics") and state[r]["firing"]
+        ]
+        mem_firing = state.get("mem_pressure", {}).get("firing", False)
+        if numerics_firing:
+            self._last_numerics_burn = now
+        if mem_firing:
+            self._last_mem_burn = now
+        decisions += self._compress_policy(state, numerics_firing, now,
+                                           step)
+        decisions += self._k_policy(state, snap, mem_firing, now, step)
+        decisions += self._cache_policy(state, mem_firing, now, step)
+        self._export_gauges()
+        telemetry.observe("autopilot.decision_s",
+                          time.perf_counter() - t0)
+        return decisions
+
+    # -- knob policies -----------------------------------------------------
+
+    def _compress_policy(self, state, numerics_firing, now, step):
+        if len(self.modes) < 2:
+            return []
+        if self._in_cooldown(_COMPRESS_KNOB, now):
+            return []
+        base = {"knob": _COMPRESS_KNOB, "step": step,
+                "window_s": self.window_s}
+        if numerics_firing:
+            signal = numerics_firing[0]
+            base.update(signal=signal,
+                        burns=self._quote(state, signal))
+            if self.compress_rung + 1 < len(self.modes):
+                frm = self.modes[self.compress_rung]
+                self.compress_rung += 1
+                to = self.modes[self.compress_rung]
+                if self.trainer is not None:
+                    self.trainer.set_compress(to)
+                d = dict(base, action="escalate", frm=frm, to=to)
+            else:
+                # burning at the least-compressed rung: nowhere to go
+                d = dict(base, action="clamp",
+                         frm=self.modes[self.compress_rung])
+            return [self._record(d, now)]
+        if (self.compress_rung > 0
+                and self._healthy_since(_COMPRESS_KNOB,
+                                        self._last_numerics_burn, now)):
+            frm = self.modes[self.compress_rung]
+            self.compress_rung -= 1
+            to = self.modes[self.compress_rung]
+            if self.trainer is not None:
+                self.trainer.set_compress(to)
+            d = dict(base, action="deescalate", frm=frm, to=to,
+                     signal="numerics_healthy",
+                     healthy_for_s=self.healthy_for_s)
+            return [self._record(d, now)]
+        return []
+
+    def _k_policy(self, state, snap, mem_firing, now, step):
+        if not self.k_candidates or len(self.k_candidates) < 2:
+            return []
+        if self._in_cooldown(_K_KNOB, now):
+            return []
+        base = {"knob": _K_KNOB, "step": step, "window_s": self.window_s}
+        idx = self.k_candidates.index(self.scan_k)
+        if mem_firing:
+            base.update(signal="mem_pressure",
+                        burns=self._quote(state, "mem_pressure"))
+            if idx > 0:
+                frm, self.scan_k = self.scan_k, self.k_candidates[idx - 1]
+                if self._set_scan_k is not None:
+                    self._set_scan_k(self.scan_k)
+                d = dict(base, action="lower", frm=frm, to=self.scan_k)
+            else:
+                d = dict(base, action="clamp", frm=self.scan_k)
+            return [self._record(d, now)]
+        covered = snap.get("window", {}).get("covered_s", 0.0)
+        if covered <= 0:
+            return []
+        host_gap = max(0.0, 1.0 - _dispatch_seconds(snap) / covered)
+        headroom = snap.get("gauges", {}).get("mem.headroom_frac")
+        if (host_gap > self.host_gap_threshold
+                and headroom is not None
+                and headroom > self.headroom_min
+                and self._healthy_since(_K_KNOB, self._last_mem_burn,
+                                        now)):
+            base.update(signal="host_gap",
+                        host_gap_frac=round(host_gap, 4),
+                        headroom_frac=round(headroom, 4))
+            if idx + 1 < len(self.k_candidates):
+                frm, self.scan_k = self.scan_k, self.k_candidates[idx + 1]
+                if self._set_scan_k is not None:
+                    self._set_scan_k(self.scan_k)
+                d = dict(base, action="raise", frm=frm, to=self.scan_k)
+            else:
+                d = dict(base, action="clamp", frm=self.scan_k)
+            return [self._record(d, now)]
+        return []
+
+    def _cache_policy(self, state, mem_firing, now, step):
+        if self.cache_bytes_bounds is None or not self._caches():
+            return []
+        if self._in_cooldown(_CACHE_KNOB, now):
+            return []
+        floor, ceiling = self.cache_bytes_bounds
+        budget = self._cache_budget()
+        base = {"knob": _CACHE_KNOB, "step": step,
+                "window_s": self.window_s}
+        if mem_firing:
+            base.update(signal="mem_pressure",
+                        burns=self._quote(state, "mem_pressure"))
+            if budget > floor:
+                new = max(floor, budget // 2)
+                for c in self._caches():
+                    c.set_max_bytes(new)
+                d = dict(base, action="shrink", frm=budget, to=new)
+            else:
+                d = dict(base, action="clamp", frm=budget)
+            return [self._record(d, now)]
+        if (budget < ceiling
+                and self._healthy_since(_CACHE_KNOB, self._last_mem_burn,
+                                        now)):
+            new = min(ceiling, budget * 2)
+            for c in self._caches():
+                c.set_max_bytes(new)
+            d = dict(base, action="grow", frm=budget, to=new,
+                     signal="mem_healthy",
+                     healthy_for_s=self.healthy_for_s)
+            return [self._record(d, now)]
+        return []
+
+    # -- introspection -----------------------------------------------------
+
+    def state(self) -> dict:
+        """JSON-ready controller state (what a /statusz section or a
+        test asserts on)."""
+        return {
+            "compress": self.modes[self.compress_rung],
+            "compress_rung": self.compress_rung,
+            "modes": list(self.modes),
+            "scan_k": self.scan_k,
+            "k_candidates": list(self.k_candidates),
+            "cache_max_bytes": self._cache_budget(),
+            "chunks": self.chunks,
+            "actuations": self.counters.count("actuations"),
+            "clamped": self.counters.count("clamped"),
+            "suppressed": self.counters.count("suppressed"),
+            "last_decision": self.last_decision,
+        }

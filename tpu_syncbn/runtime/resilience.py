@@ -497,6 +497,7 @@ class ResilientLoop:
         publish_dir: str | None = None,
         publish_every: int | None = None,
         publish_keep: int = 3,
+        autopilot=None,
     ):
         """``scan_steps=K > 1`` drives the fused multi-step path
         (docs/PERFORMANCE.md): ``batches`` must then yield K-stacked
@@ -527,7 +528,17 @@ class ResilientLoop:
         cross-process path is host serialization by nature; the
         no-host-gather on-mesh path is the *in-process*
         ``swap_from_trainer``). Publications follow the checkpoint
-        transport: async when ``async_checkpoint=True``."""
+        transport: async when ``async_checkpoint=True``.
+
+        ``autopilot`` attaches a
+        :class:`~tpu_syncbn.runtime.autopilot.Autopilot`: the loop
+        drives its :meth:`~tpu_syncbn.runtime.autopilot.Autopilot.on_chunk`
+        at every chunk boundary (suppressed, and recorded as
+        suppressed, while a divergence rollback is recovering), mirrors
+        its live ``scan_k`` into ``self.scan_steps``, and rescales the
+        watchdog deadline to the live K. Feed the loop through
+        :func:`~tpu_syncbn.runtime.autopilot.chunked_batches` so the
+        data side follows the same K."""
         if ckpt_every < 1:
             raise ValueError(f"ckpt_every must be >= 1, got {ckpt_every}")
         if scan_steps < 1:
@@ -543,6 +554,7 @@ class ResilientLoop:
         self.max_restores = max_restores
         self.step_deadline_s = step_deadline_s
         self.scan_steps = scan_steps
+        self.autopilot = autopilot
         self.publish_dir = publish_dir
         self.publish_every = (
             int(publish_every) if publish_every is not None else ckpt_every
@@ -776,7 +788,12 @@ class ResilientLoop:
                     # legitimately dwarfs the steady-state deadline.
                     # Chunked mode pats once per K-step chunk, so the
                     # per-STEP deadline the caller configured scales by K
-                    # — a healthy chunk must not read as a stall.
+                    # — a healthy chunk must not read as a stall. The
+                    # deadline is recomputed from the LIVE K at every
+                    # chunk boundary below: a mid-run K change (the
+                    # autopilot's actuator, or manual retuning of
+                    # self.scan_steps) must not leave a stale stall
+                    # threshold.
                     watchdog = stack.enter_context(
                         Watchdog(self.step_deadline_s * self.scan_steps,
                                  name="train-step", start_armed=False)
@@ -848,6 +865,14 @@ class ResilientLoop:
                                         "refusing to thrash"
                                     )
                                 self._restore_last_good()
+                                if self.autopilot is not None:
+                                    # the guard owns the process during
+                                    # a rollback: the policy step is
+                                    # suppressed (and recorded as such)
+                                    self.autopilot.on_chunk(
+                                        step=self.step, k=k,
+                                        recovering=True,
+                                    )
                                 if guard.preempted:
                                     # the restored state IS the last durable
                                     # checkpoint — exit now rather than burn
@@ -860,6 +885,28 @@ class ResilientLoop:
                                     )
                                     break
                                 continue
+                    if self.autopilot is not None:
+                        # chunk-boundary policy step: the only place
+                        # knobs turn. The loop mirrors the live K so
+                        # max_steps/watchdog accounting follows the
+                        # controller; the data side follows through
+                        # autopilot.chunked_batches.
+                        self.autopilot.on_chunk(
+                            step=self.step, k=k,
+                            recovering=self.recovering,
+                        )
+                        if scanned:
+                            self.scan_steps = max(
+                                1, int(self.autopilot.scan_k)
+                            )
+                    if (watchdog is not None
+                            and self.step_deadline_s is not None):
+                        # stale-deadline fix: recompute per chunk from
+                        # the current K instead of trusting the value
+                        # captured at construction
+                        watchdog.deadline_s = (
+                            self.step_deadline_s * max(1, self.scan_steps)
+                        )
                     if guard.preempted:
                         self.save()
                         preempted = True
@@ -899,8 +946,11 @@ class ResilientLoop:
         finally:
             # the hook must not outlive the loop run: a probe hitting a
             # finished (or crashed) loop should see "no train check",
-            # not a stale ready/not-ready claim
+            # not a stale ready/not-ready claim — and the same for the
+            # step heartbeat, which would otherwise read as a stale
+            # liveness source and 503 every later /healthz probe
             obs_server.unregister_readiness("train")
+            obs_server.HEARTBEATS.clear("train")
             self._guard = None
             try:
                 # non-blocking tail drain: publish whatever settled. A
