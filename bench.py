@@ -629,6 +629,13 @@ def measure_serve(dp, batch, *, n_chips: int) -> dict:
     except Exception as e:  # null only this section, keep the rest
         log(f"serve publish measurement failed: {type(e).__name__}: {e}")
         publish = None
+    try:
+        tenancy = measure_serve_tenancy(
+            engine, x, gb=gb, max_batch=max_batch, max_wait_ms=max_wait_ms,
+        )
+    except Exception as e:  # null only this section, keep the rest
+        log(f"serve tenancy measurement failed: {type(e).__name__}: {e}")
+        tenancy = None
     stats = engine.stats()
     return {
         "buckets": stats["buckets"],
@@ -648,6 +655,7 @@ def measure_serve(dp, batch, *, n_chips: int) -> dict:
         "drained": bat.drained,
         "open_loop": open_loop,
         "publish": publish,
+        "tenancy": tenancy,
     }
 
 
@@ -802,6 +810,164 @@ def measure_serve_publish(
         "double_buffer_bounded": bounded,
         "rollback_s": round(rollback_s, 6),
         "rollback_bit_identical": rollback_bit_identical,
+    }
+
+
+def measure_serve_tenancy(
+    engine, x, *, gb: int, max_batch: int, max_wait_ms: float,
+) -> dict:
+    """The ``tenancy`` section of the serve block (ISSUE 18): the
+    per-tenant SLO isolation drill on labeled metrics
+    (docs/OBSERVABILITY.md "Labels & cardinality").
+
+    Two tenants share the warmed engine through separate batchers
+    publishing ``tenant``-labeled series: ``aggressive`` carries an
+    unmeetable per-request deadline (every admitted request becomes a
+    ``serve.deadline_miss_total{tenant="aggressive"}`` event — shed by
+    predicted-completion admission or counted at completion), ``steady``
+    a generous one (zero misses). Both tenants get the IDENTICAL
+    :class:`~tpu_syncbn.obs.slo.SubsetRate` rule over their own labeled
+    ``deadline_miss_total / requests`` pair, so the asymmetry in the
+    outcome is carried entirely by the label dimension: the aggressive
+    tenant's burn must exceed the firing threshold while the steady
+    tenant's identical rule stays quiet (``isolation_ok``), and the
+    fired alert's incident bundle must carry the labeled series
+    (``alert_bundle.labeled_series``). Burn anchors:
+    ``serve.tenancy.{aggressive,steady}_burn`` in BASELINE.json. Split
+    out so a failure nulls only this section. Schema pinned by
+    tests/test_bench_tooling.py."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from tpu_syncbn import serve as serve_lib
+    from tpu_syncbn.obs import (
+        flightrec, incident as incident_mod, slo as obs_slo, telemetry,
+        timeseries,
+    )
+
+    deadline_ms = {"aggressive": 0.05, "steady": 60000.0}
+    miss_target = 0.9  # budget 0.1: a 100% miss rate burns at 10x
+    burn_threshold = 2.0
+    clients, per_client = 2, 6
+
+    agg = timeseries.WindowedAggregator(interval_s=0.25)
+    agg.tick()  # baseline frame: deltas start at this run's counts
+    tracker = obs_slo.SLOTracker(agg, [
+        obs_slo.AlertRule(
+            f"tenant_{t}",
+            obs_slo.SubsetRate(
+                total=telemetry.labeled_name("serve.requests",
+                                             {"tenant": t}),
+                bad=telemetry.labeled_name("serve.deadline_miss_total",
+                                           {"tenant": t}),
+                target=miss_target,
+            ),
+            windows_s=(60.0,), burn_threshold=burn_threshold,
+        )
+        for t in ("aggressive", "steady")
+    ])
+
+    # a fresh recorder sharing this aggregator catches the fired alert:
+    # the bundle is the proof the labeled series travel with incidents
+    bundle_dir = tempfile.mkdtemp(prefix="bench_tenancy_")
+    prev_rec = flightrec.get()
+    rec = flightrec.FlightRecorder(aggregator=agg, incident_dir=bundle_dir,
+                                   cooldown_s=0.0)
+    flightrec.install(rec)
+    try:
+        tenants_out = {}
+        for tenant in ("aggressive", "steady"):
+            bat = serve_lib.DynamicBatcher(
+                engine, max_batch=max_batch, max_wait_ms=max_wait_ms,
+                max_queue=4 * max_batch, deadline_ms=deadline_ms[tenant],
+                tenant=tenant,
+            )
+
+            def client(cid, batcher=bat):
+                rng = np.random.RandomState(cid)
+                for _ in range(per_client):
+                    i = int(rng.randint(0, gb))
+                    try:
+                        batcher.submit(x[i:i + 1]).result(timeout=600)
+                    except serve_lib.RejectedError:
+                        continue  # shed/deadline-missed — counted
+
+            threads = [threading.Thread(target=client, args=(c,),
+                                        daemon=True)
+                       for c in range(clients)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            bat.close(drain=True)
+            agg.tick()  # land this tenant's deltas in a windowed frame
+            requests = bat.counters.count("requests")
+            misses = bat.counters.count("deadline_miss_total")
+            p50 = agg.quantile(
+                telemetry.labeled_name("serve.latency_s",
+                                       {"tenant": tenant}), 0.5)
+            p99 = agg.quantile(
+                telemetry.labeled_name("serve.latency_s",
+                                       {"tenant": tenant}), 0.99)
+            tenants_out[tenant] = {
+                "requests": requests,
+                "deadline_misses": misses,
+                "miss_fraction": (round(misses / requests, 4)
+                                  if requests else None),
+                "latency_p50_ms": (round(p50 * 1e3, 3)
+                                   if p50 is not None else None),
+                "latency_p99_ms": (round(p99 * 1e3, 3)
+                                   if p99 is not None else None),
+            }
+
+        state = tracker.evaluate()
+        for tenant in ("aggressive", "steady"):
+            st = state[f"tenant_{tenant}"]
+            burns = [b for b in st["burns"].values() if b is not None]
+            tenants_out[tenant]["burn_rate"] = (round(max(burns), 4)
+                                                if burns else None)
+            tenants_out[tenant]["firing"] = bool(st["firing"])
+            log(f"serve tenancy {tenant}: "
+                f"{tenants_out[tenant]['deadline_misses']}/"
+                f"{tenants_out[tenant]['requests']} deadline misses, "
+                f"burn {tenants_out[tenant]['burn_rate']}, "
+                f"firing={tenants_out[tenant]['firing']}")
+
+        alert_bundle = None
+        if rec.last_incident is not None:
+            bundle = incident_mod.load_bundle(rec.last_incident["path"])
+            labeled = [
+                name
+                for kind in ("counters", "gauges", "histograms")
+                for name in bundle["registry"].get(kind, {})
+                if '{' in name and 'tenant="' in name
+            ]
+            alert_bundle = {
+                "incident_id": bundle["incident_id"],
+                "trigger": bundle["trigger"]["kind"],
+                "labeled_series": len(labeled),
+            }
+    finally:
+        if prev_rec is not None:
+            flightrec.install(prev_rec)
+        else:
+            flightrec.uninstall()
+        agg.close()
+
+    return {
+        "deadline_ms": deadline_ms,
+        "miss_target": miss_target,
+        "burn_threshold": burn_threshold,
+        "tenants": tenants_out,
+        "aggressive_burn": tenants_out["aggressive"]["burn_rate"],
+        "steady_burn": tenants_out["steady"]["burn_rate"],
+        "isolation_ok": bool(
+            tenants_out["aggressive"]["firing"]
+            and not tenants_out["steady"]["firing"]
+        ),
+        "alert_bundle": alert_bundle,
     }
 
 
@@ -1885,15 +2051,42 @@ def check_regression(
 
 def _resolve_metric(line: dict, key: str):
     """``key`` is the headline metric name or a dotted path into the
-    bench line (``serve.latency_p99_ms``, ``monitor.metrics_fetch_s``)."""
+    bench line (``serve.latency_p99_ms``, ``monitor.metrics_fetch_s``).
+
+    Dots split path components only OUTSIDE a ``{...}`` label selector,
+    and at each level the longest dotted join is tried first — so a
+    path component that is itself a dotted (possibly labeled) metric
+    name resolves: ``telemetry.counters.serve.requests{tenant="a"}``
+    walks ``line["telemetry"]["counters"]['serve.requests{tenant="a"}']``."""
     if key == line.get("metric"):
         return line.get("value")
-    cur = line
-    for part in key.split("."):
-        if not isinstance(cur, dict) or part not in cur:
+    parts, buf, depth = [], [], 0
+    for ch in key:
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth = max(0, depth - 1)
+        if ch == "." and depth == 0:
+            parts.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+    parts.append("".join(buf))
+
+    def walk(cur, rest):
+        if not rest:
+            return cur
+        if not isinstance(cur, dict):
             return None
-        cur = cur[part]
-    return cur
+        for n in range(len(rest), 0, -1):
+            joined = ".".join(rest[:n])
+            if joined in cur:
+                got = walk(cur[joined], rest[n:])
+                if got is not None:
+                    return got
+        return None
+
+    return walk(line, parts)
 
 
 def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):

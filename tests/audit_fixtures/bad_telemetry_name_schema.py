@@ -19,3 +19,9 @@ def record(n):
     REGISTRY.counter("serve-errors")  # bad: dash not in schema
     CounterGroup(prefix="metricz")  # bad: unknown subsystem token
     return CounterGroup(prefix="serve.batcher")  # bad: prefix is one token
+
+
+def labeled(n):
+    telemetry.count("serve.requests", n, labels={"Tenant": "a"})  # bad: key schema
+    telemetry.count("serve.requests", n, labels={"zone": "us"})  # bad: key not in the vocabulary
+    telemetry.count("serve.requests", n, labels={"tenant": "a"})  # ok

@@ -26,12 +26,13 @@ RULE_FIXTURES = {
     "host_sync_in_step": ("bad_host_sync_in_step.py", 2),
     "donate_after_use": ("bad_donate_after_use.py", 2),
     "unlocked_shared_state": ("bad_unlocked_shared_state.py", 4),
-    "telemetry_name_schema": ("bad_telemetry_name_schema.py", 6),
+    "telemetry_name_schema": ("bad_telemetry_name_schema.py", 8),
     "unpaired_trace_span": ("bad_unpaired_trace_span.py", 3),
     "wallclock_duration": ("bad_wallclock_duration.py", 3),
     "unbounded_blocking": ("bad_unbounded_blocking.py", 5),
     "hardcoded_mesh_axis": ("bad_hardcoded_mesh_axis.py", 6),
     "lossy_default_mode": ("bad_lossy_default_mode.py", 4),
+    "unbounded_label_value": ("bad_unbounded_label_value.py", 5),
 }
 
 

@@ -976,7 +976,7 @@ class TestServeBlock:
             "levels", "clients", "requests", "rejected",
             "throughput_rps", "latency_p50_ms", "latency_p99_ms",
             "fill_ratio", "buckets_compiled", "drained", "open_loop",
-            "publish",
+            "publish", "tenancy",
         }
         assert isinstance(block["buckets"], list) and block["buckets"]
         assert all(isinstance(b, int) and b >= 1 for b in block["buckets"])
@@ -1054,6 +1054,37 @@ class TestServeBlock:
         # faster than any rebuild could (retained buffers, no compile)
         assert pub["rollback_s"] > 0
         assert pub["rollback_bit_identical"] is True
+        # ISSUE 18: the per-tenant SLO isolation drill on labeled
+        # metrics (null only if that sub-measurement failed — which is
+        # itself a failure here)
+        ten = block["tenancy"]
+        assert ten is not None
+        assert set(ten) == {
+            "deadline_ms", "miss_target", "burn_threshold", "tenants",
+            "aggressive_burn", "steady_burn", "isolation_ok",
+            "alert_bundle",
+        }
+        assert set(ten["tenants"]) == {"aggressive", "steady"}
+        for t in ("aggressive", "steady"):
+            assert set(ten["tenants"][t]) == {
+                "requests", "deadline_misses", "miss_fraction",
+                "latency_p50_ms", "latency_p99_ms", "burn_rate",
+                "firing",
+            }
+            assert ten["tenants"][t]["requests"] >= 1
+        # identical rules, asymmetric outcome — carried entirely by the
+        # tenant label: aggressive fires past the threshold, steady's
+        # twin rule stays quiet on the same evaluation pass
+        assert ten["aggressive_burn"] > ten["burn_threshold"]
+        assert ten["steady_burn"] is not None \
+            and ten["steady_burn"] <= ten["burn_threshold"]
+        assert ten["tenants"]["aggressive"]["firing"] is True
+        assert ten["tenants"]["steady"]["firing"] is False
+        assert ten["isolation_ok"] is True
+        # the fired alert's incident bundle carries the labeled series
+        assert ten["alert_bundle"] is not None
+        assert ten["alert_bundle"]["trigger"] == "slo_alert"
+        assert ten["alert_bundle"]["labeled_series"] >= 1
 
     def test_serve_flag_emits_block_and_line_stays_last(
         self, tmp_path, monkeypatch, capsys
@@ -1140,6 +1171,28 @@ class TestCheckRegression:
             "monitor.metrics_fetch_s": {"value": 0.005,
                                         "direction": "lower"},
         }) == []
+
+    def test_labeled_key_dotted_path_resolution(self, tmp_path):
+        """ISSUE 18: a published key may point at a LABELED series in
+        the telemetry block — the dots inside the ``{...}`` selector
+        are part of the dict key, not path separators, and a component
+        that is itself a dotted metric name resolves longest-first."""
+        bench = _load_bench()
+        line = dict(self.LINE)
+        line["telemetry"] = {"counters": {
+            'serve.requests{tenant="a"}': 50.0,
+            "serve.requests": 80.0,
+        }}
+        key = 'telemetry.counters.serve.requests{tenant="a"}'
+        assert bench._resolve_metric(line, key) == 50.0
+        assert bench._resolve_metric(
+            line, "telemetry.counters.serve.requests") == 80.0
+        # an anchor over the labeled series gates like any other
+        assert bench.check_regression(line, baseline_path=self._baseline(
+            tmp_path, {key: 50.0})) == []
+        fails = bench.check_regression(line, baseline_path=self._baseline(
+            tmp_path, {key: 200.0}))
+        assert len(fails) == 1 and "below the published" in fails[0]
 
     def test_per_entry_tolerance_overrides(self, tmp_path):
         published = {"resnet50_syncbn_dp_train_throughput": {

@@ -617,7 +617,10 @@ class TestStatusz:
                     "burns": {"60.0": 4.1},
                 }},
             },
-            "circuits": {"serve.circuit_state": 2.0},
+            # ISSUE 18 re-pin: breakers/caches key by family token (the
+            # default breaker is "serve"; labeled breakers key by their
+            # family label), not by raw gauge name
+            "circuits": {"serve": 0.0, "tenant_b": 2.0},
             "program_caches": {"serve": {"hits": 4, "misses": 2}},
             "publication": {
                 "serve.version.active": 7.0,
@@ -671,7 +674,8 @@ class TestStatusz:
             "burns {'60.0': 4.1})\n"
             "\n"
             "circuit breakers\n"
-            "  serve.circuit_state          open (2)\n"
+            "  serve                        closed (0)\n"
+            "  tenant_b                     open (2)\n"
             "\n"
             "program caches\n"
             "  serve    hits=4 misses=2\n"
@@ -814,7 +818,7 @@ class TestFlowEvents:
 _DYNAMIC_FAMILIES = (
     (r"^slo\.[a-z0-9_]+\.burn_rate$", "slo.<rule>.burn_rate"),
     (r"^serve\.circuit_state\.[a-z0-9_]+$", "serve.circuit_state.<key>"),
-    (r"^(train|gan|serve)\.program_cache\."
+    (r"^(train|gan|serve|scan)\.program_cache\."
      r"(hits|misses|evictions|bytes_live|live|fill_frac)$",
      ".program_cache."),
     (r"^audit\.rule\.[a-z0-9_.]+$", "audit.rule.<rule_id>"),
@@ -972,7 +976,9 @@ class TestMetricNameDrift:
     def test_produced_names_are_documented_and_lintable(self, tmp_path):
         import re
 
-        from tpu_syncbn.audit.srclint import KNOWN_METRIC_PREFIXES
+        from tpu_syncbn.audit.srclint import (
+            KNOWN_METRIC_PREFIXES, LABEL_KEYS,
+        )
 
         self._produce(tmp_path)
         snap = telemetry.snapshot()
@@ -981,29 +987,41 @@ class TestMetricNameDrift:
             | set(snap["histograms"])
         )
         assert len(names) >= 20  # the producers actually produced
+        # ISSUE 18: the producers actually publish labeled families
+        assert any("{" in n for n in names)
         docs = ""
         for doc in ("docs/OBSERVABILITY.md", "docs/RESILIENCE.md"):
             with open(os.path.join(ROOT, doc)) as f:
                 docs += f.read()
-        undocumented, unknown_prefix = [], []
+        undocumented, unknown_prefix, unknown_label_keys = [], [], []
         for name in names:
-            if name.split(".", 1)[0] not in KNOWN_METRIC_PREFIXES:
+            # a labeled series is gated on its FAMILY: the base name
+            # must be documented/lintable, and every label key must be
+            # in srclint's closed vocabulary
+            base, labels = telemetry.split_labels(name)
+            if labels and set(labels) - LABEL_KEYS:
+                unknown_label_keys.append(name)
+            if base.split(".", 1)[0] not in KNOWN_METRIC_PREFIXES:
                 unknown_prefix.append(name)
-            if name in docs:
+            if base in docs:
                 continue
-            if any(re.match(pat, name) and marker in docs
+            if any(re.match(pat, base) and marker in docs
                    for pat, marker in _DYNAMIC_FAMILIES):
                 continue
             # grouped table rows ("serve.requests / rejected / ..."):
             # the family prefix and the member token both appear
-            family, _, tail = name.rpartition(".")
-            if family and f"{name.split('.', 1)[0]}." in docs \
+            family, _, tail = base.rpartition(".")
+            if family and f"{base.split('.', 1)[0]}." in docs \
                     and tail in docs:
                 continue
             undocumented.append(name)
         assert not unknown_prefix, (
             f"metric prefixes missing from KNOWN_METRIC_PREFIXES: "
             f"{unknown_prefix}"
+        )
+        assert not unknown_label_keys, (
+            f"label keys outside srclint.LABEL_KEYS: {unknown_label_keys}"
+            " — extend the vocabulary deliberately"
         )
         assert not undocumented, (
             "metrics produced at runtime but absent from the docs "

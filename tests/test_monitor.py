@@ -161,6 +161,58 @@ class TestWindowedAggregator:
         # threshold beyond the last edge: unattributable -> not counted
         assert agg.fraction_above("step.time_s", 600.0, now=1.0) == 0.0
 
+    def test_selector_rate_sums_and_plain_name_stays_exact(self):
+        """ISSUE 18: a ``{...}`` selector sums matching labeled series;
+        the empty selector matches every labeled series of the family;
+        a PLAIN name stays an exact lookup — labeled children are never
+        silently folded into the unlabeled series."""
+        r, agg = self._setup()
+        agg.tick(now=0.0)
+        r.counter("serve.requests", labels={"tenant": "a"}).inc(10)
+        r.counter("serve.requests", labels={"tenant": "b"}).inc(30)
+        r.counter("serve.requests").inc(5)
+        agg.tick(now=1.0)
+        assert agg.rate('serve.requests{tenant="a"}', now=1.0) \
+            == pytest.approx(10.0)
+        assert agg.rate("serve.requests{}", now=1.0) == pytest.approx(40.0)
+        assert agg.rate("serve.requests", now=1.0) == pytest.approx(5.0)
+        assert agg.rate('serve.requests{tenant="nope"}', now=1.0) == 0.0
+
+    def test_selector_quantile_merges_matching_series(self):
+        r, agg = self._setup()
+        agg.tick(now=0.0)
+        ha = r.histogram("serve.latency_s", buckets=(0.1, 1.0),
+                         labels={"tenant": "a"})
+        hb = r.histogram("serve.latency_s", buckets=(0.1, 1.0),
+                         labels={"tenant": "b"})
+        for _ in range(10):
+            ha.observe(0.05)
+        for _ in range(10):
+            hb.observe(0.5)
+        agg.tick(now=1.0)
+        assert agg.quantile('serve.latency_s{tenant="a"}', 0.5, now=1.0) \
+            < 0.1
+        assert agg.quantile('serve.latency_s{tenant="b"}', 0.5, now=1.0) \
+            > 0.1
+        # merged across both tenants the p50 sits at the shared edge
+        assert agg.quantile("serve.latency_s{}", 0.5, now=1.0) \
+            == pytest.approx(0.1)
+        assert agg.fraction_above("serve.latency_s{}", 0.1, now=1.0) \
+            == pytest.approx(0.5)
+
+    def test_selector_bucket_mismatch_is_loud(self):
+        """Merging labeled histograms with drifted bucket boundaries
+        would be silently wrong — a selector query refuses instead."""
+        r, agg = self._setup()
+        agg.tick(now=0.0)
+        r.histogram("f.h", buckets=(0.1,),
+                    labels={"tenant": "a"}).observe(0.05)
+        r.histogram("f.h", buckets=(0.2,),
+                    labels={"tenant": "b"}).observe(0.05)
+        agg.tick(now=1.0)
+        with pytest.raises(ValueError, match="bucket"):
+            agg.quantile("f.h{}", 0.5, now=1.0)
+
     def test_ring_capacity_bounds_memory(self):
         r, agg = self._setup()  # capacity=4
         agg.tick(now=0.0)
@@ -265,6 +317,41 @@ class TestPrometheusExposition:
             'tpu_syncbn_serve_latency_s_bucket{le="+Inf"} 3\n'
             "tpu_syncbn_serve_latency_s_sum 5.1\n"
             "tpu_syncbn_serve_latency_s_count 3\n"
+        )
+
+    def test_render_labeled_golden(self):
+        """ISSUE 18: labeled series render as Prometheus 0.0.4
+        ``{label="value"}`` children of their family — ONE TYPE line
+        per family (sorting is by family, not raw name, so
+        ``serve.requests2`` cannot interleave), unlabeled series first,
+        histogram series labels precede ``le``, and values are escaped
+        (backslash, quote, newline)."""
+        r = telemetry.Registry()
+        r.counter("serve.requests").inc(3)
+        r.counter("serve.requests", labels={"tenant": "a"}).inc(2)
+        r.counter("serve.requests", labels={"tenant": 'we"ird\\x'}).inc(1)
+        r.counter("serve.requests2").inc(4)
+        r.gauge("serve.queue_depth", labels={"tenant": "a"}).set(2.5)
+        h = r.histogram("serve.latency_s", buckets=(0.1, 1.0),
+                        labels={"tenant": "a"})
+        h.observe(0.05)
+        h.observe(5.0)
+        text = obs_server.render_prometheus(r.snapshot())
+        assert text == (
+            "# TYPE tpu_syncbn_serve_requests_total counter\n"
+            "tpu_syncbn_serve_requests_total 3\n"
+            'tpu_syncbn_serve_requests_total{tenant="a"} 2\n'
+            'tpu_syncbn_serve_requests_total{tenant="we\\"ird\\\\x"} 1\n'
+            "# TYPE tpu_syncbn_serve_requests2_total counter\n"
+            "tpu_syncbn_serve_requests2_total 4\n"
+            "# TYPE tpu_syncbn_serve_queue_depth gauge\n"
+            'tpu_syncbn_serve_queue_depth{tenant="a"} 2.5\n'
+            "# TYPE tpu_syncbn_serve_latency_s histogram\n"
+            'tpu_syncbn_serve_latency_s_bucket{tenant="a",le="0.1"} 1\n'
+            'tpu_syncbn_serve_latency_s_bucket{tenant="a",le="1"} 1\n'
+            'tpu_syncbn_serve_latency_s_bucket{tenant="a",le="+Inf"} 2\n'
+            'tpu_syncbn_serve_latency_s_sum{tenant="a"} 5.05\n'
+            'tpu_syncbn_serve_latency_s_count{tenant="a"} 2\n'
         )
 
     def test_metrics_endpoint_serves_exposition(self):
@@ -701,6 +788,64 @@ class TestSLO:
                     "serve.latency_s < 0.25", ""):
             with pytest.raises(ValueError, match="objective"):
                 obs_slo.parse_objective(bad)
+
+    def test_objective_parser_selector(self):
+        """ISSUE 18: objectives bind label selectors — the metric
+        string carries the selector through fraction_above/rate
+        unchanged, and objective_labels() surfaces it for the labeled
+        burn-gauge twin."""
+        obj = obs_slo.parse_objective(
+            'serve.latency_s{tenant="a"} p99 < 0.25')
+        assert obj.metric == 'serve.latency_s{tenant="a"}'
+        assert obj.threshold == 0.25
+        assert obs_slo.objective_labels(obj) == {"tenant": "a"}
+        plain = obs_slo.parse_objective("serve.latency_s p99 < 0.25")
+        assert obs_slo.objective_labels(plain) is None
+        sub = obs_slo.SubsetRate(
+            total='serve.requests{tenant="b"}',
+            bad='serve.deadline_miss_total{tenant="b"}', target=0.9)
+        assert obs_slo.objective_labels(sub) == {"tenant": "b"}
+        # an empty/malformed selector is a typo, not "match everything"
+        for bad in ("serve.latency_s{} p99 < 0.25",
+                    "serve.latency_s{tenant} p99 < 0.25"):
+            with pytest.raises(ValueError, match="objective"):
+                obs_slo.parse_objective(bad)
+
+    def test_per_tenant_burn_isolation(self):
+        """The tentpole acceptance shape: two tenants, IDENTICAL rules
+        differing only in the label selector — the slow tenant's rule
+        fires while the fast tenant's stays quiet on the same
+        evaluation pass, and each publishes a labeled burn twin."""
+        telemetry.set_enabled(True)
+        r = telemetry.Registry()
+        agg = timeseries.WindowedAggregator(r, interval_s=1.0)
+        agg.tick(now=0.0)
+        ha = r.histogram("serve.latency_s", buckets=(0.05, 1.0),
+                         labels={"tenant": "a"})
+        hb = r.histogram("serve.latency_s", buckets=(0.05, 1.0),
+                         labels={"tenant": "b"})
+        for _ in range(90):
+            ha.observe(0.5)  # tenant a: 90% over threshold
+        for _ in range(10):
+            ha.observe(0.01)
+        for _ in range(100):
+            hb.observe(0.01)  # tenant b: all fast
+        agg.tick(now=1.0)
+        tracker = obs_slo.SLOTracker(agg, [
+            obs_slo.AlertRule(
+                f"lat_{t}", f'serve.latency_s{{tenant="{t}"}} p99 < 0.05',
+                windows_s=(2.0,), burn_threshold=2.0,
+            )
+            for t in ("a", "b")
+        ])
+        out = tracker.evaluate(now=1.0)
+        assert out["lat_a"]["firing"] is True
+        assert out["lat_b"]["firing"] is False
+        snap = telemetry.snapshot()
+        assert snap["gauges"]["slo.lat_a.burn_rate"] > 2.0
+        assert snap["gauges"]['slo.lat_a.burn_rate{tenant="a"}'] > 2.0
+        assert snap["gauges"]['slo.lat_b.burn_rate{tenant="b"}'] <= 2.0
+        assert snap["counters"]["obs.alert.fired"] == 1
 
     def test_latency_burn_fires_and_resolves_with_hysteresis(self):
         r, agg = self._hot_agg(frac_slow=0.1)  # 10% over a 1% budget

@@ -318,6 +318,142 @@ class TestRank0Merge:
         with pytest.raises(ValueError, match="bucket"):
             telemetry.merge_exports([p0, p1])
 
+    def test_merge_two_hosts_labeled(self, tmp_path):
+        """ISSUE 18: labeled series are ordinary registry names
+        (``family{k="v"}``), so the rank-0 export/merge path sums them
+        PER SERIES — tenant a's counts never bleed into tenant b's."""
+        r0, r1 = telemetry.Registry(), telemetry.Registry()
+        for r, a, b in ((r0, 10, 1), (r1, 12, 2)):
+            r.counter("serve.requests", labels={"tenant": "a"}).inc(a)
+            r.counter("serve.requests", labels={"tenant": "b"}).inc(b)
+            r.histogram("serve.latency_s",
+                        labels={"tenant": "a"}).observe(a / 10)
+        p0 = str(tmp_path / "host0.jsonl")
+        p1 = str(tmp_path / "host1.jsonl")
+        r0.export_jsonl(p0, host=0)
+        r1.export_jsonl(p1, host=1)
+        merged = telemetry.merge_exports([p0, p1])
+        assert merged["counters"]['serve.requests{tenant="a"}'] == 22
+        assert merged["counters"]['serve.requests{tenant="b"}'] == 3
+        h = merged["histograms"]['serve.latency_s{tenant="a"}']
+        assert h["count"] == 2 and h["min"] == 1.0 and h["max"] == 1.2
+        telemetry.validate_snapshot(merged)
+
+
+# ----------------------------------------------------------- labeled series
+
+
+class TestLabeledMetrics:
+    """ISSUE 18 tentpole: bounded-cardinality label sets on the same
+    instruments, encoded into registry names — the exporters, mergers,
+    and windowing above work on labeled series unchanged."""
+
+    def test_labeled_name_roundtrip_and_sorting(self):
+        n = telemetry.labeled_name("serve.requests",
+                                   {"tenant": "a", "model": "m1"})
+        assert n == 'serve.requests{model="m1",tenant="a"}'  # keys sorted
+        assert telemetry.split_labels(n) == (
+            "serve.requests", {"tenant": "a", "model": "m1"})
+        # plain names pass through: no selector, not an empty one
+        assert telemetry.split_labels("serve.requests") == (
+            "serve.requests", None)
+        assert telemetry.labeled_name("serve.requests", None) == \
+            "serve.requests"
+
+    def test_label_value_escaping_roundtrip(self):
+        raw = 'we"ird\\x\nnl'
+        n = telemetry.labeled_name("f.g", {"tenant": raw})
+        assert telemetry.split_labels(n)[1] == {"tenant": raw}
+
+    def test_bad_label_keys_and_family_rejected(self):
+        with pytest.raises(ValueError, match="label key"):
+            telemetry.labeled_name("f.g", {"Tenant": "a"})
+        with pytest.raises(ValueError, match="label key"):
+            telemetry.labeled_name("f.g", {"9oops": "a"})
+        with pytest.raises(ValueError):
+            telemetry.labeled_name('f.g{already="labeled"}', {"tenant": "a"})
+
+    def test_labeled_ops_create_distinct_series(self):
+        telemetry.set_enabled(True)
+        telemetry.count("serve.requests", 2)
+        telemetry.count("serve.requests", 5, labels={"tenant": "a"})
+        telemetry.count("serve.requests", 7, labels={"tenant": "b"})
+        telemetry.set_gauge("serve.queue_depth", 3, labels={"tenant": "a"})
+        telemetry.observe("serve.latency_s", 0.2, labels={"tenant": "a"})
+        snap = telemetry.snapshot()
+        assert snap["counters"]["serve.requests"] == 2
+        assert snap["counters"]['serve.requests{tenant="a"}'] == 5
+        assert snap["counters"]['serve.requests{tenant="b"}'] == 7
+        assert snap["gauges"]['serve.queue_depth{tenant="a"}'] == 3
+        assert snap["histograms"]['serve.latency_s{tenant="a"}']["count"] == 1
+        telemetry.validate_snapshot(snap)
+
+    def test_labels_match_selector_semantics(self):
+        assert telemetry.labels_match({"tenant": "a", "model": "m"},
+                                      {"tenant": "a"})
+        assert not telemetry.labels_match({"tenant": "b"}, {"tenant": "a"})
+        # a plain (unlabeled) series never matches a selector; the
+        # empty selector matches every LABELED series
+        assert not telemetry.labels_match(None, {"tenant": "a"})
+        assert not telemetry.labels_match(None, {})
+        assert telemetry.labels_match({"tenant": "b"}, {})
+
+    def test_cardinality_cap_overflows_into_other(self):
+        """Past the per-family cap, new combinations collapse
+        deterministically into the ``other`` series and each routed
+        call bumps ``telemetry.cardinality_dropped`` — an unbounded
+        label can cost at most cap+1 series, never registry blowup."""
+        telemetry.set_enabled(True)
+        r = telemetry.REGISTRY
+        r.set_label_cardinality("serve.requests", 2)
+        for i in range(10):
+            telemetry.count("serve.requests", 1,
+                            labels={"tenant": f"t{i}"})
+        snap = telemetry.snapshot()
+        # first-come-first-kept: t0, t1 admitted, the rest collapsed
+        assert snap["counters"]['serve.requests{tenant="t0"}'] == 1
+        assert snap["counters"]['serve.requests{tenant="t1"}'] == 1
+        assert snap["counters"]['serve.requests{tenant="other"}'] == 8
+        assert snap["counters"]["telemetry.cardinality_dropped"] == 8
+        assert not any('tenant="t5"' in k for k in snap["counters"])
+        # admitted combinations keep routing to their own series
+        telemetry.count("serve.requests", 1, labels={"tenant": "t1"})
+        assert telemetry.snapshot()["counters"][
+            'serve.requests{tenant="t1"}'] == 2
+
+    def test_cap_is_per_family(self):
+        telemetry.set_enabled(True)
+        telemetry.REGISTRY.set_label_cardinality("f.a", 1)
+        telemetry.count("f.a", labels={"tenant": "x"})
+        telemetry.count("f.a", labels={"tenant": "y"})  # over f.a's cap
+        telemetry.count("f.b", labels={"tenant": "y"})  # f.b unaffected
+        snap = telemetry.snapshot()
+        assert snap["counters"]['f.a{tenant="other"}'] == 1
+        assert snap["counters"]['f.b{tenant="y"}'] == 1
+
+    def test_disabled_path_ignores_labels(self, monkeypatch):
+        monkeypatch.delenv("TPU_SYNCBN_TELEMETRY", raising=False)
+        telemetry.set_enabled(None)  # env default: off
+        telemetry.count("serve.requests", labels={"tenant": "a"})
+        telemetry.set_gauge("serve.queue_depth", 1, labels={"tenant": "a"})
+        telemetry.observe("serve.latency_s", 0.1, labels={"tenant": "a"})
+        assert len(telemetry.REGISTRY) == 0
+
+    def test_deprecated_flat_mirror_warns_once(self):
+        telemetry.reset_deprecated_warnings()
+        with pytest.warns(DeprecationWarning, match="deprecated flat"):
+            telemetry.warn_deprecated_name(
+                "serve.version.active",
+                'serve.version{mode="active"}')
+        # once per process per old name: a second call is silent
+        import warnings as _warnings
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")
+            telemetry.warn_deprecated_name(
+                "serve.version.active",
+                'serve.version{mode="active"}')
+        telemetry.reset_deprecated_warnings()
+
 
 # ------------------------------------------------------------- stepstats
 

@@ -48,12 +48,27 @@ PREFIX_RE = re.compile(r"^[a-z0-9_]+$")
 #: "Memory & compile").
 #: ``autopilot`` is ISSUE 17's closed-loop controller family
 #: (``runtime.autopilot`` — docs/OBSERVABILITY.md "Autopilot").
+#: ``telemetry`` is the registry's own meta family
+#: (``telemetry.cardinality_dropped`` — the label-cap overflow tally,
+#: docs/OBSERVABILITY.md "Labels & cardinality").
 KNOWN_METRIC_PREFIXES = frozenset({
     "audit", "autopilot", "bench", "checkpoint", "collectives", "compile",
     "data", "events", "gan", "incident", "loader", "mem", "monitor",
     "numerics", "obs", "pipeline", "probe", "rendezvous", "resilience",
-    "scan", "serve", "slo", "step", "train",
+    "scan", "serve", "slo", "step", "telemetry", "train",
 })
+
+#: The closed label-key vocabulary: every literal ``labels={...}`` key
+#: in the tree must come from here (docs/OBSERVABILITY.md "Labels &
+#: cardinality"). A closed key set is what keeps selectors writable —
+#: ``{tenant="a"}`` only works if every producer spells the dimension
+#: the same way — and it is the first line of cardinality defense: a
+#: new key is a new dimension, added deliberately, here AND in the docs
+#: vocabulary table.
+LABEL_KEYS = frozenset({
+    "tenant", "model", "version", "mode", "family", "device", "knob",
+})
+LABEL_KEY_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 
 _SUPPRESS_RE = re.compile(r"#\s*audit:\s*ok(?:\[([a-z0-9_,\s]+)\])?")
 
@@ -641,6 +656,21 @@ _TELEMETRY_HELPERS = {"count", "observe", "set_gauge", "timed"}
 _REGISTRY_METHODS = {"counter", "gauge", "histogram"}
 
 
+def _is_label_sink(attr: str, base: str) -> bool:
+    """Is this call a telemetry sink whose ``labels={...}`` kwarg mints
+    registry series? Module helpers (``telemetry.count(...)`` and
+    friends, plus ``inc_gauge``), Registry instrument getters, and
+    ``CounterGroup.bump``."""
+    if (attr in _TELEMETRY_HELPERS or attr == "inc_gauge") \
+            and base.endswith("telemetry"):
+        return True
+    if attr in _REGISTRY_METHODS and (
+        "registry" in base.lower() or base.endswith("REGISTRY")
+    ):
+        return True
+    return attr == "bump"
+
+
 def check_telemetry_name_schema(
     tree: ast.AST, path: str, src_lines: Sequence[str]
 ) -> list[Violation]:
@@ -683,6 +713,35 @@ def check_telemetry_name_schema(
         if not isinstance(func, ast.Attribute):
             continue
         base = _dotted(func.value) or ""
+        # labeled series: literal label keys must come from the closed
+        # vocabulary — a producer minting a private key breaks every
+        # selector that spells the dimension the standard way
+        if _is_label_sink(func.attr, base):
+            for kw in node.keywords:
+                if kw.arg != "labels" or not isinstance(kw.value, ast.Dict):
+                    continue
+                for k in kw.value.keys:
+                    if not isinstance(k, ast.Constant) \
+                            or not isinstance(k.value, str):
+                        continue
+                    if not LABEL_KEY_RE.match(k.value):
+                        out.append(Violation(
+                            rule="telemetry_name_schema", path=path,
+                            line=k.lineno, col=k.col_offset,
+                            message=f"label key {k.value!r} does not "
+                                    f"match {LABEL_KEY_RE.pattern}",
+                        ))
+                    elif k.value not in LABEL_KEYS:
+                        out.append(Violation(
+                            rule="telemetry_name_schema", path=path,
+                            line=k.lineno, col=k.col_offset,
+                            message=f"label key {k.value!r} is not in "
+                                    "the closed label vocabulary "
+                                    f"{sorted(LABEL_KEYS)} — a new "
+                                    "dimension is added deliberately: "
+                                    "LABEL_KEYS AND the docs vocabulary "
+                                    "table",
+                        ))
         checked = None
         if func.attr in _TELEMETRY_HELPERS and base.endswith("telemetry"):
             checked = _first_str_arg(node)
@@ -710,6 +769,77 @@ def check_telemetry_name_schema(
                         "extend KNOWN_METRIC_PREFIXES (and the docs "
                         "table) deliberately",
             ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule: unbounded_label_value
+
+#: String literals shaped like per-request identity: long hex runs,
+#: uuid prefixes, long digit runs. A label value like this is one
+#: series per request — the cardinality cap will eat it, but the code
+#: is wrong before the runtime has to defend itself.
+_REQUEST_ID_LITERAL_RE = re.compile(
+    r"(?i)(?:[0-9a-f]{12,}|[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}|\d{6,})"
+)
+
+#: Call names whose result is per-call-unique (or arbitrarily wide)
+#: when fed to a label value.
+_UNBOUNDED_VALUE_CALLS = {"str", "format", "hex", "uuid1", "uuid4"}
+
+
+def check_unbounded_label_value(
+    tree: ast.AST, path: str, src_lines: Sequence[str]
+) -> list[Violation]:
+    """``unbounded_label_value``: a label value built per-request — an
+    f-string, string concatenation/formatting, a ``str()``/``.format()``
+    conversion, or a literal shaped like a request id. Labels are
+    *dimensions* (tenant, model, mode — a small closed set of values);
+    per-request identity belongs in trace spans and flight-recorder
+    rings, not the registry keyspace, where each distinct value mints a
+    series that lives forever. The runtime cardinality cap bounds the
+    damage (overflow collapses into ``other``); this rule catches the
+    mistake at review time instead."""
+    out: list[Violation] = []
+
+    def flag(node: ast.AST, key: str, what: str) -> None:
+        out.append(Violation(
+            rule="unbounded_label_value", path=path,
+            line=node.lineno, col=node.col_offset,
+            message=f"label {key!r} gets {what} as its value — label "
+                    "values must be a small closed set (per-request "
+                    "identity belongs in traces/rings, not the registry "
+                    "keyspace; overflow collapses into 'other')",
+        ))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute):
+            continue
+        base = _dotted(node.func.value) or ""
+        if not _is_label_sink(node.func.attr, base):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "labels" or not isinstance(kw.value, ast.Dict):
+                continue
+            for k, v in zip(kw.value.keys, kw.value.values):
+                key = (k.value if isinstance(k, ast.Constant)
+                       and isinstance(k.value, str) else "?")
+                if isinstance(v, ast.JoinedStr):
+                    flag(v, key, "an f-string")
+                elif isinstance(v, ast.BinOp):
+                    flag(v, key, "string concatenation/%-formatting")
+                elif isinstance(v, ast.Call):
+                    cf = v.func
+                    cname = cf.id if isinstance(cf, ast.Name) else (
+                        cf.attr if isinstance(cf, ast.Attribute) else ""
+                    )
+                    if cname in _UNBOUNDED_VALUE_CALLS:
+                        flag(v, key, f"a {cname}() result")
+                elif isinstance(v, ast.Constant) \
+                        and isinstance(v.value, str) \
+                        and _REQUEST_ID_LITERAL_RE.search(v.value):
+                    flag(v, key, "a request-id-shaped literal")
     return out
 
 
@@ -1091,6 +1221,7 @@ RULES: dict[str, Callable] = {
     "donate_after_use": check_donate_after_use,
     "unlocked_shared_state": check_unlocked_shared_state,
     "telemetry_name_schema": check_telemetry_name_schema,
+    "unbounded_label_value": check_unbounded_label_value,
     "unpaired_trace_span": check_unpaired_trace_span,
     "wallclock_duration": check_wallclock_duration,
     "unbounded_blocking": check_unbounded_blocking,

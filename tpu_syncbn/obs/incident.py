@@ -45,8 +45,16 @@ from typing import Iterable
 from tpu_syncbn.obs import telemetry, tracing
 
 #: Bump when the bundle JSON shape changes incompatibly
-#: (tests/test_incident.py pins the schema).
-BUNDLE_SCHEMA = 1
+#: (tests/test_incident.py pins the schema). v2: embedded registry and
+#: windowed snapshots may carry labeled series (``family{k="v"}``
+#: names) and slo_alert trigger details may bind label selectors in
+#: their objective strings.
+BUNDLE_SCHEMA = 2
+
+#: Schemas :func:`validate_bundle` still loads. v1 bundles (pre-label)
+#: differ only by what names *may* appear, so post-mortem diffs across
+#: the upgrade window keep working.
+ACCEPTED_SCHEMAS = frozenset({1, 2})
 BUNDLE_KIND = "tpu_syncbn.incident"
 MERGED_KIND = "tpu_syncbn.incident_merged"
 
@@ -223,9 +231,10 @@ def validate_bundle(bundle) -> dict:
     only valid if each tool it feeds can load its part."""
     if not isinstance(bundle, dict):
         raise ValueError(f"bundle must be a dict, got {type(bundle)}")
-    if bundle.get("schema") != BUNDLE_SCHEMA:
+    if bundle.get("schema") not in ACCEPTED_SCHEMAS:
         raise ValueError(
-            f"bundle schema {bundle.get('schema')!r} != {BUNDLE_SCHEMA}"
+            f"bundle schema {bundle.get('schema')!r} not in "
+            f"{sorted(ACCEPTED_SCHEMAS)}"
         )
     if bundle.get("kind") != BUNDLE_KIND:
         raise ValueError(f"bundle kind {bundle.get('kind')!r}")

@@ -153,33 +153,71 @@ def _prom_num(v: float) -> str:
     return repr(float(v))
 
 
+def _prom_split(name: str) -> tuple[str, str]:
+    """Split a registry name into (family, label chunk). The encoded
+    chunk (``{k="v",...}`` — keys sorted, values escaped by
+    :func:`telemetry.labeled_name`) is already valid Prometheus label
+    syntax, so it re-emits verbatim; only the family passes through the
+    name-charset sanitizer."""
+    family, sep, rest = name.partition("{")
+    return family, sep + rest
+
+
+def _prom_sort_key(name: str) -> tuple[str, str]:
+    # group label variants under their family: ``{`` sorts after every
+    # name character (ASCII 123), so a raw sort would interleave e.g.
+    # ``serve.latency_s2`` between ``serve.latency_s`` and its labeled
+    # series and duplicate the family's # TYPE line
+    return _prom_split(name)
+
+
 def render_prometheus(snap: dict, *, namespace: str = "tpu_syncbn") -> str:
     """Render a snapshot-shaped dict (``Registry.snapshot()``) as
     Prometheus text exposition format 0.0.4: counters become
     ``<ns>_<name>_total``, gauges ``<ns>_<name>``, histograms the
     ``_bucket{le=...}`` (cumulative counts, closed with ``le="+Inf"``) /
     ``_sum`` / ``_count`` family — each with its ``# TYPE`` line.
-    Dots in registry names become underscores (Prometheus name charset)."""
+    Dots in registry names become underscores (Prometheus name charset).
+    Labeled series (``family{k="v"}`` registry names) render under
+    their family's single ``# TYPE`` line, unlabeled series first, with
+    the label chunk emitted verbatim; histogram bucket lines splice
+    ``le`` after the series labels."""
     lines: list[str] = []
-    for name in sorted(snap.get("counters", {})):
-        pn = _prom_name(name, namespace) + "_total"
-        lines.append(f"# TYPE {pn} counter")
-        lines.append(f"{pn} {_prom_num(snap['counters'][name])}")
-    for name in sorted(snap.get("gauges", {})):
-        pn = _prom_name(name, namespace)
-        lines.append(f"# TYPE {pn} gauge")
-        lines.append(f"{pn} {_prom_num(snap['gauges'][name])}")
-    for name in sorted(snap.get("histograms", {})):
+    prev = None
+    for name in sorted(snap.get("counters", {}), key=_prom_sort_key):
+        family, chunk = _prom_split(name)
+        pn = _prom_name(family, namespace) + "_total"
+        if family != prev:
+            lines.append(f"# TYPE {pn} counter")
+            prev = family
+        lines.append(f"{pn}{chunk} {_prom_num(snap['counters'][name])}")
+    prev = None
+    for name in sorted(snap.get("gauges", {}), key=_prom_sort_key):
+        family, chunk = _prom_split(name)
+        pn = _prom_name(family, namespace)
+        if family != prev:
+            lines.append(f"# TYPE {pn} gauge")
+            prev = family
+        lines.append(f"{pn}{chunk} {_prom_num(snap['gauges'][name])}")
+    prev = None
+    for name in sorted(snap.get("histograms", {}), key=_prom_sort_key):
         h = snap["histograms"][name]
-        pn = _prom_name(name, namespace)
-        lines.append(f"# TYPE {pn} histogram")
+        family, chunk = _prom_split(name)
+        pn = _prom_name(family, namespace)
+        if family != prev:
+            lines.append(f"# TYPE {pn} histogram")
+            prev = family
+        # series labels precede ``le`` inside one brace pair
+        le_open = "{" + chunk[1:-1] + "," if chunk else "{"
         cum = 0
         for edge, c in zip(h["buckets"], h["counts"]):
             cum += c
-            lines.append(f'{pn}_bucket{{le="{_prom_num(edge)}"}} {cum}')
-        lines.append(f'{pn}_bucket{{le="+Inf"}} {h["count"]}')
-        lines.append(f"{pn}_sum {_prom_num(h['sum'])}")
-        lines.append(f"{pn}_count {h['count']}")
+            lines.append(
+                f'{pn}_bucket{le_open}le="{_prom_num(edge)}"}} {cum}'
+            )
+        lines.append(f'{pn}_bucket{le_open}le="+Inf"}} {h["count"]}')
+        lines.append(f"{pn}_sum{chunk} {_prom_num(h['sum'])}")
+        lines.append(f"{pn}_count{chunk} {h['count']}")
     return "\n".join(lines) + "\n"
 
 
@@ -200,23 +238,56 @@ def statusz_report(
     reg = registry if registry is not None else telemetry.REGISTRY
     snap = reg.snapshot()
     ready_ok, checks = evaluate_readiness()
-    circuits = {
-        name: value for name, value in snap["gauges"].items()
-        if name == "serve.circuit_state"
-        or name.startswith("serve.circuit_state.")
-    }
+    # circuit breakers, grouped by breaker family: the default breaker's
+    # plain ``serve.circuit_state`` gauge keys as "serve", labeled
+    # series key by their ``family`` label, and legacy dotted-suffix
+    # names (mirrored behind a DeprecationWarning) fill in only when no
+    # labeled twin exists
+    circuits: dict[str, float] = {}
+    for name, value in snap["gauges"].items():
+        if name == "serve.circuit_state":
+            circuits["serve"] = value
+        elif name.startswith("serve.circuit_state{"):
+            _, labels = telemetry.split_labels(name)
+            circuits[(labels or {}).get("family", name)] = value
+    for name, value in snap["gauges"].items():
+        if name.startswith("serve.circuit_state."):
+            circuits.setdefault(
+                name[len("serve.circuit_state."):], value
+            )
+    # program caches, grouped by cache family: labeled
+    # ``scan.program_cache.<field>{family=...}`` counters first, then
+    # legacy ``<name>.program_cache.<field>`` mirrors fill gaps
     caches: dict[str, dict] = {}
+    legacy_caches: list[tuple[str, str, float]] = []
     for name, value in snap["counters"].items():
-        family, sep, field = name.partition(".program_cache.")
-        if sep:
-            caches.setdefault(family, {})[field] = value
+        base, sep, rest = name.partition(".program_cache.")
+        if not sep:
+            continue
+        field, brace, _ = rest.partition("{")
+        if brace:
+            _, labels = telemetry.split_labels(name)
+            caches.setdefault(
+                (labels or {}).get("family", base), {}
+            )[field] = value
+        else:
+            legacy_caches.append((base, field, value))
+    for base, field, value in legacy_caches:
+        caches.setdefault(base, {}).setdefault(field, value)
     # weight publication (serve.publish): live version pair + swap /
     # rollback / rejection tallies, so "which weights is this process
-    # serving, and how did they get there" is on the one-glance page
+    # serving, and how did they get there" is on the one-glance page.
+    # Reads the labeled ``serve.version{mode=...}`` series, falling back
+    # to the legacy flat names, but keeps the legacy report keys so the
+    # page layout is stable.
     publication: dict = {}
-    for name in ("serve.version.active", "serve.version.previous"):
-        if name in snap["gauges"]:
-            publication[name] = snap["gauges"][name]
+    for mode, legacy in (("active", "serve.version.active"),
+                         ("previous", "serve.version.previous")):
+        labeled = telemetry.labeled_name("serve.version", {"mode": mode})
+        if labeled in snap["gauges"]:
+            publication[legacy] = snap["gauges"][labeled]
+        elif legacy in snap["gauges"]:
+            publication[legacy] = snap["gauges"][legacy]
     for name in ("serve.swaps_total", "serve.rollbacks_total",
                  "serve.swap_rejected_total"):
         if name in snap["counters"]:

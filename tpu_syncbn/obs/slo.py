@@ -42,10 +42,32 @@ from typing import Sequence
 from tpu_syncbn.obs import telemetry, tracing
 
 _OBJECTIVE_RE = re.compile(
-    r"^\s*(?P<metric>[a-z0-9_]+(?:\.[a-z0-9_]+)+)\s+"
+    r"^\s*(?P<metric>[a-z0-9_]+(?:\.[a-z0-9_]+)+(?:\{[^{}]*\})?)\s+"
     r"p(?P<q>\d{1,2}(?:\.\d+)?)\s*<\s*"
     r"(?P<threshold>[0-9.eE+-]+)\s*$"
 )
+
+
+def objective_labels(
+    objective: "LatencyObjective | Availability | SubsetRate",
+) -> dict[str, str] | None:
+    """The label selector an objective binds, pooled across every metric
+    name it reads (``serve.latency_s{tenant="a"} p99 < 0.25`` binds
+    ``{"tenant": "a"}``). ``None`` for unlabeled objectives. The burn
+    gauge publishes a labeled twin under these labels, so per-tenant
+    rules surface per-tenant burn series."""
+    if isinstance(objective, LatencyObjective):
+        names = (objective.metric,)
+    elif isinstance(objective, Availability):
+        names = (objective.good, objective.bad)
+    else:
+        names = (objective.total, objective.bad)
+    labels: dict[str, str] = {}
+    for n in names:
+        _, sel = telemetry.parse_selector(n)
+        if sel:
+            labels.update(sel)
+    return labels or None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -157,11 +179,19 @@ def parse_objective(spec: str) -> LatencyObjective:
         raise ValueError(
             f"unparseable SLO objective {spec!r}; expected "
             "'<dotted.metric> p<QQ> < <threshold>' "
-            "(e.g. 'serve.latency_s p99 < 0.25')"
+            "(e.g. 'serve.latency_s p99 < 0.25', or with a label "
+            "selector: 'serve.latency_s{tenant=\"a\"} p99 < 0.25')"
+        )
+    metric = m.group("metric")
+    family, sel = telemetry.parse_selector(metric)
+    if "{" in metric and sel is not None and not sel:
+        raise ValueError(
+            f"unparseable SLO objective {spec!r}: empty or malformed "
+            f"label selector on {metric!r}"
         )
     q = float(m.group("q")) / 100.0
     return LatencyObjective(
-        metric=m.group("metric"), quantile=q,
+        metric=metric, quantile=q,
         threshold=float(m.group("threshold")),
     )
 
@@ -389,12 +419,19 @@ class SLOTracker:
             all_hot = (len(known) == len(burns)
                        and all(b > rule.burn_threshold for b in known))
             all_cool = all(b <= rule.clear_threshold for b in known)
+            rule_labels = objective_labels(rule.objective)
             with self._lock:
                 st = self._states[rule.name]
                 st.burns = burns
                 worst = max(known) if known else 0.0
                 telemetry.set_gauge(f"slo.{rule.name}.burn_rate",
                                     round(worst, 4))
+                if rule_labels:
+                    # per-label burn twin: an objective bound to a
+                    # selector publishes its burn under those labels too
+                    telemetry.set_gauge(f"slo.{rule.name}.burn_rate",
+                                        round(worst, 4),
+                                        labels=rule_labels)
                 if not st.firing and all_hot:
                     st.firing = True
                     st.clear_streak = 0

@@ -31,10 +31,12 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import re
 import threading
 import time
+import warnings
 from bisect import bisect_left
-from typing import Any, Iterable, Sequence
+from typing import Any, Iterable, Mapping, Sequence
 
 _ENV_FLAG = "TPU_SYNCBN_TELEMETRY"
 _TRUTHY = ("1", "true", "on", "yes")
@@ -52,7 +54,96 @@ DEFAULT_TIME_BUCKETS_S = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
 )
 
+#: Default per-family label-combination cap. Labels are a bounded
+#: dimension by contract: the first ``cap`` distinct combinations of a
+#: family are admitted first-come-first-kept; every later combination
+#: collapses deterministically into ONE ``other`` series (all label
+#: values ``"other"``) and bumps ``telemetry.cardinality_dropped`` —
+#: a producer labeling with request ids degrades to a visible counter,
+#: never to unbounded registry growth.
+DEFAULT_LABEL_CARDINALITY = 32
+
+#: The label value every overflowed combination collapses to.
+OVERFLOW_LABEL_VALUE = "other"
+
+_LABEL_KEY_RE = re.compile(r"[a-z][a-z0-9_]*\Z")
+_LABEL_PAIR_RE = re.compile(r'([a-z][a-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
 _enabled: bool | None = None
+
+
+def escape_label_value(value: Any) -> str:
+    """Prometheus 0.0.4 label-value escaping (backslash, quote, newline)
+    — also the canonical form labels take inside an encoded series name,
+    so exposition can re-emit the encoded chunk verbatim."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _unescape_label_value(value: str) -> str:
+    out, i = [], 0
+    while i < len(value):
+        c = value[i]
+        if c == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def labeled_name(family: str, labels: Mapping[str, Any] | None) -> str:
+    """Canonical encoded series name: ``family{k1="v1",k2="v2"}`` with
+    keys sorted and values escaped. The encoding IS the registry key —
+    snapshot, JSONL export, merge, and windowing machinery all operate
+    on encoded names unchanged, and two hosts labeling the same way
+    produce byte-identical merge keys."""
+    if not labels:
+        return family
+    if "{" in family or "}" in family:
+        raise ValueError(f"metric family {family!r} must not contain braces")
+    items = []
+    for key in sorted(labels):
+        if not _LABEL_KEY_RE.match(key):
+            raise ValueError(
+                f"label key {key!r} must match [a-z][a-z0-9_]* "
+                f"(family {family!r})"
+            )
+        items.append(f'{key}="{escape_label_value(labels[key])}"')
+    return family + "{" + ",".join(items) + "}"
+
+
+def split_labels(name: str) -> tuple[str, dict[str, str] | None]:
+    """Inverse of :func:`labeled_name`: ``(family, labels)`` for an
+    encoded series name, ``(name, None)`` for a plain one."""
+    if not name.endswith("}"):
+        return name, None
+    i = name.find("{")
+    if i <= 0:
+        return name, None
+    labels = {m.group(1): _unescape_label_value(m.group(2))
+              for m in _LABEL_PAIR_RE.finditer(name[i + 1:-1])}
+    return name[:i], labels
+
+
+def parse_selector(name: str) -> tuple[str, dict[str, str] | None]:
+    """Parse an inline label selector (``serve.latency_s{tenant="a"}``)
+    into ``(family, selector)``; a plain name parses to ``(name, None)``
+    — exact-match semantics, not a match-all selector."""
+    return split_labels(name)
+
+
+def labels_match(series: Mapping[str, str] | None,
+                 selector: Mapping[str, str]) -> bool:
+    """Superset match: a series satisfies a selector when it carries
+    every selector pair (extra series labels are fine)."""
+    if not selector:
+        return series is not None
+    if not series:
+        return False
+    return all(series.get(k) == v for k, v in selector.items())
 
 
 def enabled() -> bool:
@@ -203,6 +294,10 @@ class Registry:
     def __init__(self):
         self._lock = threading.RLock()
         self._instruments: dict[str, Any] = {}
+        # per-family admitted label combinations (encoded names) and
+        # explicit cardinality-cap overrides
+        self._label_seen: dict[str, set[str]] = {}
+        self._label_caps: dict[str, int] = {}
 
     def _get(self, name: str, factory, kind: str):
         with self._lock:
@@ -216,17 +311,55 @@ class Registry:
                 )
             return inst
 
-    def counter(self, name: str) -> Counter:
+    def set_label_cardinality(self, family: str, cap: int) -> None:
+        """Explicit per-family cap on distinct label combinations
+        (default :data:`DEFAULT_LABEL_CARDINALITY`). Lowering the cap
+        affects only combinations not yet admitted."""
+        if int(cap) < 1:
+            raise ValueError(f"label cardinality cap must be >= 1, got {cap}")
+        with self._lock:
+            self._label_caps[family] = int(cap)
+
+    def _labeled(self, family: str, labels: Mapping[str, Any]) -> str:
+        """Resolve ``(family, labels)`` to the encoded series name,
+        enforcing the per-family cardinality cap: combinations past the
+        cap collapse deterministically into the ``other`` series and
+        bump ``telemetry.cardinality_dropped`` per routed call."""
+        full = labeled_name(family, labels)
+        with self._lock:
+            seen = self._label_seen.setdefault(family, set())
+            if full in seen:
+                return full
+            cap = self._label_caps.get(family, DEFAULT_LABEL_CARDINALITY)
+            if len(seen) < cap:
+                seen.add(full)
+                return full
+        self._get("telemetry.cardinality_dropped",
+                  lambda: Counter("telemetry.cardinality_dropped"),
+                  "counter").inc()
+        return labeled_name(
+            family, {k: OVERFLOW_LABEL_VALUE for k in labels})
+
+    def counter(self, name: str, *,
+                labels: Mapping[str, Any] | None = None) -> Counter:
+        if labels:
+            name = self._labeled(name, labels)
         return self._get(name, lambda: Counter(name), "counter")
 
-    def gauge(self, name: str) -> Gauge:
+    def gauge(self, name: str, *,
+              labels: Mapping[str, Any] | None = None) -> Gauge:
+        if labels:
+            name = self._labeled(name, labels)
         return self._get(name, lambda: Gauge(name), "gauge")
 
     def histogram(
-        self, name: str, buckets: Sequence[float] = DEFAULT_TIME_BUCKETS_S
+        self, name: str, buckets: Sequence[float] = DEFAULT_TIME_BUCKETS_S,
+        *, labels: Mapping[str, Any] | None = None,
     ) -> Histogram:
         """Get/create a histogram. ``buckets`` applies only at creation;
         later calls return the existing instrument unchanged."""
+        if labels:
+            name = self._labeled(name, labels)
         return self._get(name, lambda: Histogram(name, buckets), "histogram")
 
     def __len__(self) -> int:
@@ -237,6 +370,8 @@ class Registry:
         """Drop every instrument (tests; between bench phases)."""
         with self._lock:
             self._instruments.clear()
+            self._label_seen.clear()
+            self._label_caps.clear()
 
     def snapshot(self) -> dict:
         """JSON-ready state of every instrument, grouped by kind:
@@ -324,40 +459,58 @@ REGISTRY = Registry()
 # module helpers (the hot-path API: no-ops when disabled)
 
 
-def count(name: str, n: int = 1) -> None:
+def count(name: str, n: int = 1,
+          labels: Mapping[str, Any] | None = None) -> None:
     """Bump counter ``name`` in the process registry (no-op when
-    telemetry is disabled)."""
+    telemetry is disabled). ``labels`` routes to the encoded labeled
+    series (cardinality-capped); the unlabeled path is unchanged."""
     if not enabled():
         return
-    REGISTRY.counter(name).inc(n)
+    if labels is None:
+        REGISTRY.counter(name).inc(n)
+    else:
+        REGISTRY.counter(name, labels=labels).inc(n)
 
 
-def set_gauge(name: str, value: float) -> None:
+def set_gauge(name: str, value: float,
+              labels: Mapping[str, Any] | None = None) -> None:
     if not enabled():
         return
-    REGISTRY.gauge(name).set(value)
+    if labels is None:
+        REGISTRY.gauge(name).set(value)
+    else:
+        REGISTRY.gauge(name, labels=labels).set(value)
 
 
-def inc_gauge(name: str, n: float = 1.0) -> None:
+def inc_gauge(name: str, n: float = 1.0,
+              labels: Mapping[str, Any] | None = None) -> None:
     """Atomically add ``n`` to gauge ``name`` (no-op when disabled) —
     the level-gauge producer path (:meth:`Gauge.inc`): concurrent
     producers must not ``set(read() + 1)``."""
     if not enabled():
         return
-    REGISTRY.gauge(name).inc(n)
+    if labels is None:
+        REGISTRY.gauge(name).inc(n)
+    else:
+        REGISTRY.gauge(name, labels=labels).inc(n)
 
 
 def observe(
     name: str, value: float,
     buckets: Sequence[float] = DEFAULT_TIME_BUCKETS_S,
+    labels: Mapping[str, Any] | None = None,
 ) -> None:
     if not enabled():
         return
-    REGISTRY.histogram(name, buckets).observe(value)
+    if labels is None:
+        REGISTRY.histogram(name, buckets).observe(value)
+    else:
+        REGISTRY.histogram(name, buckets, labels=labels).observe(value)
 
 
 @contextlib.contextmanager
-def timed(name: str, buckets: Sequence[float] = DEFAULT_TIME_BUCKETS_S):
+def timed(name: str, buckets: Sequence[float] = DEFAULT_TIME_BUCKETS_S,
+          labels: Mapping[str, Any] | None = None):
     """Time a block into histogram ``name`` (seconds). Disabled path:
     zero instruments touched, one clock read avoided."""
     if not enabled():
@@ -367,7 +520,35 @@ def timed(name: str, buckets: Sequence[float] = DEFAULT_TIME_BUCKETS_S):
     try:
         yield
     finally:
-        observe(name, time.perf_counter() - t0, buckets)
+        observe(name, time.perf_counter() - t0, buckets, labels)
+
+
+# once-per-process-per-name DeprecationWarning for renamed metric
+# families (the suffix-metric -> label migration): old flat names keep
+# publishing so dashboards and BASELINE anchors keep resolving, but each
+# warns once at its first mirror
+_deprecated_lock = threading.Lock()
+_deprecated_warned: set[str] = set()
+
+
+def warn_deprecated_name(old: str, new: str) -> None:
+    """Warn (once per process per ``old``) that a flat metric name is a
+    deprecated mirror of a labeled family."""
+    with _deprecated_lock:
+        if old in _deprecated_warned:
+            return
+        _deprecated_warned.add(old)
+    warnings.warn(
+        f"telemetry name {old!r} is a deprecated flat mirror; read the "
+        f"labeled family {new!r} instead",
+        DeprecationWarning, stacklevel=3,
+    )
+
+
+def reset_deprecated_warnings() -> None:
+    """Forget which deprecated names already warned (tests)."""
+    with _deprecated_lock:
+        _deprecated_warned.clear()
 
 
 def snapshot() -> dict:
@@ -399,14 +580,21 @@ class CounterGroup:
         self.prefix = prefix
         self._registry = registry
 
-    def bump(self, name: str, n: int = 1) -> int:
-        """Increment ``name`` by ``n``; returns the new count."""
+    def bump(self, name: str, n: int = 1,
+             labels: Mapping[str, Any] | None = None) -> int:
+        """Increment ``name`` by ``n``; returns the new count. The
+        instance-local count and the unlabeled registry mirror always
+        aggregate across labels; ``labels`` additionally mirrors the
+        labeled series (so per-tenant counters ride next to the
+        aggregate, never instead of it)."""
         with self._lock:
             self._counts[name] = self._counts.get(name, 0) + n
             value = self._counts[name]
         if self.prefix and enabled():
             reg = self._registry if self._registry is not None else REGISTRY
             reg.counter(f"{self.prefix}.{name}").inc(n)
+            if labels:
+                reg.counter(f"{self.prefix}.{name}", labels=labels).inc(n)
         return value
 
     def count(self, name: str) -> int:

@@ -73,6 +73,15 @@ def quantile_from_counts(
     return float(buckets[-1])
 
 
+def _series_matches(series: str, family: str, sel: dict) -> bool:
+    """Does windowed series ``series`` satisfy a label selector? The
+    series' family must equal the selector's and its labels must be a
+    superset of the selector's pairs (``family{}`` matches every
+    labeled series of the family)."""
+    s_family, s_labels = telemetry.split_labels(series)
+    return s_family == family and telemetry.labels_match(s_labels, sel)
+
+
 class _Frame:
     """One sampling interval's deltas (and gauge readings)."""
 
@@ -214,35 +223,66 @@ class WindowedAggregator:
         """Events/second for counter ``name`` over the trailing window
         (whole ring when ``window_s`` is None). Histogram names report
         their observation-count rate — ``rate("step.time_s")`` IS
-        steps/s. ``None`` with no covered frames."""
+        steps/s. ``None`` with no covered frames.
+
+        ``name`` may be a label selector (``serve.requests{tenant="a"}``):
+        a plain name matches exactly that series (labeled children are
+        NOT summed in), while a selector sums deltas across every series
+        of the family whose labels contain the selector's pairs."""
         frames, covered = self._window_frames(window_s, now)
         if covered <= 0:
             return None
+        family, sel = telemetry.parse_selector(name)
         total = 0.0
         for f in frames:
-            total += f.counters.get(name, 0)
-            h = f.hists.get(name)
-            if h is not None:
-                total += h["count"]
+            if sel is None:
+                total += f.counters.get(name, 0)
+                h = f.hists.get(name)
+                if h is not None:
+                    total += h["count"]
+            else:
+                for series, d in f.counters.items():
+                    if _series_matches(series, family, sel):
+                        total += d
+                for series, h in f.hists.items():
+                    if _series_matches(series, family, sel):
+                        total += h["count"]
         return total / covered
 
     def _merged_counts(
         self, name: str, window_s: float | None, now: float | None,
     ) -> tuple[list[float], list[int]] | None:
         """Histogram ``name``'s bucket boundaries + summed windowed
-        counts over the trailing window, or ``None`` when absent."""
+        counts over the trailing window, or ``None`` when absent.
+        Selector names merge every matching labeled series; a bucket-
+        boundary mismatch across matched series raises (summing counts
+        from differently-bucketed histograms is silent nonsense). Plain
+        names keep the historical behavior: exact match only, frames
+        with drifted buckets re-anchor silently."""
         frames, _ = self._window_frames(window_s, now)
+        family, sel = telemetry.parse_selector(name)
         buckets: list[float] | None = None
         counts: list[int] | None = None
         for f in frames:
-            h = f.hists.get(name)
-            if h is None:
-                continue
-            if buckets is None:
-                buckets = h["buckets"]
-                counts = list(h["counts"])
-            elif h["buckets"] == buckets:
-                counts = [a + b for a, b in zip(counts, h["counts"])]
+            if sel is None:
+                matched = [f.hists[name]] if name in f.hists else []
+            else:
+                matched = [
+                    h for series, h in f.hists.items()
+                    if _series_matches(series, family, sel)
+                ]
+            for h in matched:
+                if buckets is None:
+                    buckets = h["buckets"]
+                    counts = list(h["counts"])
+                elif h["buckets"] == buckets:
+                    counts = [a + b for a, b in zip(counts, h["counts"])]
+                elif sel is not None:
+                    raise ValueError(
+                        f"selector {name!r} matched histograms with "
+                        f"different bucket boundaries: {buckets} vs "
+                        f"{h['buckets']}"
+                    )
         if buckets is None or counts is None:
             return None
         return buckets, counts

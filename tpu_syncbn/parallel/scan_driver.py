@@ -209,28 +209,42 @@ class ProgramCache(dict):
         if self.name is not None:
             from tpu_syncbn.obs import telemetry
 
+            telemetry.count("scan.program_cache." + event,
+                            labels={"family": self.name})
+            telemetry.warn_deprecated_name(
+                f"{self.name}.program_cache.{event}",
+                telemetry.labeled_name("scan.program_cache." + event,
+                                       {"family": self.name}),
+            )
             telemetry.count(f"{self.name}.program_cache.{event}")
 
     def _publish_gauges(self) -> None:
-        """Live cache-occupancy gauges (``<name>.program_cache.
-        bytes_live`` / ``.live`` / ``.fill_frac``) — today ``stats()``
-        snapshots are the only view, so one tenant's cache churn is
-        invisible on ``/metrics`` (ROADMAP item 4's shared-budget
-        pre-work). Called on the mutation path (a build); no-op for
-        anonymous caches and when telemetry is off."""
+        """Live cache-occupancy gauges — the labeled
+        ``scan.program_cache.{bytes_live,live,fill_frac}{family=<name>}``
+        series, with the legacy flat ``<name>.program_cache.*`` names
+        mirrored behind a DeprecationWarning — so one tenant's cache
+        churn is visible on ``/metrics`` per family (ROADMAP item 4's
+        shared-budget pre-work). Called on the mutation path (a build);
+        no-op for anonymous caches and when telemetry is off."""
         if self.name is None:
             return
         from tpu_syncbn.obs import telemetry
 
+        labels = {"family": self.name}
         bytes_live = self.bytes_live
+        telemetry.set_gauge("scan.program_cache.bytes_live", bytes_live,
+                            labels=labels)
         telemetry.set_gauge(f"{self.name}.program_cache.bytes_live",
                             bytes_live)
+        telemetry.set_gauge("scan.program_cache.live", len(self),
+                            labels=labels)
         telemetry.set_gauge(f"{self.name}.program_cache.live", len(self))
         if self.max_bytes:
-            telemetry.set_gauge(
-                f"{self.name}.program_cache.fill_frac",
-                round(bytes_live / self.max_bytes, 4),
-            )
+            fill = round(bytes_live / self.max_bytes, 4)
+            telemetry.set_gauge("scan.program_cache.fill_frac", fill,
+                                labels=labels)
+            telemetry.set_gauge(f"{self.name}.program_cache.fill_frac",
+                                fill)
 
     @property
     def bytes_live(self) -> int:

@@ -346,8 +346,18 @@ class CircuitBreaker:
             raise ValueError(f"probe_limit must be >= 1, got {probe_limit}")
         self.probe_limit = int(probe_limit)
         token = re.sub(r"[^a-z0-9_]", "_", key.lower())
-        self.gauge_name = ("serve.circuit_state" if token in ("", "serve")
-                          else f"serve.circuit_state.{token}")
+        if token in ("", "serve"):
+            # the default breaker keeps the plain process-wide gauge
+            self._labels = None
+            self.gauge_name = "serve.circuit_state"
+        else:
+            # non-default breakers publish the labeled family; the old
+            # flat dotted-suffix name mirrors behind a DeprecationWarning
+            self._labels = {"family": token}
+            self.gauge_name = telemetry.labeled_name(
+                "serve.circuit_state", self._labels
+            )
+            self._legacy_gauge_name = f"serve.circuit_state.{token}"
         self._now = now
         self._lock = threading.Lock()
         self._state = self.CLOSED
@@ -360,7 +370,16 @@ class CircuitBreaker:
         self._publish()
 
     def _publish(self) -> None:
-        telemetry.set_gauge(self.gauge_name, self._CODES[self._state])
+        code = self._CODES[self._state]
+        if self._labels is None:
+            telemetry.set_gauge(self.gauge_name, code)
+        else:
+            telemetry.set_gauge("serve.circuit_state", code,
+                                labels=self._labels)
+            telemetry.warn_deprecated_name(
+                self._legacy_gauge_name, self.gauge_name
+            )
+            telemetry.set_gauge(self._legacy_gauge_name, code)
         # breaker transitions land in the flight recorder's serve ring
         # (no-op without a recorder; record_serve only takes the
         # recorder's own ring lock — no cross-lock cycle with ours)

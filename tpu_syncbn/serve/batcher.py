@@ -129,6 +129,12 @@ class DynamicBatcher:
     * ``breaker`` — the engine :class:`~tpu_syncbn.serve.admission.
       CircuitBreaker`; default-constructed (5 consecutive failures
       open). Pass a configured instance, or ``False`` to disable.
+    * ``tenant`` — optional tenant name: traffic series (``requests`` /
+      ``rejected`` / ``shed`` / ``deadline_miss_total`` counters, the
+      ``serve.latency_s`` histogram, the ``serve.queue_depth`` gauge)
+      additionally publish ``{tenant="..."}``-labeled twins, and serve-
+      ring entries carry the tenant — the per-tenant SLO substrate
+      (docs/OBSERVABILITY.md "Labels & cardinality").
     """
 
     def __init__(
@@ -144,6 +150,7 @@ class DynamicBatcher:
         deadline_ms: float | None = None,
         estimator: LatencyEstimator | None = None,
         breaker: CircuitBreaker | bool | None = None,
+        tenant: str | None = None,
     ):
         if max_batch is None:
             max_batch = int(engine.max_bucket)
@@ -165,6 +172,14 @@ class DynamicBatcher:
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) / 1e3
         self._guard = guard
+        #: optional ``tenant`` label: when set, this batcher publishes
+        #: labeled twins of its serve.* traffic series alongside the
+        #: unlabeled process-wide ones, so two tenants sharing one mesh
+        #: get separately addressable rates/quantiles/burn rates
+        self.tenant = tenant
+        self._tenant_labels = {"tenant": tenant} if tenant else None
+        #: tenant attribution for flight-recorder serve-ring entries
+        self._detail = {"tenant": tenant} if tenant else {}
         self.default_deadline_ms = deadline_ms
         self.estimator = (estimator if estimator is not None
                           else LatencyEstimator())
@@ -305,9 +320,11 @@ class DynamicBatcher:
             req.future.set_exception(DeadlineExceededError(
                 "shed: predicted completion misses the request deadline"
             ))
-        self.counters.bump("shed")
-        self.counters.bump("deadline_miss_total")
-        flightrec.record_serve("shed", rid=req.rid, n=req.n)
+        self.counters.bump("shed", labels=self._tenant_labels)
+        self.counters.bump("deadline_miss_total",
+                           labels=self._tenant_labels)
+        flightrec.record_serve("shed", rid=req.rid, n=req.n,
+                               **self._detail)
 
     def submit(self, item, *, deadline_ms: float | None = None) -> Future:
         """Enqueue one request; returns its ``Future``. Raises
@@ -323,15 +340,17 @@ class DynamicBatcher:
                 "split it or call the engine directly"
             )
         if self.draining or self._stopped.is_set():
-            self.counters.bump("rejected")
-            flightrec.record_serve("rejected", reason="draining", n=n)
+            self.counters.bump("rejected", labels=self._tenant_labels)
+            flightrec.record_serve("rejected", reason="draining", n=n,
+                                   **self._detail)
             raise RejectedError("batcher is draining — not admitting")
         if self._breaker is not None:
             admit, retry_after = self._breaker.allow()
             if not admit:
-                self.counters.bump("rejected")
+                self.counters.bump("rejected",
+                                   labels=self._tenant_labels)
                 flightrec.record_serve("rejected", reason="circuit_open",
-                                       n=n)
+                                       n=n, **self._detail)
                 raise CircuitOpenError(
                     "engine circuit open after consecutive failures — "
                     f"retry in {retry_after:.2f}s",
@@ -355,8 +374,9 @@ class DynamicBatcher:
         try:
             self._q.put_nowait(req)
         except queue.Full:
-            self.counters.bump("rejected")
-            flightrec.record_serve("rejected", reason="queue_full", n=n)
+            self.counters.bump("rejected", labels=self._tenant_labels)
+            flightrec.record_serve("rejected", reason="queue_full", n=n,
+                                   **self._detail)
             raise RejectedError(
                 f"request queue full ({self._q.maxsize}) — shed load"
             ) from None
@@ -367,10 +387,13 @@ class DynamicBatcher:
             # request; a result already set by the worker wins)
             self._reject_dead_queue()
             if req.future.done() and req.future.exception() is not None:
-                self.counters.bump("rejected")
+                self.counters.bump("rejected", labels=self._tenant_labels)
                 raise RejectedError("batcher is draining — not admitting")
-        self.counters.bump("requests")
+        self.counters.bump("requests", labels=self._tenant_labels)
         telemetry.set_gauge("serve.queue_depth", self._q.qsize())
+        if self._tenant_labels is not None:
+            telemetry.set_gauge("serve.queue_depth", self._q.qsize(),
+                                labels=self._tenant_labels)
         return req.future
 
     def _reject_dead_queue(self) -> None:
@@ -419,7 +442,8 @@ class DynamicBatcher:
                         # open circuit: already-queued work fast-fails
                         # too — dispatching it into a known-broken
                         # engine would only delay the client's retry
-                        self.counters.bump("rejected")
+                        self.counters.bump("rejected",
+                                           labels=self._tenant_labels)
                         if first.future.set_running_or_notify_cancel():
                             first.future.set_exception(CircuitOpenError(
                                 "engine circuit open — retry in "
@@ -516,14 +540,18 @@ class DynamicBatcher:
             lo = off
             off += r.n
             telemetry.observe("serve.latency_s", now - r.t0)
+            if self._tenant_labels is not None:
+                telemetry.observe("serve.latency_s", now - r.t0,
+                                  labels=self._tenant_labels)
             if r.deadline is not None and mono > r.deadline:
                 # answered, but late: the client may already have given
                 # up — count it so the miss rate covers late answers,
                 # not just sheds
-                self.counters.bump("deadline_miss_total")
+                self.counters.bump("deadline_miss_total",
+                                   labels=self._tenant_labels)
                 flightrec.record_serve(
                     "deadline_miss", rid=r.rid,
-                    late_s=round(mono - r.deadline, 4),
+                    late_s=round(mono - r.deadline, 4), **self._detail,
                 )
             r.future.set_result(jax.tree_util.tree_map(
                 lambda a: a[lo:lo + r.n], out

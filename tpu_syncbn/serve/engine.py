@@ -117,6 +117,7 @@ class InferenceEngine:
         apply_fn: Callable[[Any, Any], Any] | None = None,
         buckets: Sequence[int] = (8, 32, 128),
         program_cache_bytes: int | None = None,
+        model_label: str | None = None,
     ):
         import jax
         from flax import nnx
@@ -181,6 +182,13 @@ class InferenceEngine:
             name="serve", max_bytes=program_cache_bytes
         )
         self._programs_compiled = 0
+        #: optional ``model`` label: when set, the engine publishes
+        #: labeled twins of its serve.* series alongside the unlabeled
+        #: process-wide ones (multi-model tenancy attribution)
+        self.model_label = model_label
+        self._model_labels = (
+            {"model": model_label} if model_label else None
+        )
 
     # -- versioned state ---------------------------------------------------
 
@@ -444,13 +452,22 @@ class InferenceEngine:
         key = (bucket, treedef, leafspecs)
 
         def build():
+            import time
+
             sharded = self._sharded_fwd()
             sds = self._bucket_struct(bucket, treedef, leafspecs)
+            t0 = time.perf_counter()
             with telemetry.timed("serve.compile_s"):
                 compiled = jax.jit(sharded).lower(
                     self._params, self._rest, sds
                 ).compile()
             telemetry.count("serve.compiles")
+            if self._model_labels is not None:
+                telemetry.observe("serve.compile_s",
+                                  time.perf_counter() - t0,
+                                  labels=self._model_labels)
+                telemetry.count("serve.compiles",
+                                labels=self._model_labels)
             # int bump on the GIL, read only by stats(); _swap_lock
             # guards the version triple, not the program cache
             self._programs_compiled += 1  # audit: ok[unlocked_shared_state]
@@ -498,6 +515,8 @@ class InferenceEngine:
     # -- execution ---------------------------------------------------------
 
     def _run_one(self, batch, n: int):
+        import time
+
         import jax
 
         from tpu_syncbn.obs import stepstats as obs_stepstats
@@ -524,6 +543,10 @@ class InferenceEngine:
         # level gauge, not set(): concurrent callers each inc/dec their
         # own contribution atomically (obs.telemetry.Gauge.inc)
         telemetry.inc_gauge("serve.inflight")
+        if self._model_labels is not None:
+            telemetry.inc_gauge("serve.inflight",
+                                labels=self._model_labels)
+        t0 = time.perf_counter()
         try:
             with obs_stepstats.timed_span(
                 "serve.infer", "serve.infer_s", n=n, bucket=bucket
@@ -537,6 +560,12 @@ class InferenceEngine:
                     lambda a: np.asarray(a)[:n], out
                 )
         finally:
+            if self._model_labels is not None:
+                telemetry.observe("serve.infer_s",
+                                  time.perf_counter() - t0,
+                                  labels=self._model_labels)
+                telemetry.inc_gauge("serve.inflight", -1,
+                                    labels=self._model_labels)
             telemetry.inc_gauge("serve.inflight", -1)
 
     def predict(self, batch):

@@ -74,6 +74,19 @@ SWAP_PHASES = ("verify", "preflight", "not_ready", "commit", "probe",
                "ready")
 
 
+def _publish_version(mode: str, value: float) -> None:
+    """Publish the ``serve.version{mode=...}`` gauge (``mode`` is
+    ``active`` or ``previous``), mirroring the legacy flat
+    ``serve.version.<mode>`` name behind a DeprecationWarning so
+    pre-label dashboards keep resolving."""
+    telemetry.set_gauge("serve.version", value, labels={"mode": mode})
+    legacy = f"serve.version.{mode}"
+    telemetry.warn_deprecated_name(
+        legacy, telemetry.labeled_name("serve.version", {"mode": mode})
+    )
+    telemetry.set_gauge(legacy, value)
+
+
 class PublicationError(RuntimeError):
     """A weight swap could not be performed; serving state untouched."""
 
@@ -139,8 +152,7 @@ class SwapController:
         self.rollbacks = 0
         self.rejected = 0
         self.last: dict | None = None
-        telemetry.set_gauge("serve.version.active",
-                            int(getattr(engine, "version", 0)))
+        _publish_version("active", int(getattr(engine, "version", 0)))
         obs_server.register_readiness(self._health_name, self.readiness)
         self._registered = True
 
@@ -327,8 +339,8 @@ class SwapController:
                     self.rollbacks += 1
                     swap_s = time.perf_counter() - t0
                     telemetry.count("serve.rollbacks_total")
-                    telemetry.set_gauge("serve.version.active", restored)
-                    telemetry.set_gauge("serve.version.previous", version)
+                    _publish_version("active", restored)
+                    _publish_version("previous", version)
                     result = {
                         "outcome": "rolled_back", "version": restored,
                         "failed_version": version, "source": source,
@@ -347,8 +359,8 @@ class SwapController:
             self.swaps += 1
             telemetry.count("serve.swaps_total")
             telemetry.observe("serve.swap_s", swap_s)
-            telemetry.set_gauge("serve.version.active", version)
-            telemetry.set_gauge("serve.version.previous", old)
+            _publish_version("active", version)
+            _publish_version("previous", old)
             result = {
                 "outcome": "swapped", "version": version,
                 "previous_version": old, "source": source,
@@ -370,8 +382,8 @@ class SwapController:
             restored = self.engine.rollback()
             self.rollbacks += 1
             telemetry.count("serve.rollbacks_total")
-            telemetry.set_gauge("serve.version.active", restored)
-            telemetry.set_gauge("serve.version.previous", bad)
+            _publish_version("active", restored)
+            _publish_version("previous", bad)
             result = {
                 "outcome": "rolled_back", "version": restored,
                 "failed_version": bad, "source": "manual",
