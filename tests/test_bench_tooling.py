@@ -578,6 +578,9 @@ class TestTelemetryBlock:
         # the autopilot block is always present (the closed-loop
         # controller A/B under an injected numerics fault — ISSUE 17)
         self._validate_autopilot_block(line["autopilot"])
+        # the planner block is always present (the contract-driven
+        # layout search ranked against reality — ISSUE 19)
+        self._validate_planner_block(line["planner"])
         # the serve block is null unless --serve ran the sweep
         assert line["serve"] is None
         # the --trace file is valid Chrome trace JSON with the three
@@ -767,6 +770,61 @@ class TestTelemetryBlock:
         assert bundles is not None and bundles["valid"] is True
         assert bundles["count"] == block["actuations"]
         assert all(s == "numerics_clip" for s in bundles["signals"])
+
+    @staticmethod
+    def _validate_planner_block(block):
+        """The schema-pinned `planner` block (ISSUE 19): the static
+        cost model must rank {DP, DP+ZeRO, 1F1B pipeline} in the same
+        order the host actually runs them (Kendall tau == 1.0 is the
+        ordinal acceptance gate; measured/predicted ratios are
+        recorded, never gated), and the planner-backed autopilot A/B
+        must escalate off the violated plan with a schema-valid
+        plan_change bundle."""
+        assert block is not None
+        assert set(block) == {
+            "world", "batch", "rates", "plan_s", "cache",
+            "candidates_feasible", "candidates", "predicted_order",
+            "measured_order", "kendall_tau", "autopilot",
+        }
+        assert set(block["rates"]) == {
+            "flop_rate", "wire_rate", "dispatch_s",
+        }
+        assert block["plan_s"] > 0
+        # the restricted surface is exactly the three measured layouts
+        assert block["candidates_feasible"] == 3
+        assert set(block["candidates"]) == {
+            "dp.fp32.k1", "zero.fp32.k1", "pipe.1f1b.n4.m8",
+        }
+        for name, cand in block["candidates"].items():
+            assert set(cand) == {
+                "predicted_step_s", "measured_step_s", "ratio",
+            }, name
+            assert cand["predicted_step_s"] > 0
+            assert cand["measured_step_s"] > 0
+            # ratio is recorded for cross-round trend reading, not
+            # gated: the rates are host-calibrated, not host-exact
+            assert cand["ratio"] > 0
+        assert sorted(block["predicted_order"]) \
+            == sorted(block["measured_order"]) \
+            == sorted(block["candidates"])
+        # the ordinal acceptance gate: predicted ordering == measured
+        assert block["kendall_tau"] == 1.0
+        # the planner-backed A/B: top-2 planned layouts, the live
+        # plan's measured step time violates its prediction, and the
+        # controller escalates with the bundle proof
+        ab = block["autopilot"]
+        assert set(ab) == {
+            "plans", "escalated", "frm", "to", "signal", "switches",
+            "bundles",
+        }
+        assert ab["plans"] == block["predicted_order"][:2]
+        assert ab["escalated"] is True
+        assert (ab["frm"], ab["to"]) == tuple(ab["plans"])
+        assert ab["signal"] == "plan_violation"
+        assert ab["switches"] == [ab["to"]]
+        assert ab["bundles"] is not None
+        assert ab["bundles"]["valid"] is True
+        assert ab["bundles"]["count"] == 1
 
     @staticmethod
     def _validate_incident_block(block, *, steps):

@@ -1,5 +1,9 @@
 """CLI driver: ``python -m tpu_syncbn.audit [--strict] [--json]
-[--shardings] [--mem-budget N] [--changed-only REF]``.
+[--shardings] [--mem-budget N] [--changed-only REF]``, plus the
+``plan`` subcommand (``python -m tpu_syncbn.audit plan``): the
+contract-driven parallelism planner's ranked layout table — predicted
+step time per candidate, decomposed into compute/collective/bubble/
+host shares, with nothing compiled (docs/PLANNER.md).
 
 Exit codes: 0 — clean; 1 — violations (or, under ``--strict``, traced
 programs with no pinned golden; or ``--write-goldens`` refusing to
@@ -131,7 +135,10 @@ def main(argv=None) -> int:
     # exit, so import-time forcing alone would leave a second call's
     # contract layer on whatever platform the caller selected
     _force_env()
+    argv = list(sys.argv[1:] if argv is None else argv)
     try:
+        if argv and argv[0] == "plan":
+            return _run_plan(_parse_plan(argv[1:]))
         return _run(_parse(argv))
     finally:
         _restore_env()
@@ -334,6 +341,106 @@ def _run(args) -> int:
                else "")
         )
     return 0 if result.ok else 1
+
+
+def _parse_plan(argv):
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_syncbn.audit plan",
+        description="Contract-driven parallelism planner: enumerate "
+        "DP / DP+ZeRO / pipeline / tensor layout candidates over the "
+        "virtual 8-device mesh, cost each statically from its traced "
+        "contract (nothing compiles), and print the ranked "
+        "predicted-step-time table (docs/PLANNER.md).",
+    )
+    parser.add_argument(
+        "--layers", type=int, default=None, metavar="N",
+        help="LayerStack depth (default: the bench proxy stack)",
+    )
+    parser.add_argument(
+        "--d-model", type=int, default=None, metavar="D",
+        help="LayerStack model width",
+    )
+    parser.add_argument(
+        "--d-hidden", type=int, default=None, metavar="H",
+        help="LayerStack hidden width",
+    )
+    parser.add_argument(
+        "--batch", type=int, default=32, metavar="B",
+        help="global batch rows (default 32)",
+    )
+    parser.add_argument(
+        "--objective", default="step_time",
+        choices=("step_time", "wire_bytes", "peak_memory"),
+        help="ranking objective (default step_time)",
+    )
+    parser.add_argument(
+        "--mem-budget", default=None, metavar="BYTES",
+        help="per-device peak-memory contract (k/m/g suffixes ok); "
+        "candidates whose predicted peak exceeds it are rejected with "
+        "a named reason",
+    )
+    parser.add_argument(
+        "--top", type=int, default=None, metavar="K",
+        help="print only the K best plans (default: all)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the full RankedPlans JSON on stdout",
+    )
+    return parser.parse_args(argv)
+
+
+def _run_plan(args) -> int:
+    mem_budget = None
+    if args.mem_budget is not None:
+        try:
+            mem_budget = _parse_bytes(args.mem_budget)
+        except ValueError:
+            print(f"--mem-budget: cannot parse {args.mem_budget!r} "
+                  "(want bytes, or k/m/g-suffixed)", file=sys.stderr)
+            return 2
+        if mem_budget < 1:
+            print("--mem-budget must be positive", file=sys.stderr)
+            return 2
+    # same pinned-CPU-mesh discipline as the contract layer: a site
+    # hook may have re-selected a TPU plugin via jax.config after the
+    # env forcing — candidates are built with the real trainers, so the
+    # virtual 8-device mesh must win; rolled back with the env
+    import jax
+
+    if jax.config.jax_platforms != "cpu":
+        _PRIOR_JAX_PLATFORMS.append(jax.config.jax_platforms)
+        jax.config.update("jax_platforms", "cpu")
+
+    from tpu_syncbn.parallel import planner
+
+    stack = planner.bench_stack()
+    if (args.layers is not None or args.d_model is not None
+            or args.d_hidden is not None):
+        stack = planner.LayerStack(
+            n_layers=args.layers if args.layers is not None
+            else stack.n_layers,
+            d_model=args.d_model if args.d_model is not None
+            else stack.d_model,
+            d_hidden=args.d_hidden if args.d_hidden is not None
+            else stack.d_hidden,
+            name="custom",
+        )
+    try:
+        ranked = planner.plan(
+            stack, args.batch, len(jax.devices()),
+            objective=args.objective, mem_budget=mem_budget,
+        )
+    except ValueError as e:
+        print(f"plan: {e}", file=sys.stderr)
+        return 2
+    if args.top is not None:
+        ranked.plans = ranked.plans[:max(0, args.top)]
+    if args.as_json:
+        print(json.dumps(ranked.to_json(), indent=1, sort_keys=False))
+    else:
+        print(ranked.table())
+    return 0 if ranked.plans else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,168 @@
+"""Memoized contract extraction: one trace per (program fingerprint,
+layout, world) per process.
+
+The planner (:mod:`tpu_syncbn.parallel.planner`) enumerates candidate
+layouts whose *programs* often coincide — every scan-chunk-K variant of
+a DP candidate shares one traced program (the pinned
+``contract.scan_variance`` invariant: the fused-scan contract is
+K-invariant per logical step), and a ``--strict --shardings`` audit CLI
+run in the same process rebuilds the registry programs the planner
+already traced. Re-tracing is pure waste, so both paths key their
+extraction through this cache.
+
+The fingerprint is everything that determines the traced program text
+and its layer-3 sharding block — NOT the callable's identity (trainers
+are rebuilt per call, so ``fn`` is always a fresh object):
+
+* the program name and extraction kind (contract vs weighted cost),
+* the mesh world and its named-axis factorization,
+* every argument's pytree structure + leaf shapes/dtypes,
+* the entry ``in_specs`` and declared donation,
+* whether the ``memory=True`` XLA cross-check was requested.
+
+Hits and misses are counted under the planner metric family
+(``planner.contract_cache_hits`` / ``planner.contract_cache_misses`` —
+docs/OBSERVABILITY.md "Planner"). The cache is process-global and
+unbounded: entries are a few KB of JSON-able dataclass, and the
+candidate surface is enumerable by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+from tpu_syncbn.obs import telemetry
+
+_CONTRACTS: dict[tuple, Any] = {}
+_COSTS: dict[tuple, dict] = {}
+
+#: Process-lifetime hit/miss tallies — the source of truth for
+#: :func:`stats` (the telemetry counters mirror them, but telemetry may
+#: be disabled).
+_TALLY = {"hits": 0, "misses": 0}
+
+
+def _tree_signature(args: Sequence[Any]) -> tuple:
+    import jax
+
+    sig = []
+    for arg in args:
+        leaves, treedef = jax.tree_util.tree_flatten(arg)
+        sig.append((
+            str(treedef),
+            tuple(
+                (tuple(getattr(leaf, "shape", ())),
+                 str(getattr(leaf, "dtype", type(leaf).__name__)))
+                for leaf in leaves
+            ),
+        ))
+    return tuple(sig)
+
+
+def fingerprint(
+    *,
+    name: str,
+    world: int,
+    example_args: Sequence[Any],
+    mesh: Any | None = None,
+    in_specs: Sequence[Any] | None = None,
+    declared_donated: Sequence[str] = (),
+    memory: bool = False,
+) -> tuple:
+    """The (program fingerprint, layout, world size) cache key."""
+    mesh_axes = (
+        tuple(sorted((str(a), int(s)) for a, s in mesh.shape.items()))
+        if mesh is not None else ()
+    )
+    specs = (
+        tuple(repr(s) for s in in_specs) if in_specs is not None else ()
+    )
+    return (
+        name, int(world), mesh_axes, _tree_signature(example_args),
+        specs, tuple(declared_donated), bool(memory),
+    )
+
+
+def _lookup(cache: dict, key: tuple, build: Callable[[], Any]):
+    if key in cache:
+        _TALLY["hits"] += 1
+        telemetry.count("planner.contract_cache_hits")
+        return cache[key]
+    _TALLY["misses"] += 1
+    telemetry.count("planner.contract_cache_misses")
+    cache[key] = build()
+    return cache[key]
+
+
+def cached_contract(
+    fn: Callable,
+    example_args: Sequence[Any],
+    *,
+    name: str,
+    world: int,
+    arg_labels: Sequence[str],
+    declared_donated: Sequence[str] = (),
+    mesh: Any | None = None,
+    in_specs: Sequence[Any] | None = None,
+    memory: bool = False,
+):
+    """Memoizing front end for
+    :func:`tpu_syncbn.audit.contracts.extract_contract` — same
+    signature, same return, at most one trace per fingerprint per
+    process."""
+    from tpu_syncbn.audit import contracts
+
+    key = fingerprint(
+        name=name, world=world, example_args=example_args, mesh=mesh,
+        in_specs=in_specs, declared_donated=declared_donated,
+        memory=memory,
+    )
+    return _lookup(_CONTRACTS, key, lambda: contracts.extract_contract(
+        fn, example_args, name=name, world=world, arg_labels=arg_labels,
+        declared_donated=declared_donated, mesh=mesh, in_specs=in_specs,
+        memory=memory,
+    ))
+
+
+def cached_cost(
+    fn: Callable,
+    example_args: Sequence[Any],
+    *,
+    name: str,
+    world: int,
+    mesh: Any | None = None,
+    in_specs: Sequence[Any] | None = None,
+) -> dict:
+    """Memoized :func:`tpu_syncbn.audit.contracts.weighted_cost_summary`
+    of ``jax.make_jaxpr(fn)(*example_args)`` — the execution-weighted
+    flop/byte figures the planner's cost model consumes."""
+    import jax
+
+    from tpu_syncbn.audit import contracts
+
+    key = fingerprint(
+        name=name, world=world, example_args=example_args, mesh=mesh,
+        in_specs=in_specs,
+    ) + ("__cost__",)
+    return _lookup(_COSTS, key, lambda: contracts.weighted_cost_summary(
+        jax.make_jaxpr(fn)(*example_args)
+    ))
+
+
+def stats() -> dict:
+    """Live hit/miss tallies plus entry counts (JSON-ready)."""
+    return {
+        "hits": _TALLY["hits"],
+        "misses": _TALLY["misses"],
+        "contracts": len(_CONTRACTS),
+        "costs": len(_COSTS),
+    }
+
+
+def clear() -> None:
+    """Drop every memoized entry and zero the tallies (tests; the
+    mirrored telemetry counters are the registry's to reset)."""
+    _CONTRACTS.clear()
+    _COSTS.clear()
+    _TALLY["hits"] = 0
+    _TALLY["misses"] = 0

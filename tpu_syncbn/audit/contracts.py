@@ -298,6 +298,119 @@ def summarize_jaxpr(closed_jaxpr) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# execution-weighted costing (the planner's static cost oracle)
+
+#: Matmul-shaped primitives the weighted walk assigns flops to. Every
+#: other primitive is treated as free — on the accelerators this stack
+#: targets the MXU work dominates and elementwise ops ride along fused,
+#: so the planner's *relative* ordering does not need them.
+FLOP_PRIMS = frozenset({"dot_general", "conv_general_dilated"})
+
+
+def _eqn_flops(eqn) -> int:
+    """Multiply-add flop estimate (2·MACs) for one matmul-shaped
+    equation, from the operand avals and dimension numbers. Returns 0
+    for anything outside :data:`FLOP_PRIMS`."""
+    prim = eqn.primitive.name
+    if prim == "dot_general":
+        lhs = getattr(eqn.invars[0], "aval", None)
+        rhs = getattr(eqn.invars[1], "aval", None)
+        if lhs is None or rhs is None:
+            return 0
+        (lc, _rc), (lb, _rb) = eqn.params["dimension_numbers"]
+        contract = math.prod(lhs.shape[i] for i in lc) or 1
+        batch = math.prod(lhs.shape[i] for i in lb) or 1
+        lhs_free = max(1, math.prod(lhs.shape) // (contract * batch))
+        rhs_free = max(1, math.prod(rhs.shape) // (contract * batch))
+        return 2 * batch * lhs_free * rhs_free * contract
+    if prim == "conv_general_dilated":
+        rhs = getattr(eqn.invars[1], "aval", None)
+        out = getattr(eqn.outvars[0], "aval", None)
+        if rhs is None or out is None:
+            return 0
+        dn = eqn.params.get("dimension_numbers")
+        rhs_spec = getattr(dn, "rhs_spec", None)
+        out_ch = rhs.shape[rhs_spec[0]] if rhs_spec else max(rhs.shape)
+        macs_per_out = max(1, math.prod(rhs.shape) // max(1, out_ch))
+        groups = int(eqn.params.get("feature_group_count", 1) or 1)
+        return 2 * math.prod(out.shape) * macs_per_out // max(1, groups)
+    return 0
+
+
+def weighted_cost_summary(closed_jaxpr) -> dict:
+    """Execution-weighted pass over the program text: unlike
+    :func:`summarize_jaxpr` (program text — a scan body counts once),
+    this walk multiplies by the ``lax.scan`` trip count when it
+    descends into a scan body, so a fused K-step program or a T-tick
+    pipeline schedule is costed by what it *executes*, not what it
+    spells. Returns per-device figures (shard_map bodies carry
+    per-shard avals):
+
+    * ``flops`` — 2·MAC estimate over :data:`FLOP_PRIMS`;
+    * ``collective_bytes`` — per-primitive executed bytes-on-wire;
+    * ``bytes_total`` — their sum;
+    * ``host_callbacks`` — executed host round trips.
+
+    ``while`` bodies are weighted by one trip (the count is not in the
+    program text — a known under-estimate, stated in docs/PLANNER.md);
+    ``cond`` contributes its most expensive branch."""
+
+    def walk(jaxpr, weight: int):
+        jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+        flops = 0
+        cbytes: dict[str, int] = {}
+        callbacks = 0
+
+        def merge(f, cb, hb):
+            nonlocal flops, callbacks
+            flops += f
+            callbacks += hb
+            for k, v in cb.items():
+                cbytes[k] = cbytes.get(k, 0) + v
+
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            if prim in COLLECTIVE_PRIMS:
+                nbytes = sum(_aval_bytes(v.aval) for v in eqn.invars
+                             if hasattr(v, "aval"))
+                cbytes[prim] = cbytes.get(prim, 0) + weight * nbytes
+            elif prim in HOST_CALLBACK_PRIMS:
+                callbacks += weight
+            elif prim in FLOP_PRIMS:
+                flops += weight * _eqn_flops(eqn)
+            if prim == "cond":
+                branches = [
+                    walk(b, weight)
+                    for b in eqn.params.get("branches", ())
+                    if hasattr(getattr(b, "jaxpr", b), "eqns")
+                ]
+                if branches:
+                    merge(*max(branches, key=lambda c: c[0]))
+                continue
+            sub_w = weight
+            if prim == "scan":
+                sub_w = weight * int(eqn.params.get("length", 1) or 1)
+            seen: set[int] = set()
+            for value in eqn.params.values():
+                subs = value if isinstance(value, (list, tuple)) \
+                    else (value,)
+                for sub in subs:
+                    inner = getattr(sub, "jaxpr", sub)
+                    if hasattr(inner, "eqns") and id(inner) not in seen:
+                        seen.add(id(inner))
+                        merge(*walk(inner, sub_w))
+        return flops, cbytes, callbacks
+
+    flops, cbytes, callbacks = walk(closed_jaxpr, 1)
+    return {
+        "flops": flops,
+        "collective_bytes": cbytes,
+        "bytes_total": sum(cbytes.values()),
+        "host_callbacks": callbacks,
+    }
+
+
+# ---------------------------------------------------------------------------
 # donation (StableHLO arg attributes)
 
 _MAIN_SIG_RE = re.compile(

@@ -719,12 +719,16 @@ def build_contracts(
     (layer-1 fields + the layer-3 sharding block). ``memory=True``
     additionally compiles each program once so the sharding block
     carries the XLA ``memory_analysis`` cross-check — the ``--shardings``
-    CLI mode."""
+    CLI mode. Extraction is memoized per (fingerprint, layout, world)
+    through :mod:`tpu_syncbn.audit.contract_cache`, so a CLI run in a
+    process that already planned (or audited) pays zero re-traces."""
+    from tpu_syncbn.audit import contract_cache
+
     picked = list(PROGRAM_BUILDERS) if names is None else list(names)
     out: dict[str, ProgramContract] = {}
     for name in picked:
         spec = PROGRAM_BUILDERS[name]()
-        out[name] = extract_contract(
+        out[name] = contract_cache.cached_contract(
             spec.fn, spec.example_args,
             name=spec.name, world=spec.world,
             arg_labels=spec.arg_labels,

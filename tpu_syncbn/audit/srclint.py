@@ -48,14 +48,17 @@ PREFIX_RE = re.compile(r"^[a-z0-9_]+$")
 #: "Memory & compile").
 #: ``autopilot`` is ISSUE 17's closed-loop controller family
 #: (``runtime.autopilot`` — docs/OBSERVABILITY.md "Autopilot").
+#: ``planner`` is ISSUE 19's contract-driven layout search family
+#: (``parallel.planner`` + ``audit.contract_cache`` —
+#: docs/OBSERVABILITY.md "Planner").
 #: ``telemetry`` is the registry's own meta family
 #: (``telemetry.cardinality_dropped`` — the label-cap overflow tally,
 #: docs/OBSERVABILITY.md "Labels & cardinality").
 KNOWN_METRIC_PREFIXES = frozenset({
     "audit", "autopilot", "bench", "checkpoint", "collectives", "compile",
     "data", "events", "gan", "incident", "loader", "mem", "monitor",
-    "numerics", "obs", "pipeline", "probe", "rendezvous", "resilience",
-    "scan", "serve", "slo", "step", "telemetry", "train",
+    "numerics", "obs", "pipeline", "planner", "probe", "rendezvous",
+    "resilience", "scan", "serve", "slo", "step", "telemetry", "train",
 })
 
 #: The closed label-key vocabulary: every literal ``labels={...}`` key

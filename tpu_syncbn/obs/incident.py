@@ -63,7 +63,8 @@ MERGED_KIND = "tpu_syncbn.incident_merged"
 #: (schema token form) — these are the wired ones.
 TRIGGER_KINDS = ("slo_alert", "divergence_restore", "watchdog_stall",
                  "circuit_open", "numerics_drift", "mem_pressure",
-                 "recompile_storm", "weight_swap", "autopilot", "manual")
+                 "recompile_storm", "weight_swap", "autopilot",
+                 "plan_change", "manual")
 
 _KIND_RE = re.compile(r"^[a-z0-9_]+$")
 

@@ -321,6 +321,9 @@ class PipelineTrainer:
             )
         (self.n_stages,) = stage_dims
         self.num_microbatches = int(num_microbatches)
+        # named schedules (gpipe/1f1b) can be re-derived at a new M by
+        # set_microbatches(); an explicit Schedule instance cannot
+        self._schedule_name = schedule if isinstance(schedule, str) else None
         self.schedule = pipeline_schedule.get_schedule(
             schedule, self.num_microbatches, self.n_stages
         )
@@ -628,13 +631,40 @@ class PipelineTrainer:
             donate=self._donate,
         )
 
+    def set_microbatches(self, num_microbatches: int) -> bool:
+        """Re-derive the schedule at a new microbatch count M — the
+        autopilot's ``microbatch_m`` actuator (docs/PLANNER.md "The M
+        actuator"). Only valid for named schedules (``gpipe`` /
+        ``1f1b``); an explicit :class:`~tpu_syncbn.parallel.
+        pipeline_schedule.Schedule` instance is pinned to its M and
+        this returns ``False`` without touching anything. Programs for
+        the new M are (re)built lazily by the K->program cache — prior
+        Ms stay warm, so flapping between two values does not
+        recompile. Callers must feed batches split at the new M."""
+        m = int(num_microbatches)
+        if self._schedule_name is None:
+            return False
+        if m == self.num_microbatches:
+            return True
+        sched = pipeline_schedule.get_schedule(
+            self._schedule_name, m, self.n_stages
+        )
+        if not sched.name.startswith("_"):
+            pipeline_schedule.validate_schedule(sched)
+        self.num_microbatches = m
+        self.schedule = sched
+        return True
+
     def _run(self, key, batch):
         from tpu_syncbn.parallel import scan_driver
         from tpu_syncbn.parallel.trainer import StepOutput
 
         n_steps, stacked = key
+        # M is part of the program identity: set_microbatches() swaps
+        # the schedule, and each (K, stacked, M) gets its own fused
+        # program in the LRU
         fn = scan_driver.cached_program(
-            self._train_cache, key,
+            self._train_cache, key + (self.num_microbatches,),
             lambda: self._build_train_steps(n_steps, stacked=stacked),
         )
         self._param_store, self.opt_state, losses, metrics = fn(

@@ -23,7 +23,25 @@ the stack already exposes:
   watchdog deadline follows the live K);
 * **program-cache byte budgets** — shrink under memory pressure,
   regrow after the healthy window
-  (:meth:`~tpu_syncbn.parallel.scan_driver.ProgramCache.set_max_bytes`).
+  (:meth:`~tpu_syncbn.parallel.scan_driver.ProgramCache.set_max_bytes`);
+* **pipeline microbatch count M** — drive M toward the schedule's
+  predicted bubble optimum: raise it when the tick tables say a larger
+  M buys a materially smaller bubble *and* the measured
+  ``pipeline.bubble_frac`` gauge confirms there is bubble to reclaim;
+  lower it under memory pressure (GPipe's in-flight activation stash
+  grows with M — :meth:`Schedule.max_in_flight`). Actuates
+  :meth:`~tpu_syncbn.parallel.pipeline.PipelineTrainer.set_microbatches`
+  — prior Ms stay warm in the program cache, so moving back compiles
+  nothing;
+* **layout (planner-backed candidate-set mode)** — the controller
+  holds a ranked plan list from
+  :func:`tpu_syncbn.parallel.planner.plan` and *escalates* to the next
+  planned layout when the measured mean step time violates the current
+  plan's prediction by more than ``plan_tolerance``x. Layout moves are
+  the one knob whose actuation fires the ``plan_change`` incident
+  trigger (not ``autopilot``): a layout swap is a topology event worth
+  its own bundle. Escalate-only by design — the planner re-ranks
+  offline; the controller never walks back on its own.
 
 Safety is the existing machinery, by construction:
 
@@ -47,8 +65,10 @@ Telemetry (all under the ``autopilot.`` family —
 docs/OBSERVABILITY.md "Autopilot"): ``autopilot.actuations`` /
 ``autopilot.suppressed`` / ``autopilot.clamped`` counters, per-knob
 state gauges ``autopilot.compress_rung`` / ``autopilot.scan_k`` /
-``autopilot.cache_max_bytes``, and the ``autopilot.decision_s``
-histogram (policy-evaluation cost per chunk boundary).
+``autopilot.cache_max_bytes`` (plus ``autopilot.microbatch_m`` /
+``autopilot.plan_rank`` when those knobs are configured), and the
+``autopilot.decision_s`` histogram (policy-evaluation cost per chunk
+boundary).
 
 Clocks are injectable (``now=``) and the SLO tracker is evaluated with
 the same timestamp, so the whole state machine is deterministic under
@@ -78,6 +98,9 @@ DEFAULT_RULE_FAMILIES = ("numerics", "mem", "compile")
 _COMPRESS_KNOB = "compress"
 _K_KNOB = "scan_k"
 _CACHE_KNOB = "cache_bytes"
+_M_KNOB = "microbatch_m"
+_LAYOUT_KNOB = "layout"
+_KNOBS = (_COMPRESS_KNOB, _K_KNOB, _CACHE_KNOB, _M_KNOB, _LAYOUT_KNOB)
 
 
 def _dispatch_seconds(snap: dict) -> float:
@@ -134,7 +157,17 @@ class Autopilot:
       its chunk source through it);
     * ``cache_bytes_bounds`` — ``(floor, ceiling)`` for every cache in
       ``trainer.program_caches`` (plus ``extra_caches``); ``None``
-      disables the knob.
+      disables the knob;
+    * ``m_candidates`` — ascending microbatch-count set for the
+      pipeline M actuator (needs ``pipe_schedule`` + ``pipe_stages``
+      so every candidate's bubble is derivable up front;
+      ``set_microbatch`` is the actuation callback, normally
+      ``PipelineTrainer.set_microbatches``); empty disables the knob;
+    * ``plan_candidates`` — rank-ordered ``(name, predicted_step_s)``
+      pairs (or planner ``PlannedCandidate``s — pass
+      ``RankedPlans.top(k)`` directly) for the layout knob;
+      ``set_layout`` receives the next plan's name on escalation;
+      fewer than two candidates disables the knob.
 
     Policy timing: ``window_s`` is the evaluation window (signals are
     read over it; at most one actuation per knob per window —
@@ -157,6 +190,15 @@ class Autopilot:
         initial_k: int | None = None,
         cache_bytes_bounds: tuple[int, int] | None = None,
         extra_caches: Sequence = (),
+        m_candidates: Sequence[int] = (),
+        set_microbatch: Callable[[int], None] | None = None,
+        initial_m: int | None = None,
+        pipe_schedule: str | None = None,
+        pipe_stages: int | None = None,
+        bubble_margin: float = 0.02,
+        plan_candidates: Sequence = (),
+        set_layout: Callable[[str], None] | None = None,
+        plan_tolerance: float = 1.5,
         window_s: float = 60.0,
         healthy_for_s: float = 300.0,
         host_gap_threshold: float = 0.3,
@@ -201,6 +243,45 @@ class Autopilot:
                     f"cache_bytes_bounds needs 1 <= floor <= ceiling, "
                     f"got {cache_bytes_bounds}"
                 )
+        ms = tuple(int(m) for m in m_candidates)
+        if list(ms) != sorted(set(ms)) or any(m < 1 for m in ms):
+            raise ValueError(
+                f"m_candidates must be ascending positive ints, got "
+                f"{m_candidates}"
+            )
+        if ms and (pipe_schedule is None or pipe_stages is None):
+            raise ValueError(
+                "the microbatch knob needs pipe_schedule and "
+                "pipe_stages (the predicted-bubble side of the policy "
+                "comes from the static tick tables)"
+            )
+        if ms and pipe_stages is not None:
+            from tpu_syncbn.parallel import pipeline_schedule
+
+            for m in ms:
+                # every candidate's schedule must be derivable up front
+                # (candidate-set discipline: no first-actuation surprise)
+                pipeline_schedule.get_schedule(
+                    pipe_schedule, m, int(pipe_stages)
+                )
+        plans = []
+        for cand in plan_candidates:
+            if hasattr(cand, "candidate"):  # a planner PlannedCandidate
+                plans.append((cand.name, float(cand.predicted_step_s)))
+            else:
+                name, predicted = cand
+                plans.append((str(name), float(predicted)))
+        if plans and len({n for n, _ in plans}) != len(plans):
+            raise ValueError(
+                f"plan_candidates repeat a layout name: "
+                f"{[n for n, _ in plans]}"
+            )
+        if plan_tolerance < 1.0:
+            raise ValueError(
+                f"plan_tolerance must be >= 1.0 (a plan is violated "
+                f"only when measured exceeds predicted), got "
+                f"{plan_tolerance}"
+            )
         if window_s <= 0 or healthy_for_s <= 0:
             raise ValueError(
                 "window_s and healthy_for_s must be > 0, got "
@@ -218,6 +299,16 @@ class Autopilot:
         self._set_scan_k = set_scan_k
         self.cache_bytes_bounds = cache_bytes_bounds
         self.extra_caches = tuple(extra_caches)
+        self.m_candidates = ms
+        self._set_microbatch = set_microbatch
+        self.pipe_schedule = pipe_schedule
+        self.pipe_stages = int(pipe_stages) if pipe_stages is not None \
+            else None
+        self.bubble_margin = float(bubble_margin)
+        self.plan_candidates = tuple(plans)
+        self._set_layout = set_layout
+        self.plan_tolerance = float(plan_tolerance)
+        self.plan_rank = 0
         self.window_s = float(window_s)
         self.healthy_for_s = float(healthy_for_s)
         self.host_gap_threshold = float(host_gap_threshold)
@@ -235,16 +326,24 @@ class Autopilot:
                 f"initial_k {initial_k} not in k_candidates {ks}"
             )
         self.scan_k = int(initial_k)
+        if initial_m is None:
+            initial_m = ms[0] if ms else None
+        if ms and initial_m not in ms:
+            raise ValueError(
+                f"initial_m {initial_m} not in m_candidates {ms}"
+            )
+        self.microbatch_m = int(initial_m) if initial_m is not None \
+            else None
         # per-knob last-actuation clocks (None = never): hysteresis
         # anchors — only real knob turns move them
         self._last_actuation: dict[str, float | None] = {
-            _COMPRESS_KNOB: None, _K_KNOB: None, _CACHE_KNOB: None,
+            knob: None for knob in _KNOBS
         }
         # per-knob last-decision clocks: the cooldown — clamps count
         # too, so a sustained burn at a bound writes one ring entry per
         # window, not one per chunk
         self._last_decision_t: dict[str, float | None] = {
-            _COMPRESS_KNOB: None, _K_KNOB: None, _CACHE_KNOB: None,
+            knob: None for knob in _KNOBS
         }
         # last time the knob's driving family burned (None = never seen
         # burning — de-escalation then keys off the first chunk's clock)
@@ -318,7 +417,11 @@ class Autopilot:
             self.counters.bump("actuations")
             self._last_actuation[knob] = now
             self._last_decision_t[knob] = now
-            flightrec.trigger("autopilot", decision)
+            # a layout swap is a topology event: it gets its own
+            # incident kind so post-mortems can diff plan moves apart
+            # from routine knob turns
+            kind = "plan_change" if knob == _LAYOUT_KNOB else "autopilot"
+            flightrec.trigger(kind, decision)
         return decision
 
     def _export_gauges(self) -> None:
@@ -327,6 +430,11 @@ class Autopilot:
         budget = self._cache_budget()
         if budget is not None:
             telemetry.set_gauge("autopilot.cache_max_bytes", budget)
+        if self.microbatch_m is not None:
+            telemetry.set_gauge("autopilot.microbatch_m",
+                                self.microbatch_m)
+        if self.plan_candidates:
+            telemetry.set_gauge("autopilot.plan_rank", self.plan_rank)
 
     @staticmethod
     def _quote(state: dict, rule: str) -> dict:
@@ -374,6 +482,8 @@ class Autopilot:
                                            step)
         decisions += self._k_policy(state, snap, mem_firing, now, step)
         decisions += self._cache_policy(state, mem_firing, now, step)
+        decisions += self._m_policy(state, snap, mem_firing, now, step)
+        decisions += self._layout_policy(snap, now, step)
         self._export_gauges()
         telemetry.observe("autopilot.decision_s",
                           time.perf_counter() - t0)
@@ -491,6 +601,116 @@ class Autopilot:
             return [self._record(d, now)]
         return []
 
+    def _predicted_bubble(self, m: int) -> float:
+        from tpu_syncbn.parallel import pipeline_schedule
+
+        return pipeline_schedule.get_schedule(
+            self.pipe_schedule, m, self.pipe_stages
+        ).predicted_bubble_frac
+
+    def _m_policy(self, state, snap, mem_firing, now, step):
+        """Drive M toward the schedule's predicted bubble optimum:
+        raise it when the NEXT candidate's tick table predicts at least
+        ``bubble_margin`` less bubble than the measured
+        ``pipeline.bubble_frac`` says we are paying; lower it when
+        ``mem_pressure`` fires (GPipe's in-flight activation stash
+        scales with M). Predicted numbers come from the same exact
+        arithmetic the planner costs with — measured-vs-predicted gap
+        is the actuation evidence, quoted into the decision."""
+        if not self.m_candidates or len(self.m_candidates) < 2:
+            return []
+        if self._in_cooldown(_M_KNOB, now):
+            return []
+        base = {"knob": _M_KNOB, "step": step, "window_s": self.window_s}
+        idx = self.m_candidates.index(self.microbatch_m)
+        if mem_firing:
+            base.update(signal="mem_pressure",
+                        burns=self._quote(state, "mem_pressure"))
+            if idx > 0:
+                frm = self.microbatch_m
+                self.microbatch_m = self.m_candidates[idx - 1]
+                if self._set_microbatch is not None:
+                    self._set_microbatch(self.microbatch_m)
+                d = dict(base, action="lower", frm=frm,
+                         to=self.microbatch_m)
+            else:
+                d = dict(base, action="clamp", frm=self.microbatch_m)
+            return [self._record(d, now)]
+        measured = snap.get("gauges", {}).get("pipeline.bubble_frac")
+        if measured is None:
+            return []
+        if not self._healthy_since(_M_KNOB, self._last_mem_burn, now):
+            return []
+        cur = self._predicted_bubble(self.microbatch_m)
+        if idx + 1 < len(self.m_candidates):
+            nxt_m = self.m_candidates[idx + 1]
+            nxt = self._predicted_bubble(nxt_m)
+            # two conditions: the tick table promises a material win,
+            # and the measured bubble confirms there is that much to
+            # reclaim (a noisy low measurement must not drive M up)
+            if (cur - nxt >= self.bubble_margin
+                    and measured >= nxt + self.bubble_margin):
+                frm = self.microbatch_m
+                self.microbatch_m = nxt_m
+                if self._set_microbatch is not None:
+                    self._set_microbatch(nxt_m)
+                d = dict(base, action="raise", frm=frm, to=nxt_m,
+                         signal="bubble_gap",
+                         bubble_measured=round(measured, 4),
+                         bubble_predicted=round(cur, 4),
+                         bubble_predicted_next=round(nxt, 4))
+                return [self._record(d, now)]
+            return []
+        # top of the candidate set but still paying a bubble the
+        # margin says matters: clamp, visibly
+        if measured >= cur + self.bubble_margin:
+            d = dict(base, action="clamp", frm=self.microbatch_m,
+                     signal="bubble_gap",
+                     bubble_measured=round(measured, 4),
+                     bubble_predicted=round(cur, 4))
+            return [self._record(d, now)]
+        return []
+
+    def _layout_policy(self, snap, now, step):
+        """Planner-backed candidate-set mode: hold the ranked plan
+        list, compare the measured mean step time against the current
+        plan's prediction, escalate one rank when the prediction is
+        violated by more than ``plan_tolerance``x. Escalate-only (the
+        planner re-ranks offline; the controller never walks back), and
+        the actuation fires the ``plan_change`` incident trigger."""
+        if len(self.plan_candidates) < 2:
+            return []
+        if self._in_cooldown(_LAYOUT_KNOB, now):
+            return []
+        hists = snap.get("histograms", {})
+        from tpu_syncbn.obs import incident
+
+        count = sum(
+            hists[name]["count"] for name in incident._DISPATCH_HISTS
+            if name in hists
+        )
+        if count <= 0:
+            return []
+        measured = _dispatch_seconds(snap) / count
+        name, predicted = self.plan_candidates[self.plan_rank]
+        if measured <= predicted * self.plan_tolerance:
+            return []
+        base = {"knob": _LAYOUT_KNOB, "step": step,
+                "window_s": self.window_s, "signal": "plan_violation",
+                "measured_step_s": round(measured, 6),
+                "predicted_step_s": round(predicted, 6),
+                "plan_tolerance": self.plan_tolerance}
+        if self.plan_rank + 1 < len(self.plan_candidates):
+            self.plan_rank += 1
+            to_name = self.plan_candidates[self.plan_rank][0]
+            if self._set_layout is not None:
+                self._set_layout(to_name)
+            d = dict(base, action="escalate", frm=name, to=to_name,
+                     plan_rank=self.plan_rank)
+        else:
+            d = dict(base, action="clamp", frm=name)
+        return [self._record(d, now)]
+
     # -- introspection -----------------------------------------------------
 
     def state(self) -> dict:
@@ -503,6 +723,12 @@ class Autopilot:
             "scan_k": self.scan_k,
             "k_candidates": list(self.k_candidates),
             "cache_max_bytes": self._cache_budget(),
+            "microbatch_m": self.microbatch_m,
+            "m_candidates": list(self.m_candidates),
+            "plan": (self.plan_candidates[self.plan_rank][0]
+                     if self.plan_candidates else None),
+            "plan_rank": self.plan_rank,
+            "plan_candidates": [n for n, _ in self.plan_candidates],
             "chunks": self.chunks,
             "actuations": self.counters.count("actuations"),
             "clamped": self.counters.count("clamped"),
