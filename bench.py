@@ -1874,6 +1874,63 @@ def measure_planner(*, n_chips: int) -> dict:
     }
 
 
+def measure_layout() -> dict:
+    """The ``layout`` block of the bench line (docs/LAYOUT.md): the
+    SpecLayout composition claim measured from traced contracts —
+    per-device peak bytes and traced wire bytes for the SAME model and
+    optimizer under plain DP, the composed DP×FSDP layout
+    (``SpecLayout.fsdp``), and DP×FSDP with int8 wire compression.
+    Pure program-text arithmetic over the audit registry's ``layout.*``
+    programs (nothing compiles, nothing executes), so the two ratios
+    are backend-independent and BASELINE-anchored:
+
+    * ``fsdp_peak_ratio`` — the composed layout's per-device peak over
+      plain DP's (``layout.fsdp_peak_ratio``, direction lower): the
+      memory claim. The audit layer pins the same bound as the
+      ``contract.fsdp_peak_memory`` invariant (≤ 0.6×).
+    * ``int8_wire_ratio`` — the composed layout's fp32 wire bytes over
+      its int8 twin's (``layout.int8_wire_ratio``, direction higher):
+      compression must keep reaching the wire when routed over the
+      layout's derived reduce/scatter axes.
+
+    Schema pinned by tests/test_bench_tooling.py."""
+    from tpu_syncbn.audit import contract_cache, jaxpr_audit
+
+    t0 = time.perf_counter()
+    kinds = ("dp", "dp_fsdp", "dp_fsdp_int8")
+    per_kind: dict[str, dict] = {}
+    for kind in kinds:
+        spec = jaxpr_audit.PROGRAM_BUILDERS[
+            f"layout.{kind}.train_step"]()
+        contract = contract_cache.cached_contract(
+            spec.fn, spec.example_args, name=spec.name,
+            world=spec.world, arg_labels=spec.arg_labels,
+            declared_donated=spec.declared_donated, mesh=spec.mesh,
+            in_specs=spec.in_specs,
+        )
+        summary = contract_cache.cached_cost(
+            spec.fn, spec.example_args, name=spec.name,
+            world=spec.world, mesh=spec.mesh, in_specs=spec.in_specs,
+        )
+        per_kind[kind] = {
+            "world": int(spec.world),
+            "peak_bytes_per_device": int(
+                contract.sharding.peak_bytes_per_device),
+            "wire_bytes_per_device": int(summary["bytes_total"]),
+        }
+    dp, fs, q = (per_kind[k] for k in kinds)
+    return {
+        **per_kind,
+        "fsdp_peak_ratio": round(
+            fs["peak_bytes_per_device"]
+            / max(dp["peak_bytes_per_device"], 1), 4),
+        "int8_wire_ratio": round(
+            fs["wire_bytes_per_device"]
+            / max(q["wire_bytes_per_device"], 1), 4),
+        "layout_s": round(time.perf_counter() - t0, 3),
+    }
+
+
 def measure_audit(dp, batch) -> dict:
     """The ``audit`` block of the bench line: the static-analysis layer
     (docs/STATIC_ANALYSIS.md) run against THIS process — the package
@@ -2641,6 +2698,21 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
         log(f"planner measurement failed: {type(e).__name__}: {e}")
         planner_info = None
 
+    # composed-layout memory/wire claim from traced contracts
+    # (docs/LAYOUT.md) — an annotation, never fatal to the metric
+    try:
+        with stepstats.timed_span("layout_bench", "bench.layout_s"):
+            layout_info = measure_layout()
+        log(f"layout: DP+FSDP peak ratio "
+            f"{layout_info['fsdp_peak_ratio']} (per-device "
+            f"{layout_info['dp']['peak_bytes_per_device']} -> "
+            f"{layout_info['dp_fsdp']['peak_bytes_per_device']} B), "
+            f"int8 wire ratio {layout_info['int8_wire_ratio']} in "
+            f"{layout_info['layout_s']}s")
+    except Exception as e:
+        log(f"layout measurement failed: {type(e).__name__}: {e}")
+        layout_info = None
+
     # flight recorder + incident bundle measured on the run's own state
     # (docs/OBSERVABILITY.md "Incidents & flight recorder") — an
     # annotation, never fatal to the metric
@@ -2822,6 +2894,9 @@ def main(trace_path: str | None = None, scan: int = 1, serve: bool = False):
         # layouts with its plan_change bundle proof; schema pinned by
         # tests/test_bench_tooling.py
         "planner": planner_info,
+        # composed-layout contract ratios (docs/LAYOUT.md); the two
+        # ratio fields are BASELINE --check-regression anchors
+        "layout": layout_info,
         # a fallback line is a liveness smoke signal, not a measurement
         # of anything the project tracks — cross-round diffs of it are
         # meaningless and tagged as such

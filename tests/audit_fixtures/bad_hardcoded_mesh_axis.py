@@ -10,7 +10,7 @@ from jax import lax
 
 def build_mesh(devices):
     # literal axis name in a Mesh constructor (tuple form)
-    return Mesh(np.array(devices), ("data",))  # VIOLATION
+    return Mesh(np.array(devices), ("data",))  # VIOLATION  # audit: ok[private_mesh_plumbing]
 
 
 def batch_spec():
@@ -20,7 +20,7 @@ def batch_spec():
 
 def shard(mesh, x):
     # literal axis in a NamedSharding spec call chain
-    return NamedSharding(mesh, P(None, "model"))  # VIOLATION
+    return NamedSharding(mesh, P(None, "model"))  # VIOLATION  # audit: ok[private_mesh_plumbing]
 
 
 def reduce_grads(g):

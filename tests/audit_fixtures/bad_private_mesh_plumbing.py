@@ -1,0 +1,40 @@
+"""Planted private_mesh_plumbing violations — a trainer-shaped module
+assembling its own mesh/sharding universe instead of consuming a
+SpecLayout. Lint input only; never imported. Axis names here are
+deliberately non-canonical strings (no ``data``/``model``/``fsdp``) so
+only this rule fires."""
+
+import numpy as np
+from jax.sharding import AbstractMesh, Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from tpu_syncbn.mesh_axes import ALL_AXES
+
+
+class PrivateTrainer:
+    def __init__(self, devices, axis):
+        # a trainer building its own mesh: the siloing the rule polices
+        self.mesh = Mesh(np.array(devices), (axis,))  # VIOLATION
+        # ...and its own shardings, spec universe included
+        self.replicated = NamedSharding(self.mesh, P())  # VIOLATION
+        self.batch_sharding = NamedSharding(self.mesh, P(axis))  # VIOLATION
+
+    def abstract_twin(self, axis):
+        # the tracing-only constructor counts too — same private universe
+        return AbstractMesh((8,), (axis,))  # VIOLATION
+
+    def put_spec(self, spec):
+        # attribute-qualified constructor form
+        import jax.sharding as shd
+
+        return shd.NamedSharding(self.mesh, spec)  # VIOLATION
+
+
+def clean(layout, spec, sharding):
+    # consuming a layout (or inspecting shardings) stays clean:
+    # annotations, isinstance checks, and layout.sharding(spec) calls
+    named: NamedSharding | None = None
+    if isinstance(sharding, NamedSharding):
+        named = sharding
+    assert ALL_AXES
+    return named, layout.sharding(spec)

@@ -31,6 +31,7 @@ RULE_FIXTURES = {
     "wallclock_duration": ("bad_wallclock_duration.py", 3),
     "unbounded_blocking": ("bad_unbounded_blocking.py", 5),
     "hardcoded_mesh_axis": ("bad_hardcoded_mesh_axis.py", 6),
+    "private_mesh_plumbing": ("bad_private_mesh_plumbing.py", 5),
     "lossy_default_mode": ("bad_lossy_default_mode.py", 4),
     "unbounded_label_value": ("bad_unbounded_label_value.py", 5),
 }

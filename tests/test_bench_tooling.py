@@ -581,6 +581,9 @@ class TestTelemetryBlock:
         # the planner block is always present (the contract-driven
         # layout search ranked against reality — ISSUE 19)
         self._validate_planner_block(line["planner"])
+        # the layout block is always present (the composed-layout
+        # memory/wire claim from traced contracts — ISSUE 20)
+        self._validate_layout_block(line["layout"])
         # the serve block is null unless --serve ran the sweep
         assert line["serve"] is None
         # the --trace file is valid Chrome trace JSON with the three
@@ -825,6 +828,34 @@ class TestTelemetryBlock:
         assert ab["bundles"] is not None
         assert ab["bundles"]["valid"] is True
         assert ab["bundles"]["count"] == 1
+
+    @staticmethod
+    def _validate_layout_block(block):
+        """The schema-pinned `layout` block (ISSUE 20): per-device peak
+        and traced wire bytes for the same model+optimizer under DP,
+        the composed DP×FSDP SpecLayout, and its int8 twin. The two
+        ratios are the BASELINE --check-regression anchors; here the
+        composition claims themselves are pinned deterministically."""
+        assert block is not None
+        assert set(block) == {
+            "dp", "dp_fsdp", "dp_fsdp_int8", "fsdp_peak_ratio",
+            "int8_wire_ratio", "layout_s",
+        }
+        for kind in ("dp", "dp_fsdp", "dp_fsdp_int8"):
+            sub = block[kind]
+            assert set(sub) == {
+                "world", "peak_bytes_per_device", "wire_bytes_per_device",
+            }, kind
+            assert sub["world"] == 8
+            assert sub["peak_bytes_per_device"] > 0
+            assert sub["wire_bytes_per_device"] > 0
+        # the memory claim: composed FSDP peak <= 0.6x plain DP (the
+        # contract.fsdp_peak_memory invariant, live on the bench line)
+        assert block["fsdp_peak_ratio"] <= 0.6
+        # the wire claim: int8 keeps compressing on the layout-derived
+        # reduce/scatter axes (>= 2x vs the fp32 composed twin)
+        assert block["int8_wire_ratio"] >= 2.0
+        assert block["layout_s"] > 0
 
     @staticmethod
     def _validate_incident_block(block, *, steps):

@@ -1,0 +1,194 @@
+"""SpecLayout unit behavior (ISSUE 20): presets, wildcard rules,
+derived reduce/scatter axes, canonical-order enforcement, and named
+legality rejections.
+
+Everything here is host-side mesh/spec arithmetic on the virtual
+8-device mesh (tests/conftest.py) — nothing trains. Trajectory-level
+composition claims live in tests/test_layout_parity.py.
+"""
+
+import jax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_syncbn.mesh_axes import DATA_AXIS, FSDP_AXIS, MODEL_AXIS
+from tpu_syncbn.parallel import SpecLayout
+from tpu_syncbn.parallel.pipeline import pipeline_mesh
+
+pytestmark = pytest.mark.layout
+
+
+# -- presets ---------------------------------------------------------------
+
+
+class TestPresets:
+    def test_data_parallel_is_1d_replicated(self):
+        lay = SpecLayout.data_parallel()
+        assert lay.axis_sizes == {DATA_AXIS: 8}
+        assert lay.param_shard_axis is None
+        assert lay.batch_entry == DATA_AXIS  # plain string: 1-D layout
+        assert lay.batch_spec == P(DATA_AXIS)
+        assert lay.replica_world == 8 and lay.shard_world == 1
+        assert lay.world == 8
+
+    def test_zero_shards_over_the_data_axis(self):
+        lay = SpecLayout.zero()
+        assert lay.param_shard_axis == DATA_AXIS
+        assert lay.grad_scatter_axis == DATA_AXIS
+        # the scatter consumes the only batch axis: nothing left to psum
+        assert lay.grad_cross_axes == ()
+        assert lay.shard_world == 8
+
+    def test_fsdp_composes_two_batch_axes(self):
+        lay = SpecLayout.fsdp(data=2, fsdp=4)
+        assert lay.axis_sizes == {DATA_AXIS: 2, FSDP_AXIS: 4}
+        # composed: the batch entry is a tuple over both axes
+        assert lay.batch_entry == (DATA_AXIS, FSDP_AXIS)
+        assert lay.batch_spec == P((DATA_AXIS, FSDP_AXIS))
+        # SyncBN statistics scope == all batch replicas
+        assert lay.stat_axes == (DATA_AXIS, FSDP_AXIS)
+        # gradient: reduce-scatter over fsdp, then psum the rest over data
+        assert lay.grad_scatter_axis == FSDP_AXIS
+        assert lay.grad_cross_axes == (DATA_AXIS,)
+        assert lay.replica_world == 8 and lay.shard_world == 4
+
+    def test_tensor_parallel_carries_rules(self):
+        lay = SpecLayout.tensor_parallel(
+            data=4, model=2, rules=(("*/kernel", P(None, MODEL_AXIS)),)
+        )
+        assert lay.axis_sizes == {DATA_AXIS: 4, MODEL_AXIS: 2}
+        assert lay.param_shard_axis is None
+        assert lay.batch_entry == DATA_AXIS  # model axis is not batch-like
+        assert lay.spec_for("block/kernel") == P(None, MODEL_AXIS)
+
+    def test_from_mesh_adopts_pipeline_mesh(self):
+        mesh = pipeline_mesh(4)
+        lay = SpecLayout.from_mesh(mesh, param_shard_axis=None)
+        assert lay.mesh is mesh
+        assert lay.batch_entry == DATA_AXIS
+
+    def test_from_mesh_auto_picks_fsdp_axis(self):
+        lay = SpecLayout.from_mesh(SpecLayout.fsdp(data=2, fsdp=4).mesh)
+        assert lay.param_shard_axis == FSDP_AXIS
+
+
+# -- construction errors ---------------------------------------------------
+
+
+class TestConstruction:
+    def test_unknown_axis_rejected(self):
+        with pytest.raises(ValueError, match="canonical axes"):
+            SpecLayout({"replica": 8})
+
+    def test_adopted_mesh_must_be_canonical_order(self):
+        import numpy as np
+        from tpu_syncbn.runtime import distributed as dist
+
+        good = dist.make_mesh({DATA_AXIS: 2, FSDP_AXIS: 4})
+        bad = jax.sharding.Mesh(
+            np.array(good.devices).reshape(4, 2), (FSDP_AXIS, DATA_AXIS)
+        )
+        with pytest.raises(ValueError, match="canonical order"):
+            SpecLayout(mesh=bad)
+
+    def test_rule_naming_missing_axis_rejected(self):
+        with pytest.raises(ValueError, match="not in mesh"):
+            SpecLayout.data_parallel(
+                rules=(("*", P(None, MODEL_AXIS)),)
+            )
+
+    def test_param_shard_axis_must_be_batch_like(self):
+        with pytest.raises(ValueError, match="batch-sharding axis"):
+            SpecLayout(
+                {DATA_AXIS: 4, MODEL_AXIS: 2},
+                param_shard_axis=MODEL_AXIS,
+            )
+
+    def test_param_shard_axis_must_exist(self):
+        with pytest.raises(ValueError, match="not in mesh"):
+            SpecLayout({DATA_AXIS: 8}, param_shard_axis=FSDP_AXIS)
+
+
+# -- wildcard rules --------------------------------------------------------
+
+
+class TestRules:
+    def test_first_match_wins_default_replicated(self):
+        lay = SpecLayout.tensor_parallel(
+            data=4, model=2,
+            rules=(
+                ("*/qkv/kernel", P(None, MODEL_AXIS)),
+                ("*/kernel", P(MODEL_AXIS, None)),
+            ),
+        )
+        assert lay.spec_for("attn/qkv/kernel") == P(None, MODEL_AXIS)
+        assert lay.spec_for("mlp/kernel") == P(MODEL_AXIS, None)
+        assert lay.spec_for("mlp/bias") == P()  # unmatched: replicated
+
+    def test_param_specs_walks_the_tree_by_path(self):
+        import jax.numpy as jnp
+
+        lay = SpecLayout.tensor_parallel(
+            data=4, model=2, rules=(("a/*", P(MODEL_AXIS)),)
+        )
+        tree = {"a": {"x": jnp.zeros(2)}, "b": {"x": jnp.zeros(2)}}
+        specs = lay.param_specs(tree)
+        assert specs["a"]["x"] == P(MODEL_AXIS)
+        assert specs["b"]["x"] == P()
+        shardings = lay.param_shardings(tree)
+        assert isinstance(shardings["a"]["x"], NamedSharding)
+        assert shardings["a"]["x"].spec == P(MODEL_AXIS)
+
+
+# -- shardings -------------------------------------------------------------
+
+
+class TestShardings:
+    def test_sharding_and_replicated(self):
+        lay = SpecLayout.fsdp(data=2, fsdp=4)
+        s = lay.sharding(P(FSDP_AXIS))
+        assert s.mesh == lay.mesh and s.spec == P(FSDP_AXIS)
+        assert lay.replicated.spec == P()
+        assert lay.batch_sharding.spec == P((DATA_AXIS, FSDP_AXIS))
+
+
+# -- legality: named rejections -------------------------------------------
+
+
+class TestLegality:
+    def test_legal_compositions_have_no_reasons(self):
+        assert SpecLayout.data_parallel().reject_reasons() == []
+        assert SpecLayout.zero().reject_reasons(compress="int8") == []
+        assert SpecLayout.fsdp(data=2, fsdp=4).reject_reasons(
+            compress="int8") == []
+
+    def test_composed_grouped_bn_is_named(self):
+        reasons = SpecLayout.fsdp(data=2, fsdp=4).reject_reasons(
+            group_size=2
+        )
+        assert any("grouped BN" in r for r in reasons)
+
+    def test_fsdp_tensor_param_sharding_is_named(self):
+        lay = SpecLayout(
+            {DATA_AXIS: 2, FSDP_AXIS: 2, MODEL_AXIS: 2},
+            param_shard_axis=FSDP_AXIS,
+        )
+        assert any("fsdp×tensor" in r for r in lay.reject_reasons())
+
+    def test_check_raises_with_every_reason(self):
+        with pytest.raises(ValueError, match="grouped BN"):
+            SpecLayout.fsdp(data=2, fsdp=4).check(group_size=2)
+
+    def test_describe_and_repr_are_loggable(self):
+        lay = SpecLayout.fsdp(data=2, fsdp=4)
+        d = lay.describe()
+        assert d["axes"] == {DATA_AXIS: 2, FSDP_AXIS: 4}
+        assert d["param_shard_axis"] == FSDP_AXIS
+        assert "data=2" in repr(lay) and "shard=fsdp" in repr(lay)
+
+    def test_equality_and_hash_follow_mesh_and_rules(self):
+        a = SpecLayout.fsdp(data=2, fsdp=4)
+        b = SpecLayout.fsdp(data=2, fsdp=4)
+        c = SpecLayout.zero()
+        assert a == b and hash(a) == hash(b)
+        assert a != c

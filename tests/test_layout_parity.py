@@ -1,0 +1,258 @@
+"""Composed DP×FSDP SpecLayout trajectory parity (ISSUE 20).
+
+The tentpole claim: ``DataParallel(layout=SpecLayout.fsdp(data=2,
+fsdp=4))`` — batch sharded ``P(('data','fsdp'))``, flat param/opt
+shards over the ``fsdp`` axis, gradients reduce-scattered over ``fsdp``
+then psum'd over ``data`` — is the SAME training program as replicated
+DP and as the 1-D ``zero=True`` preset, just laid out differently.
+Parity is pinned at the trajectory level (losses, params, BN buffers),
+which transitively pins the sharded optimizer state; the composed
+layout must also keep every rider working: wire compression, fused-scan
+K>1, the on-device divergence guard, checkpoint round-trips, and the
+serve engine's sharded store.
+
+SGD+momentum parity is tight (reduction order only); adamw's first
+update is ~lr·sign(g), where reduction-order noise flips signs, so its
+parity is loss-level (the test_zero convention).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import nnx
+from jax.sharding import PartitionSpec as P
+
+from tpu_syncbn import nn as tnn, parallel, serve
+from tpu_syncbn.mesh_axes import DATA_AXIS, FSDP_AXIS
+from tpu_syncbn.parallel import SpecLayout
+
+pytestmark = pytest.mark.layout
+
+
+class TinyNet(nnx.Module):
+    def __init__(self, rngs):
+        self.fc = nnx.Linear(4, 8, rngs=rngs)
+        self.bn = tnn.BatchNorm1d(8)
+        self.out = nnx.Linear(8, 4, rngs=rngs)
+
+    def __call__(self, x):
+        return self.out(jax.nn.relu(self.bn(self.fc(x))))
+
+
+def make_model(seed=0):
+    return tnn.convert_sync_batchnorm(TinyNet(nnx.Rngs(seed)))
+
+
+def loss_fn(m, batch):
+    x, y = batch
+    return ((m(x) - y) ** 2).mean()
+
+
+def make_batch(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.randn(n, 4), jnp.float32),
+        jnp.asarray(rng.randn(n, 4), jnp.float32),
+    )
+
+
+def composed_layout():
+    return SpecLayout.fsdp(data=2, fsdp=4)
+
+
+def make_dp(seed=0, *, layout=None, **kw):
+    return parallel.DataParallel(
+        make_model(seed), kw.pop("opt", optax.sgd(0.1, momentum=0.9)),
+        loss_fn, layout=layout, **kw
+    )
+
+
+def snap(tree):
+    return jax.tree_util.tree_map(lambda x: np.array(x, copy=True), tree)
+
+
+def trees_close(a, b, atol=1e-5):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), atol=atol
+        ),
+        a, b,
+    )
+
+
+# -- trajectory parity -----------------------------------------------------
+
+
+def test_composed_fsdp_matches_replicated_trajectory_sgdm():
+    batches = [make_batch(seed=s) for s in range(3)]
+    results = {}
+    for name, layout in (("dp", None), ("fsdp", composed_layout())):
+        dp = make_dp(layout=layout)
+        losses = [float(dp.train_step(b).loss) for b in batches]
+        results[name] = (losses, snap(dp.params), snap(dp.rest))
+    np.testing.assert_allclose(results["fsdp"][0], results["dp"][0],
+                               rtol=1e-5)
+    trees_close(results["fsdp"][1], results["dp"][1])
+    # SyncBN running statistics: composed stat_axes ('data','fsdp')
+    # reduce over ALL batch replicas, same scope as the 1-D pmean
+    trees_close(results["fsdp"][2], results["dp"][2])
+
+
+def test_composed_fsdp_matches_zero_trajectory_sgdm():
+    batches = [make_batch(seed=s) for s in range(3)]
+    results = {}
+    for name, kw in (("zero", {"zero": True}),
+                     ("fsdp", {"layout": composed_layout()})):
+        dp = make_dp(**kw)
+        losses = [float(dp.train_step(b).loss) for b in batches]
+        results[name] = (losses, snap(dp.params))
+    np.testing.assert_allclose(results["fsdp"][0], results["zero"][0],
+                               rtol=1e-5)
+    trees_close(results["fsdp"][1], results["zero"][1])
+
+
+def test_composed_fsdp_adamw_loss_level_parity():
+    batches = [make_batch(seed=s) for s in range(4)]
+    losses = {}
+    for name, layout in (("dp", None), ("fsdp", composed_layout())):
+        dp = make_dp(layout=layout,
+                     opt=optax.adamw(1e-3, weight_decay=1e-2))
+        losses[name] = [float(dp.train_step(b).loss) for b in batches]
+    np.testing.assert_allclose(losses["fsdp"], losses["dp"], rtol=1e-4)
+
+
+def test_composed_state_is_actually_sharded():
+    dp = make_dp(layout=composed_layout())
+    assert dp.zero is True
+    assert dp.axis_name == (DATA_AXIS, FSDP_AXIS)
+    assert dp.world == 8  # gradient-mean divisor: ALL batch replicas
+    assert dp._shard_world == 4
+    for vec in jax.tree_util.tree_leaves(dp._param_store):
+        spec = vec.sharding.spec
+        assert spec == P(FSDP_AXIS), spec
+        # each device holds 1/F of the flat vector, not 1/world
+        assert vec.addressable_shards[0].data.size * 4 == vec.size
+
+
+def test_composed_int8_compression_converges():
+    dp = make_dp(layout=composed_layout(), compress="int8")
+    losses = [float(dp.train_step(make_batch(seed=s)).loss)
+              for s in range(10)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_composed_ef_residual_keeps_per_replica_storage():
+    # regression: the residual's shard_map specs once used the ctor's
+    # 'data' axis instead of the composed tuple, silently sharing
+    # residuals across the fsdp axis and shrinking the stored leading
+    # dim 8 -> 2 after the first step (which then broke state_dict
+    # round-trips on the SAME layout)
+    dp = make_dp(layout=composed_layout(), compress="int8")
+    dp.train_step(make_batch())
+    residual = dp.opt_state[1]
+    for vec in jax.tree_util.tree_leaves(residual):
+        assert vec.shape[0] == dp.world, vec.shape
+        assert vec.sharding.spec == P((DATA_AXIS, FSDP_AXIS))
+    state = dp.state_dict()
+    dp2 = make_dp(seed=3, layout=composed_layout(), compress="int8")
+    dp2.load_state_dict(state)
+    b = make_batch(seed=5)
+    np.testing.assert_allclose(float(dp2.train_step(b).loss),
+                               float(dp.train_step(b).loss), rtol=1e-6)
+
+
+# -- riders: fused scan, divergence guard, checkpoints ---------------------
+
+
+def test_composed_fused_scan_matches_stepwise():
+    batch = make_batch()
+    dp_scan = make_dp(layout=composed_layout())
+    dp_step = make_dp(layout=composed_layout())
+    out = dp_scan.train_steps(batch, 4)
+    for _ in range(4):
+        last = dp_step.train_step(batch)
+    # train_steps stacks per-step losses (leading dim n_steps)
+    np.testing.assert_allclose(float(np.asarray(out.loss)[-1]),
+                               float(last.loss), rtol=1e-6)
+    trees_close(dp_scan.params, dp_step.params, atol=1e-6)
+
+
+def test_composed_divergence_guard_skips_poisoned_step():
+    dp = make_dp(layout=composed_layout(), divergence_guard="skip_step")
+    batch = make_batch()
+    dp.train_step(batch)
+    before = snap(dp.params)
+    x, y = batch
+    poisoned = (x.at[0, 0].set(jnp.nan), y)
+    out = dp.train_step(poisoned)
+    assert float(out.metrics["nonfinite"]) == 1.0
+    # the on-device guard rolled the sharded update back: params intact
+    trees_close(dp.params, before, atol=0)
+    assert np.isfinite(float(dp.train_step(batch).loss))
+
+
+def test_composed_checkpoint_round_trip_resumes_exactly():
+    batches = [make_batch(seed=s) for s in range(4)]
+    dp = make_dp(layout=composed_layout())
+    for b in batches[:2]:
+        dp.train_step(b)
+    state = dp.state_dict()
+    tail_ref = [float(dp.train_step(b).loss) for b in batches[2:]]
+
+    dp2 = make_dp(seed=7, layout=composed_layout())
+    dp2.load_state_dict(state)
+    tail = [float(dp2.train_step(b).loss) for b in batches[2:]]
+    np.testing.assert_allclose(tail, tail_ref, rtol=1e-6)
+
+
+def test_composed_checkpoint_rejects_other_shard_world():
+    # composed F=4 flat padding != 1-D zero's F=8: resume must be
+    # refused with the layout-mismatch message, not silently misloaded
+    dp = make_dp(layout=composed_layout())
+    dp.train_step(make_batch())
+    state = dp.state_dict()
+    dp_zero = make_dp(zero=True)
+    with pytest.raises(ValueError, match="world size"):
+        dp_zero.load_state_dict(state)
+
+
+# -- the serve engine rides the same layout --------------------------------
+
+
+def test_serve_engine_derives_sharded_store_from_composed_trainer():
+    dp = make_dp(layout=composed_layout())
+    dp.train_step(make_batch())
+    eng = serve.InferenceEngine.from_trainer(dp, buckets=(8,))
+    # the layout came through: flat param store sharded over fsdp
+    assert eng.layout.param_shard_axis == FSDP_AXIS
+    assert eng._flat is not None
+    ref = serve.InferenceEngine(make_model(), buckets=(8,))
+    ref.swap_params(dp.params, rest=dp.rest, version=1)
+    x = np.asarray(make_batch(8, seed=9)[0])
+    np.testing.assert_allclose(
+        np.asarray(eng.predict(x)), np.asarray(ref.predict(x)),
+        atol=1e-5,
+    )
+    # resident storage shrinks by the shard world (plus replicated rest)
+    assert eng.params_nbytes() < ref.params_nbytes()
+
+
+def test_serve_engine_sharded_swap_round_trip():
+    dp = make_dp(layout=composed_layout())
+    dp.train_step(make_batch())
+    eng = serve.InferenceEngine.from_trainer(dp, buckets=(8,))
+    x = np.asarray(make_batch(8, seed=9)[0])
+    out_v1 = np.asarray(eng.predict(x))
+    dp.train_step(make_batch(seed=1))
+    eng.swap_params(dp.params, rest=dp.rest, version=2)
+    out_v2 = np.asarray(eng.predict(x))
+    assert not np.allclose(out_v1, out_v2)
+    eng.rollback()
+    np.testing.assert_allclose(np.asarray(eng.predict(x)), out_v1)
+    # the full-tree template survives the flat store (checkpoint path)
+    t = eng.param_template()
+    assert jax.tree_util.tree_structure(t) \
+        == jax.tree_util.tree_structure(dp.params)

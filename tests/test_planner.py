@@ -164,9 +164,9 @@ class TestEnumeration:
         cands, rejected = planner.enumerate_candidates(
             object(), world=8, batch=16
         )
-        assert {c.kind for c in cands} == {"dp", "dp_zero"}
+        assert {c.kind for c in cands} == {"dp", "dp_zero", "dp_fsdp"}
         kinds = {p.candidate.kind for p in rejected}
-        assert kinds == {"pipeline", "tensor"}
+        assert kinds == {"pipeline", "tensor", "dp_tensor"}
         assert all(p.reject_reason.startswith("model:")
                    for p in rejected)
         assert all(not p.feasible for p in rejected)
@@ -189,6 +189,46 @@ class TestEnumeration:
         [p] = rejected
         assert p.reject_reason == (
             "layout: hidden dim 30 does not divide over the 8-way "
+            "model axis"
+        )
+
+    def test_dp_fsdp_enumerates_every_world_factorization(self):
+        cands, rejected = planner.enumerate_candidates(
+            planner.LayerStack(), world=8, batch=16,
+            include=("dp_fsdp",), compress_modes=("fp32",),
+            scan_ks=(1,),
+        )
+        assert rejected == []
+        axes = {c.mesh_axes for c in cands}
+        assert axes == {
+            (("data", 4), ("fsdp", 2)),
+            (("data", 2), ("fsdp", 4)),
+            (("data", 1), ("fsdp", 8)),
+        }
+
+    def test_dp_fsdp_batch_divisibility_reject_is_named(self):
+        cands, rejected = planner.enumerate_candidates(
+            planner.LayerStack(), world=8, batch=12,
+            include=("dp_fsdp",), compress_modes=("fp32",),
+            scan_ks=(1,),
+        )
+        assert cands == []
+        assert all(
+            p.reject_reason == "layout: batch 12 does not divide over "
+            "the 8-device composed ('data','fsdp') batch axes"
+            for p in rejected
+        )
+
+    def test_dp_tensor_hidden_divisibility_reject_is_named(self):
+        stack = planner.LayerStack(d_hidden=30)
+        cands, rejected = planner.enumerate_candidates(
+            stack, world=8, batch=16, include=("dp_tensor",),
+        )
+        # 30 % 2 == 0: the m=2 factorization survives; m=4 is named
+        assert [c.name for c in cands] == ["dp_tp.d4.m2"]
+        [p] = rejected
+        assert p.reject_reason == (
+            "layout: hidden dim 30 does not divide over the 4-way "
             "model axis"
         )
 
@@ -222,7 +262,8 @@ def ranked():
 class TestPlan:
     def test_ranks_every_strategy_kind_without_compiling(self, ranked):
         kinds = {p.candidate.kind for p in ranked.plans}
-        assert kinds == {"dp", "dp_zero", "pipeline", "tensor"}
+        assert kinds == {"dp", "dp_zero", "dp_fsdp", "dp_tensor",
+                         "pipeline", "tensor"}
         assert all(p.predicted_step_s > 0 for p in ranked.plans)
         assert ranked.best is ranked.plans[0]
 

@@ -347,7 +347,8 @@ def _parse_plan(argv):
     parser = argparse.ArgumentParser(
         prog="python -m tpu_syncbn.audit plan",
         description="Contract-driven parallelism planner: enumerate "
-        "DP / DP+ZeRO / pipeline / tensor layout candidates over the "
+        "DP / DP+ZeRO / DP×FSDP / DP×TP / pipeline / tensor layout "
+        "candidates over the "
         "virtual 8-device mesh, cost each statically from its traced "
         "contract (nothing compiles), and print the ranked "
         "predicted-step-time table (docs/PLANNER.md).",

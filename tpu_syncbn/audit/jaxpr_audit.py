@@ -199,13 +199,12 @@ def _batch_struct(*lead):
 
 
 def _axis_mesh(axis_name: str):
-    """All devices on one named axis — how each strategy module builds
-    its own mesh today (the siloing item 1 will fold into one layout)."""
-    import jax
-    import numpy as np
-    from jax.sharding import Mesh
+    """All devices on one named axis, through the canonical mesh
+    factory (ROADMAP item 1: strategy modules consume the shared
+    layout instead of building private meshes)."""
+    from tpu_syncbn.runtime import distributed as dist
 
-    return Mesh(np.array(jax.devices()), (axis_name,))
+    return dist.make_mesh({axis_name: -1})
 
 
 # ---------------------------------------------------------------------------
@@ -281,6 +280,79 @@ def _dp_scan(k: int) -> ProgramSpec:
         mesh=dp.mesh,
         in_specs=(dp._pspec, dp._rest_spec, dp._opt_spec,
                   scan_driver.stack_batch_spec(P(dp.axis_name))),
+    )
+
+
+def _layout_train_step(kind: str) -> ProgramSpec:
+    """The ISSUE 20 trio: the SAME wide BN-free adam MLP train step
+    under (a) plain DP (replicated params + opt state), (b) composed
+    DP×FSDP on the 2-D ``(data=2, fsdp=4)`` mesh — batch
+    ``P(('data','fsdp'))``, flat param/opt shards over ``fsdp`` — and
+    (c) DP×FSDP with the int8 gradient wire. Adam's two moment slots
+    make optimizer state the dominant resident tensor, so the
+    composed contract's ``peak_bytes_per_device`` dropping below the
+    ``contract.fsdp_peak_memory`` ceiling (≤ 0.6× DP-only) is the
+    memory claim of the layout composition, machine-checked."""
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_syncbn import parallel
+
+    kw: dict = {}
+    if kind == "dp":
+        layout = parallel.SpecLayout.data_parallel()
+    else:
+        layout = parallel.SpecLayout.fsdp(data=-1, fsdp=4)
+        if kind == "dp_fsdp_int8":
+            kw["compress"] = "int8"
+    dp = parallel.DataParallel(
+        _compress_mlp(), optax.adam(1e-3), _mse, layout=layout, **kw
+    )
+    return ProgramSpec(
+        name=f"layout.{kind}.train_step",
+        fn=dp._train_step,
+        example_args=(dp._param_store, dp.rest, dp.opt_state,
+                      _batch_struct(_GLOBAL_BATCH)),
+        arg_labels=("params", "rest", "opt_state", "batch"),
+        # BN-free fixture: `rest` is an empty tree (see the compressed
+        # trio above) — declaring it donated trips donation_lost
+        declared_donated=("params", "opt_state"),
+        world=int(dp.mesh.size),
+        mesh=dp.mesh,
+        in_specs=(dp._pspec, dp._rest_spec, dp._opt_spec,
+                  P(dp.axis_name)),
+    )
+
+
+def _layout_serve_eval() -> ProgramSpec:
+    """The fsdp-composed serving program (ISSUE 20 satellite bugfix):
+    an engine derived from a param-sharding layout stores flat
+    1/shard_world shards and gathers them INSIDE the eval program —
+    the pinned ``max_replicated_bytes`` is the gathered tree, not a
+    replicated resident input."""
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_syncbn import parallel
+    from tpu_syncbn.serve.engine import InferenceEngine
+
+    import jax
+
+    layout = parallel.SpecLayout.fsdp(data=-1, fsdp=4)
+    eng = InferenceEngine(_tiny_model(), layout=layout, buckets=(8,))
+    fn = jax.jit(eng._sharded_fwd())
+    batch = _batch_struct(8)
+    pspec = {dt: P(layout.param_shard_axis)
+             for dt in eng._flat.shard_sizes}
+    return ProgramSpec(
+        name="layout.serve.eval_fsdp",
+        fn=fn,
+        example_args=(eng._params, eng._rest, batch),
+        arg_labels=("params", "rest", "batch"),
+        declared_donated=(),
+        world=int(eng.mesh.size),
+        mesh=eng.mesh,
+        in_specs=(pspec, P(), P(eng.axis_name)),
     )
 
 
@@ -465,21 +537,22 @@ def _serve_redistribute() -> ProgramSpec:
     gather smuggled back in as a giant constant."""
     import jax
     from flax import nnx
-    from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
+    from tpu_syncbn.parallel.layout import SpecLayout
     from tpu_syncbn.parallel.redistribute import build_redistribute
     from tpu_syncbn.parallel.zero import FlatLayout
     from tpu_syncbn.runtime.distributed import DATA_AXIS
 
-    mesh = _axis_mesh(DATA_AXIS)
+    speclay = SpecLayout.zero()
+    mesh = speclay.mesh
     world = int(mesh.shape[DATA_AXIS])
     model = _tiny_model()
     params = nnx.state(model, nnx.Param)
     layout = FlatLayout(params, world)
     store = jax.device_put(
         layout.flatten(params),
-        NamedSharding(mesh, P(DATA_AXIS)),
+        speclay.sharding(P(DATA_AXIS)),
     )
     return ProgramSpec(
         name="serve.redistribute",
@@ -569,14 +642,13 @@ def _pipeline_train(schedule: str) -> ProgramSpec:
     import jax.numpy as jnp
     import numpy as np
     import optax
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from tpu_syncbn.mesh_axes import DATA_AXIS, PIPE_AXIS
     from tpu_syncbn.parallel import pipeline
 
     n, m, mb = 4, 4, 2  # stages, microbatches, per-replica microbatch
-    devs = np.array(jax.devices())
-    mesh = Mesh(devs.reshape(devs.size // n, n), (DATA_AXIS, PIPE_AXIS))
+    mesh = pipeline.pipeline_mesh(n)
     d = _FEATURES
     data_world = int(mesh.shape[DATA_AXIS])
 
@@ -608,7 +680,7 @@ def _pipeline_train(schedule: str) -> ProgramSpec:
         example_args=(tr._param_store, tr.opt_state, batch),
         arg_labels=("params", "opt_state", "batch"),
         declared_donated=("params", "opt_state"),
-        world=devs.size,
+        world=int(mesh.size),
         mesh=mesh,
         in_specs=(tr._pspec, tr._opt_spec, P(None, DATA_AXIS)),
     )
@@ -697,6 +769,11 @@ PROGRAM_BUILDERS: dict[str, Callable[[], ProgramSpec]] = {
         lambda: _autopilot_train_step("bf16"),
     "autopilot.compressed_int8.train_step":
         lambda: _autopilot_train_step("int8"),
+    "layout.dp.train_step": lambda: _layout_train_step("dp"),
+    "layout.dp_fsdp.train_step": lambda: _layout_train_step("dp_fsdp"),
+    "layout.dp_fsdp_int8.train_step":
+        lambda: _layout_train_step("dp_fsdp_int8"),
+    "layout.serve.eval_fsdp": _layout_serve_eval,
     "syncbn.compressed_stats": _syncbn_compressed_stats,
     "gan.train_step": _gan_train_step,
     "serve.eval_bucket8": _serve_eval_bucket,
@@ -825,6 +902,25 @@ def check_invariants(
               f"pipeline.train_{sched} gathers instead of ringing "
               f"({gathered}) — a stage materialized another stage's "
               "state")
+
+    # ISSUE 20: the composed DP×FSDP layout's memory claim. Sharding
+    # params + adam moments 1/fsdp-world has to show up as per-device
+    # peak memory — if the composed program's peak creeps back toward
+    # the DP-only program's (a gather that outlives its use, opt state
+    # replicated by accident), the layout stopped paying for itself.
+    dp_l = contracts.get("layout.dp.train_step")
+    fs_l = contracts.get("layout.dp_fsdp.train_step")
+    if (dp_l is not None and fs_l is not None
+            and dp_l.sharding is not None and fs_l.sharding is not None):
+        dp_peak = dp_l.sharding.peak_bytes_per_device
+        fs_peak = fs_l.sharding.peak_bytes_per_device
+        if fs_peak > 0.6 * dp_peak:
+            v("contract.fsdp_peak_memory",
+              "composed DP×FSDP train step must hold per-device peak "
+              f"memory ≤ 0.6× the DP-only program, found {fs_peak} vs "
+              f"{dp_peak} bytes (ratio {fs_peak / max(1, dp_peak):.2f})"
+              " — flat param/opt shards are no longer paying for the "
+              "composition")
 
     moe = contracts.get("expert.switch_moe")
     if moe is not None and moe.collectives.get("all_to_all", 0) != 2:

@@ -1159,6 +1159,68 @@ def check_hardcoded_mesh_axis(
 
 
 # ---------------------------------------------------------------------------
+# rule: private_mesh_plumbing
+
+#: Sharding-constructor call targets the rule polices. Annotations
+#: (``x: NamedSharding``) and isinstance checks are fine — the hazard
+#: is CONSTRUCTING one, which births a private mesh/spec universe.
+_MESH_CTOR_NAMES = frozenset({"Mesh", "AbstractMesh", "NamedSharding"})
+
+#: File suffixes allowed to construct them: the layout layer itself.
+#: ``compat.py`` (version-portable shard_map shims), ``parallel/
+#: layout.py`` (SpecLayout — the ONE object that owns mesh+specs),
+#: ``runtime/distributed.py`` (``make_mesh``, the device-enumeration
+#: factory SpecLayout builds on) and the axis-constants module.
+_PRIVATE_MESH_ALLOW = (
+    "tpu_syncbn/compat.py",
+    "tpu_syncbn/parallel/layout.py",
+    "tpu_syncbn/runtime/distributed.py",
+    "tpu_syncbn/mesh_axes.py",
+)
+
+
+def check_private_mesh_plumbing(
+    tree: ast.AST, path: str, src_lines: Sequence[str]
+) -> list[Violation]:
+    """``private_mesh_plumbing``: a ``Mesh`` / ``AbstractMesh`` /
+    ``NamedSharding`` constructed outside the layout layer.
+
+    ISSUE 20's composition contract: trainers, engines and strategy
+    modules CONSUME a :class:`tpu_syncbn.parallel.SpecLayout` (or the
+    ``runtime.distributed.make_mesh`` factory it builds on) instead of
+    assembling their own mesh and shardings. A private ``Mesh(...)`` or
+    ``NamedSharding(...)`` is exactly the siloing that made DP, ZeRO,
+    TP and pipeline four incompatible programs: each module's axes and
+    specs live in its own universe, so nothing composes on one mesh.
+    Route through ``layout.sharding(spec)`` / the SpecLayout presets;
+    the layout carries the mesh, the batch spec, the param rules and
+    the derived reduce axes as one object."""
+    norm = path.replace(os.sep, "/")
+    if any(norm.endswith(suffix) for suffix in _PRIVATE_MESH_ALLOW):
+        return []
+    out: list[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        fname = func.id if isinstance(func, ast.Name) else (
+            func.attr if isinstance(func, ast.Attribute) else None
+        )
+        if fname in _MESH_CTOR_NAMES:
+            out.append(Violation(
+                rule="private_mesh_plumbing", path=path,
+                line=node.lineno, col=node.col_offset,
+                message=f"{fname}(...) constructed outside the layout "
+                        "layer — consume a parallel.SpecLayout "
+                        "(layout.sharding(spec), the presets, or "
+                        "runtime.distributed.make_mesh); a private "
+                        "mesh is the siloing that keeps DP/FSDP/TP/"
+                        "pipe from composing into one program",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # rule: lossy_default_mode
 
 #: Parameter names that carry a wire-compression mode anywhere in the
@@ -1229,6 +1291,7 @@ RULES: dict[str, Callable] = {
     "wallclock_duration": check_wallclock_duration,
     "unbounded_blocking": check_unbounded_blocking,
     "hardcoded_mesh_axis": check_hardcoded_mesh_axis,
+    "private_mesh_plumbing": check_private_mesh_plumbing,
     "lossy_default_mode": check_lossy_default_mode,
 }
 

@@ -57,10 +57,19 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
     )
 
 
-def axis_size(axis_name: str):
+def axis_size(axis_name):
     """``lax.axis_size`` where available; otherwise the classic
     ``psum(1, axis)`` identity (folded to a static constant at trace
-    time — no runtime collective)."""
+    time — no runtime collective). A tuple/list of axis names yields the
+    product of the per-axis sizes — the total replica count of a composed
+    layout like ``('data', 'fsdp')`` — and raises the same
+    NameError/KeyError as the single-axis form when *any* member axis is
+    out of scope (callers probing scope rely on that)."""
+    if isinstance(axis_name, (tuple, list)):
+        size = 1
+        for a in axis_name:
+            size = size * axis_size(a)
+        return size
     if hasattr(jax.lax, "axis_size"):
         return jax.lax.axis_size(axis_name)
     return jax.lax.psum(1, axis_name)

@@ -667,10 +667,12 @@ def device_prefetch(
         # ONE definition of the K-stacked layout rule, shared with
         # DataParallel.scan_batch_sharding — drift here would stage
         # chunks train_steps_batches can't consume without a reshard
+        from tpu_syncbn.parallel.layout import SpecLayout
         from tpu_syncbn.parallel.scan_driver import stack_batch_spec
 
-        sharding = NamedSharding(sharding.mesh,
-                                 stack_batch_spec(sharding.spec))
+        sharding = SpecLayout.from_mesh(
+            sharding.mesh, param_shard_axis=None
+        ).sharding(stack_batch_spec(sharding.spec))
 
     def put(batch):
         if not to_device:

@@ -157,19 +157,38 @@ def broadcast(tree: Pytree, src: int = 0, axis_name: str = DATA_AXIS) -> Pytree:
 
     SPMD formulation: gather all replicas' values and select ``src``'s.
     XLA folds the gather+index; for the init-time use the cost is a one-off.
+
+    ``axis_name`` may be a tuple of mesh axes (a composed layout such as
+    ``('data', 'fsdp')``): ``src`` is then a linear rank decomposed
+    row-major over the axes in the order given, and the masked psum runs
+    over all of them at once.
     """
     _tally("broadcast", tree)
-    size = _compat_axis_size(axis_name)  # static at trace time
+    size = int(_compat_axis_size(axis_name))  # static at trace time
     if not -size <= src < size:
         raise ValueError(
             f"broadcast src={src} out of range for axis {axis_name!r} of size {size}"
         )
     src = src % size
     # psum of the masked value: no world_size× gather buffer, one AllReduce.
-    is_src = lax.axis_index(axis_name) == src
+    if isinstance(axis_name, (tuple, list)):
+        axes = tuple(axis_name)
+        sizes = [int(_compat_axis_size(a)) for a in axes]
+        coords, rem = [], src
+        for n in reversed(sizes):
+            coords.append(rem % n)
+            rem //= n
+        coords.reverse()
+        is_src = jnp.bool_(True)
+        for a, c in zip(axes, coords):
+            is_src = jnp.logical_and(is_src, lax.axis_index(a) == c)
+        psum_axes: object = axes
+    else:
+        is_src = lax.axis_index(axis_name) == src
+        psum_axes = axis_name
 
     def one(x):
-        return lax.psum(jnp.where(is_src, x, jnp.zeros_like(x)), axis_name)
+        return lax.psum(jnp.where(is_src, x, jnp.zeros_like(x)), psum_axes)
 
     return jax.tree_util.tree_map(one, tree)
 
@@ -187,10 +206,13 @@ def pcast_varying(tree: Pytree, axis_name: str = DATA_AXIS) -> Pytree:
     if not compat.HAS_VMA:
         return tree  # pre-VMA jax: no varying type to cast to
 
+    axes = tuple(axis_name) if isinstance(axis_name, (tuple, list)) else (axis_name,)
+
     def leaf(x):
-        if axis_name in getattr(jax.typeof(x), "vma", frozenset()):
-            return x
-        return lax.pcast(x, axis_name, to="varying")
+        for a in axes:
+            if a not in getattr(jax.typeof(x), "vma", frozenset()):
+                x = lax.pcast(x, a, to="varying")
+        return x
 
     return jax.tree_util.tree_map(leaf, tree)
 
@@ -514,6 +536,12 @@ def reduce_moments(
     check_compress_mode(mode)
     triple = (local_sum, local_sumsq, local_count)
     if group_size is not None:
+        if isinstance(axis_name, (tuple, list)):
+            raise ValueError(
+                "group-scoped SyncBN stats need a single stat axis — the "
+                "butterfly group reduction is 1-D; a composed layout "
+                f"syncs over {tuple(axis_name)}"
+            )
         if mode != "none":
             raise ValueError(
                 "compressed SyncBN stats (mode="

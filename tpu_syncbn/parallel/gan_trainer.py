@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from flax import nnx
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_syncbn import compat
 from tpu_syncbn.compat import shard_map
@@ -70,6 +70,7 @@ class GANTrainer:
         loss: str = "bce",
         mesh: Mesh | None = None,
         axis_name: str = DATA_AXIS,
+        layout=None,
         donate: bool = True,
         monitors: bool | str = True,
         compress: str = "none",
@@ -100,8 +101,38 @@ class GANTrainer:
         self._discriminator = discriminator
         self.monitors = monitors
         self.loss_pair = LOSSES[loss]
-        self.mesh = mesh if mesh is not None else dist.data_parallel_mesh()
-        self.axis_name = axis_name
+        from tpu_syncbn.parallel.layout import SpecLayout
+
+        # consume a SpecLayout (ROADMAP item 1); the legacy mesh/axis
+        # kwargs wrap into the equivalent replicated-param layout. GAN
+        # state is replicated (no ZeRO slot — see `compress` above), so
+        # only the batch axes compose here.
+        if layout is None:
+            if mesh is not None:
+                layout = SpecLayout.from_mesh(mesh, param_shard_axis=None)
+            else:
+                layout = SpecLayout.data_parallel()
+        elif mesh is not None and mesh != layout.mesh:
+            raise ValueError(
+                "pass either layout= or mesh=, not both — the layout owns "
+                "the mesh"
+            )
+        if layout.param_shard_axis is not None:
+            raise ValueError(
+                "GANTrainer keeps params replicated — use a layout "
+                "without a param shard axis"
+            )
+        layout.check(compress=compress)
+        self.layout = layout
+        self.mesh = layout.mesh
+        self.axis_name = (
+            layout.stat_axes if layout.stat_axes is not None else axis_name
+        )
+        if isinstance(self.axis_name, tuple):
+            from tpu_syncbn.parallel.trainer import _rewire_syncbn_axes
+
+            _rewire_syncbn_axes(generator, self.axis_name)
+            _rewire_syncbn_axes(discriminator, self.axis_name)
         self.g_opt = g_optimizer
         self.d_opt = d_optimizer
 
@@ -119,8 +150,8 @@ class GANTrainer:
         self.g_opt_state = g_optimizer.init(g_params)
         self.d_opt_state = d_optimizer.init(d_params)
 
-        replicated = NamedSharding(self.mesh, P())
-        self.batch_sharding = NamedSharding(self.mesh, P(axis_name))
+        replicated = layout.replicated
+        self.batch_sharding = layout.batch_sharding
         put = lambda t: jax.device_put(t, replicated)
         self.g_params, self.g_rest = put(g_params), put(g_rest)
         self.d_params, self.d_rest = put(d_params), put(d_rest)
@@ -397,7 +428,7 @@ class GANTrainer:
         }
 
     def load_state_dict(self, state: dict) -> None:
-        put = lambda t: jax.device_put(t, NamedSharding(self.mesh, P()))
+        put = lambda t: jax.device_put(t, self.layout.replicated)
         self.g_params, self.g_rest = put(state["g_params"]), put(state["g_rest"])
         self.d_params, self.d_rest = put(state["d_params"]), put(state["d_rest"])
         self.g_opt_state = put(state["g_opt_state"])
@@ -429,7 +460,7 @@ class GANTrainer:
                     check_vma=self._check_vma,
                 )
             )
-        world = int(self.mesh.shape[self.axis_name])
+        world = self.layout.replica_world
         n = None
         if not (hasattr(z, "sharding") and getattr(z, "is_fully_addressable", True) is False):
             z = jnp.asarray(z)

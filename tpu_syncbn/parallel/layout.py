@@ -1,0 +1,394 @@
+"""One named-sharding layout for the whole program: :class:`SpecLayout`.
+
+ROADMAP item 1. The parallelism layer grew one strategy per module —
+``zero.py`` (optimizer-state sharding), ``tensor.py`` (param rules),
+``pipeline.py`` (stage axis), plain DP — each constructing its *own*
+``Mesh``/``NamedSharding`` plumbing, so strategies could be ranked but
+never combined. ``SpecLayout`` is the composition point: one named N-D
+mesh (axes canonically from :mod:`tpu_syncbn.mesh_axes`), per-param
+``PartitionSpec`` rules with wildcard name matching, and *derived*
+reduce/scatter axes for gradients, optimizer state, and SyncBN
+statistics. Trainers and the serve engine consume a layout instead of
+building meshes (srclint ``private_mesh_plumbing`` polices this), so
+``P(('data','fsdp'))`` batch sharding, fsdp-sharded optimizer state,
+tensor-parallel param rules, and the pipe axis compose on one mesh in
+one compiled program.
+
+Following arXiv:2004.13336, ZeRO is a layout *rule* here, not a trainer
+mode: ``zero=True`` is the :meth:`SpecLayout.zero` preset (shard the
+weight update over the lone data axis), and DP×FSDP is the
+:meth:`SpecLayout.fsdp` preset (shard over a dedicated ``fsdp`` axis,
+reduce the rest of the way over ``data``). Derived axes:
+
+* ``stat_axes`` — SyncBN statistics reduce over *every* batch-sharding
+  axis (the paper's point: statistics scope = all replicas, and a
+  composed layout has replicas on more than one mesh axis).
+* ``grad_reduce_axes`` — full gradient reduction axes for unsharded
+  params (plain DP pmean).
+* ``grad_scatter_axis`` / ``grad_cross_axes`` — for sharded layouts the
+  gradient is reduce-scattered over the shard axis first (full→1/F
+  bytes), then the surviving shard is psum'd over the remaining batch
+  axes. ``compressed_reduce_scatter``/``compressed_psum`` ride these
+  same axes, which is what makes ``compress="int8"`` legal in every
+  composition.
+
+Layout legality is explicit: :meth:`reject_reasons` names why a
+composition is infeasible (the planner surfaces these verbatim), instead
+of failing deep inside a trainer.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Any, Iterable, Mapping, Sequence
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_syncbn.mesh_axes import (
+    ALL_AXES,
+    DATA_AXIS,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+)
+
+__all__ = ["SpecLayout"]
+
+#: Axes whose mesh dimension shards the *batch* (replica-like axes). A
+#: composed layout's SyncBN/gradient reductions span all of these.
+_BATCH_AXES = (DATA_AXIS, FSDP_AXIS)
+
+#: int8 compressed collectives encode the reduction in an i8 accumulator
+#: budget: qmax = 127 // world (collectives._int8_qparams).
+_INT8_MAX_WORLD = 127
+
+
+def _rank_name(entry: Any) -> Iterable[str]:
+    """Axis names referenced by one PartitionSpec entry."""
+    if entry is None:
+        return ()
+    if isinstance(entry, str):
+        return (entry,)
+    return tuple(entry)
+
+
+class SpecLayout:
+    """A named mesh plus the sharding rules every consumer derives from.
+
+    Parameters
+    ----------
+    axis_sizes:
+        Mapping of canonical axis name (:data:`~tpu_syncbn.mesh_axes.ALL_AXES`)
+        to mesh dimension. At most one entry may be ``-1`` ("all remaining
+        devices"). Ignored when ``mesh`` is given.
+    rules:
+        Sequence of ``(pattern, PartitionSpec)`` pairs matched against
+        ``/``-joined parameter paths with :func:`fnmatch.fnmatchcase`
+        (first match wins; unmatched params are replicated). This is how
+        tensor-parallel layouts name their sharded matrices, e.g.
+        ``("*/attn/qkv/kernel", P(None, 'model'))``.
+    param_shard_axis:
+        Mesh axis the flat parameter/optimizer-state shards live on
+        (ZeRO/FSDP), or ``None`` for replicated params. The default
+        ``"auto"`` picks the ``fsdp`` axis when the mesh has one.
+    devices:
+        Optional explicit device sequence (defaults to ``jax.devices()``).
+    mesh:
+        Adopt an existing mesh instead of building one. Its axis names
+        must be canonical and in :data:`ALL_AXES` order.
+    """
+
+    def __init__(
+        self,
+        axis_sizes: Mapping[str, int] | None = None,
+        *,
+        rules: Sequence[tuple[str, P]] = (),
+        param_shard_axis: str | None = "auto",
+        devices: Sequence[Any] | None = None,
+        mesh: Any | None = None,
+    ) -> None:
+        from tpu_syncbn.runtime import distributed as dist
+
+        if mesh is not None:
+            names = tuple(mesh.axis_names)
+        else:
+            if not axis_sizes:
+                axis_sizes = {DATA_AXIS: -1}
+            names = tuple(axis_sizes)
+        unknown = [a for a in names if a not in ALL_AXES]
+        if unknown:
+            raise ValueError(
+                f"unknown mesh axes {unknown}; canonical axes are {list(ALL_AXES)}"
+                " (tpu_syncbn.mesh_axes)"
+            )
+        order = sorted(names, key=ALL_AXES.index)
+        if mesh is not None:
+            if tuple(order) != names:
+                raise ValueError(
+                    f"mesh axes {list(names)} out of canonical order; expected"
+                    f" {order} (data-like outermost — mesh_axes.ALL_AXES)"
+                )
+            self.mesh = mesh
+        else:
+            sizes = {a: int(axis_sizes[a]) for a in order}
+            self.mesh = dist.make_mesh(sizes, devices=devices)
+
+        self.axis_sizes: dict[str, int] = {
+            a: int(self.mesh.shape[a]) for a in self.mesh.axis_names
+        }
+        self.rules: tuple[tuple[str, P], ...] = tuple(
+            (str(pat), spec) for pat, spec in rules
+        )
+        for pat, spec in self.rules:
+            for entry in spec:
+                for a in _rank_name(entry):
+                    if a not in self.axis_sizes:
+                        raise ValueError(
+                            f"rule {pat!r} names axis {a!r} not in mesh"
+                            f" {list(self.axis_sizes)}"
+                        )
+
+        if param_shard_axis == "auto":
+            param_shard_axis = FSDP_AXIS if FSDP_AXIS in self.axis_sizes else None
+        if param_shard_axis is not None:
+            if param_shard_axis not in self.axis_sizes:
+                raise ValueError(
+                    f"param_shard_axis {param_shard_axis!r} not in mesh"
+                    f" {list(self.axis_sizes)}"
+                )
+            if param_shard_axis not in _BATCH_AXES:
+                raise ValueError(
+                    f"param_shard_axis {param_shard_axis!r} must be a"
+                    f" batch-sharding axis {list(_BATCH_AXES)}: flat ZeRO/FSDP"
+                    " shards divide the *replicated* weight update"
+                )
+        self.param_shard_axis: str | None = param_shard_axis
+
+        # ---- derived axes --------------------------------------------
+        #: batch-sharding axes present in the mesh, canonical order
+        self.data_axes: tuple[str, ...] = tuple(
+            a for a in _BATCH_AXES if a in self.axis_sizes
+        )
+        #: the PartitionSpec *entry* for the batch dimension: a plain
+        #: string for 1-D layouts (keeps single-axis programs and their
+        #: pinned goldens byte-identical), a tuple when composed, None
+        #: when the mesh has no batch axis (pure TP serving)
+        self.batch_entry: str | tuple[str, ...] | None = None
+        if len(self.data_axes) == 1:
+            self.batch_entry = self.data_axes[0]
+        elif self.data_axes:
+            self.batch_entry = self.data_axes
+        #: axes SyncBN statistics reduce over (== batch axes)
+        self.stat_axes = self.batch_entry
+        #: axes a full (unsharded) gradient pmean runs over
+        self.grad_reduce_axes = self.batch_entry
+        #: axis the flat grad is reduce-scattered over (None: no scatter)
+        self.grad_scatter_axis = param_shard_axis
+        #: batch axes left to psum after the scatter stage
+        self.grad_cross_axes: tuple[str, ...] = tuple(
+            a for a in self.data_axes if a != param_shard_axis
+        )
+        #: total number of batch replicas (gradient-mean divisor)
+        self.replica_world: int = 1
+        for a in self.data_axes:
+            self.replica_world *= self.axis_sizes[a]
+        #: devices each flat param shard is divided over
+        self.shard_world: int = (
+            self.axis_sizes[param_shard_axis] if param_shard_axis else 1
+        )
+        #: total devices in the mesh
+        self.world: int = int(self.mesh.size)
+
+    # ---- constructors (the presets) ----------------------------------
+
+    @classmethod
+    def data_parallel(
+        cls, num_replicas: int | None = None, *, devices=None, rules=()
+    ) -> "SpecLayout":
+        """Plain DP: 1-D ``data`` mesh, replicated params."""
+        return cls(
+            {DATA_AXIS: -1 if num_replicas is None else num_replicas},
+            rules=rules, param_shard_axis=None, devices=devices,
+        )
+
+    @classmethod
+    def zero(
+        cls, num_replicas: int | None = None, *, devices=None
+    ) -> "SpecLayout":
+        """Today's ``zero=True``: 1-D ``data`` mesh, flat param/opt shards
+        over the same axis (parity-pinned against the legacy flag)."""
+        return cls(
+            {DATA_AXIS: -1 if num_replicas is None else num_replicas},
+            param_shard_axis=DATA_AXIS, devices=devices,
+        )
+
+    @classmethod
+    def fsdp(
+        cls, *, data: int = -1, fsdp: int, devices=None, rules=()
+    ) -> "SpecLayout":
+        """Composed DP×FSDP: 2-D ``('data','fsdp')`` mesh, batch sharded
+        ``P(('data','fsdp'))``, flat param/opt shards over ``fsdp``."""
+        return cls(
+            {DATA_AXIS: data, FSDP_AXIS: fsdp},
+            param_shard_axis=FSDP_AXIS, devices=devices, rules=rules,
+        )
+
+    @classmethod
+    def tensor_parallel(
+        cls, *, data: int = -1, model: int, rules: Sequence[tuple[str, P]],
+        devices=None,
+    ) -> "SpecLayout":
+        """Composed DP×TP: 2-D ``('data','model')`` mesh; ``rules`` name
+        the tensor-sharded params."""
+        return cls(
+            {DATA_AXIS: data, MODEL_AXIS: model},
+            rules=rules, param_shard_axis=None, devices=devices,
+        )
+
+    @classmethod
+    def from_mesh(
+        cls, mesh, *, rules=(), param_shard_axis: str | None = "auto"
+    ) -> "SpecLayout":
+        """Wrap an existing canonical-axis mesh (e.g. ``pipeline_mesh``)."""
+        return cls(mesh=mesh, rules=rules, param_shard_axis=param_shard_axis)
+
+    # ---- shardings ----------------------------------------------------
+
+    def sharding(self, spec: P) -> NamedSharding:
+        """A ``NamedSharding`` of ``spec`` on this layout's mesh — the one
+        place trainers/engines get shardings from."""
+        return NamedSharding(self.mesh, spec)
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return self.sharding(P())
+
+    @property
+    def batch_spec(self) -> P:
+        """Leading-dim batch spec: ``P('data')``, ``P(('data','fsdp'))``…"""
+        return P(self.batch_entry) if self.batch_entry is not None else P()
+
+    @property
+    def batch_sharding(self) -> NamedSharding:
+        return self.sharding(self.batch_spec)
+
+    # ---- per-param rules ----------------------------------------------
+
+    def spec_for(self, name: str) -> P:
+        """PartitionSpec for one ``/``-joined param path (first matching
+        wildcard rule wins; default replicated)."""
+        for pat, spec in self.rules:
+            if fnmatch.fnmatchcase(name, pat):
+                return spec
+        return P()
+
+    def param_specs(self, tree) -> Any:
+        """Tree of PartitionSpecs matching ``tree``, one per leaf, from
+        the wildcard rules."""
+        paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        specs = [self.spec_for(_path_str(path)) for path, _ in paths_leaves]
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+    def param_shardings(self, tree) -> Any:
+        return jax.tree_util.tree_map(
+            self.sharding, self.param_specs(tree),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    # ---- legality ------------------------------------------------------
+
+    def reject_reasons(
+        self, *, compress: str = "none", group_size: int | None = None
+    ) -> list[str]:
+        """Why this layout (with these knobs) cannot train — empty when
+        legal. Reasons are stable strings the planner reports verbatim."""
+        reasons: list[str] = []
+        if compress == "int8":
+            if self.shard_world > _INT8_MAX_WORLD:
+                reasons.append(
+                    f"layout: int8 accumulator budget needs shard world"
+                    f" <= {_INT8_MAX_WORLD}, got {self.shard_world}"
+                )
+            cross = 1
+            for a in self.grad_cross_axes:
+                cross *= self.axis_sizes[a]
+            if self.param_shard_axis is None:
+                cross = self.replica_world
+            if cross > _INT8_MAX_WORLD:
+                reasons.append(
+                    f"layout: int8 accumulator budget needs reduce world"
+                    f" <= {_INT8_MAX_WORLD}, got {cross}"
+                )
+        if group_size is not None and isinstance(self.stat_axes, tuple):
+            reasons.append(
+                "layout: grouped BN stats need a single stat axis"
+                " (the butterfly permutation is 1-D); composed layout"
+                f" syncs over {self.stat_axes}"
+            )
+        if self.param_shard_axis is not None and MODEL_AXIS in self.axis_sizes:
+            reasons.append(
+                "layout: fsdp×tensor param sharding not implemented"
+                " (flat ZeRO shards and per-param rules both own the params)"
+            )
+        if self.param_shard_axis is not None and PIPE_AXIS in self.axis_sizes:
+            reasons.append(
+                "layout: fsdp×pipe not implemented (PipelineTrainer"
+                " shards params over the pipe axis)"
+            )
+        if not self.data_axes and self.param_shard_axis is not None:
+            reasons.append("layout: param sharding needs a batch axis")
+        return reasons
+
+    def check(self, *, compress: str = "none", group_size=None) -> None:
+        """Raise ``ValueError`` with every named reason when illegal."""
+        reasons = self.reject_reasons(compress=compress, group_size=group_size)
+        if reasons:
+            raise ValueError("; ".join(reasons))
+
+    # ---- misc ----------------------------------------------------------
+
+    def describe(self) -> dict:
+        """Loggable summary (docs/LAYOUT.md table rows come from this)."""
+        return {
+            "axes": dict(self.axis_sizes),
+            "batch_spec": str(self.batch_spec),
+            "param_shard_axis": self.param_shard_axis,
+            "grad_cross_axes": list(self.grad_cross_axes),
+            "replica_world": self.replica_world,
+            "shard_world": self.shard_world,
+            "rules": [(pat, str(spec)) for pat, spec in self.rules],
+        }
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, SpecLayout):
+            return NotImplemented
+        return (
+            self.mesh == other.mesh
+            and self.rules == other.rules
+            and self.param_shard_axis == other.param_shard_axis
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.mesh, self.rules, self.param_shard_axis))
+
+    def __repr__(self) -> str:
+        axes = ",".join(f"{a}={n}" for a, n in self.axis_sizes.items())
+        shard = f", shard={self.param_shard_axis}" if self.param_shard_axis else ""
+        nrules = f", rules={len(self.rules)}" if self.rules else ""
+        return f"SpecLayout({axes}{shard}{nrules})"
+
+
+def _path_str(path) -> str:
+    """``/``-joined name for one tree_flatten_with_path key path."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)

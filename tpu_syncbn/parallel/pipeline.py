@@ -213,15 +213,16 @@ def pipeline_mesh(
     devices reshaped to ``(world // n_stages, n_stages)``, the data
     axis outermost (DP replicas of the whole pipeline, each pipeline a
     contiguous ring of ``n_stages`` devices)."""
-    devs = np.array(jax.devices())
-    if devs.size % n_stages:
+    from tpu_syncbn.runtime import distributed as dist
+
+    ndev = len(jax.devices())
+    if ndev % n_stages:
         raise ValueError(
-            f"{devs.size} devices do not split into pipelines of "
+            f"{ndev} devices do not split into pipelines of "
             f"{n_stages} stages"
         )
-    return Mesh(
-        devs.reshape(devs.size // n_stages, n_stages),
-        (data_axis, pipe_axis),
+    return dist.make_mesh(
+        {data_axis: ndev // n_stages, pipe_axis: n_stages}
     )
 
 
@@ -296,6 +297,7 @@ class PipelineTrainer:
         num_microbatches: int,
         schedule="1f1b",
         mesh: Mesh | None = None,
+        layout=None,
         data_axis: str = DATA_AXIS,
         pipe_axis: str = PIPE_AXIS,
         divergence_guard: str | None = None,
@@ -334,9 +336,33 @@ class PipelineTrainer:
         self.optimizer = optimizer
         self.data_axis = data_axis
         self.pipe_axis = pipe_axis
-        self.mesh = mesh if mesh is not None else pipeline_mesh(
-            self.n_stages, data_axis, pipe_axis
-        )
+        from tpu_syncbn.parallel.layout import SpecLayout
+
+        # the one mesh + sharding source (ROADMAP item 1): an explicit
+        # SpecLayout, a wrapped legacy mesh, or the default 2-D
+        # (data x pipe) pipeline mesh. Stage params shard over the pipe
+        # axis by per-leaf staging, not by flat ZeRO shards, so the
+        # layout stays param_shard_axis=None here (fsdp×pipe is a named
+        # illegal composition — SpecLayout.reject_reasons).
+        if layout is None:
+            layout = SpecLayout.from_mesh(
+                mesh if mesh is not None else pipeline_mesh(
+                    self.n_stages, data_axis, pipe_axis
+                ),
+                param_shard_axis=None,
+            )
+        elif mesh is not None and mesh != layout.mesh:
+            raise ValueError(
+                "pass either layout= or mesh=, not both — the layout "
+                "owns the mesh"
+            )
+        if layout.param_shard_axis is not None:
+            raise ValueError(
+                "; ".join(layout.reject_reasons()) or
+                "PipelineTrainer needs a layout without a param shard axis"
+            )
+        self.layout = layout
+        self.mesh = layout.mesh
         for ax in (data_axis, pipe_axis):
             if ax not in self.mesh.shape:
                 raise ValueError(
@@ -359,7 +385,7 @@ class PipelineTrainer:
         # a global view across parameters would diverge per-stage.
         check_elementwise(optimizer)
         self._pspec = P(pipe_axis)
-        self._param_sharding = NamedSharding(self.mesh, self._pspec)
+        self._param_sharding = self.layout.sharding(self._pspec)
         self._param_store = jax.device_put(
             stacked_params, self._param_sharding
         )
@@ -373,7 +399,7 @@ class PipelineTrainer:
             self._opt_staged,
         )
         opt_shardings = jax.tree_util.tree_map(
-            lambda spec: NamedSharding(self.mesh, spec), self._opt_spec,
+            self.layout.sharding, self._opt_spec,
             is_leaf=lambda x: isinstance(x, P),
         )
         self.opt_state = jax.device_put(
@@ -385,7 +411,7 @@ class PipelineTrainer:
             # contract: per-update bookkeeping lives in the carry)
             guard0 = jax.device_put(
                 {"nonfinite_count": jnp.zeros((), jnp.int32)},
-                NamedSharding(self.mesh, P()),
+                self.layout.replicated,
             )
             self.opt_state = (self.opt_state, guard0)
             self._opt_spec = (self._opt_spec, {"nonfinite_count": P()})
@@ -406,7 +432,7 @@ class PipelineTrainer:
         """Sharding for one step's ``(M, global_mb, ...)`` microbatch
         pytree: microbatch rows replicated across stages, the per-row
         batch axis sharded over the data axis."""
-        return NamedSharding(self.mesh, P(None, self.data_axis))
+        return self.layout.sharding(P(None, self.data_axis))
 
     @property
     def scan_batch_sharding(self) -> NamedSharding:
@@ -414,9 +440,8 @@ class PipelineTrainer:
         what :meth:`train_steps_batches` expects."""
         from tpu_syncbn.parallel import scan_driver
 
-        return NamedSharding(
-            self.mesh,
-            scan_driver.stack_batch_spec(P(None, self.data_axis)),
+        return self.layout.sharding(
+            scan_driver.stack_batch_spec(P(None, self.data_axis))
         )
 
     # -- step body --------------------------------------------------------

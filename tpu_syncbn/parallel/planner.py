@@ -2,9 +2,10 @@
 compiling, pick the fastest layout, feed the autopilot planned
 candidates.
 
-Every parallelism decision this stack exposes — DP vs DP+ZeRO, tensor
-degree, pipeline stage count N / schedule / microbatch count M, scan
-chunk K, wire compression — was until now chosen by a human, even
+Every parallelism decision this stack exposes — DP vs DP+ZeRO, the
+composed DP×FSDP (``SpecLayout.fsdp``) and DP×TP factorizations,
+tensor degree, pipeline stage count N / schedule / microbatch count M,
+scan chunk K, wire compression — was until now chosen by a human, even
 though the audit layer already computes everything a first-order cost
 model needs *without compiling anything*: per-device collective bytes
 and peak memory from :func:`tpu_syncbn.audit.contracts.extract_contract`,
@@ -63,7 +64,12 @@ import math
 import time
 from typing import Any, Callable, Sequence
 
-from tpu_syncbn.mesh_axes import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
+from tpu_syncbn.mesh_axes import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+)
 from tpu_syncbn.parallel import pipeline_schedule
 
 #: The compression surface the planner enumerates (CLI spelling:
@@ -148,7 +154,7 @@ class Candidate:
     share one traced program and differ only in the host share)."""
 
     name: str
-    kind: str  # "dp" | "dp_zero" | "pipeline" | "tensor"
+    kind: str  # "dp" | "dp_zero" | "dp_fsdp" | "dp_tensor" | "pipeline" | "tensor"
     mesh_axes: tuple[tuple[str, int], ...]
     compress: str = "fp32"
     scan_k: int = 1
@@ -401,11 +407,11 @@ def _stack_module(stack: LayerStack):
 
 
 def _dp_spec(model: Any, batch_shape: tuple, *, zero: bool,
-             compress: str):
+             compress: str, layout: Any | None = None,
+             name: str | None = None):
     import jax
     import jax.numpy as jnp
     import optax
-    from jax.sharding import PartitionSpec as P
 
     from tpu_syncbn import parallel
     from tpu_syncbn.audit.jaxpr_audit import ProgramSpec
@@ -415,20 +421,20 @@ def _dp_spec(model: Any, batch_shape: tuple, *, zero: bool,
     dp = parallel.DataParallel(
         module, optax.sgd(0.1, momentum=0.9), _sq_loss,
         compress=("none" if compress == "fp32" else compress),
-        zero=zero, monitors=False,
+        zero=zero, layout=layout, monitors=False,
     )
     kind = "dp_zero" if zero else "dp"
     batch = jax.ShapeDtypeStruct(batch_shape, jnp.float32)
     return ProgramSpec(
-        name=f"planner.{kind}.{compress}",
+        name=name if name is not None else f"planner.{kind}.{compress}",
         fn=dp._train_step,
         example_args=(dp._param_store, dp.rest, dp.opt_state, batch),
         arg_labels=("params", "rest", "opt_state", "batch"),
         declared_donated=("params", "opt_state"),
-        world=dp.world,
+        world=int(dp.mesh.size),
         mesh=dp.mesh,
         in_specs=(dp._pspec, dp._rest_spec, dp._opt_spec,
-                  P(dp.axis_name)),
+                  dp.layout.batch_spec),
     )
 
 
@@ -438,7 +444,7 @@ def _pipeline_spec(stack: LayerStack, batch_shape: tuple, *,
     import jax.numpy as jnp
     import numpy as np
     import optax
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from tpu_syncbn.audit.jaxpr_audit import ProgramSpec
     from tpu_syncbn.parallel import pipeline
@@ -446,9 +452,7 @@ def _pipeline_spec(stack: LayerStack, batch_shape: tuple, *,
     n, m = n_stages, microbatches
     per_stage = stack.n_layers // n
     d, h = stack.d_model, stack.d_hidden
-    devs = np.array(jax.devices())
-    mesh = Mesh(devs.reshape(devs.size // n, n),
-                (DATA_AXIS, PIPE_AXIS))
+    mesh = pipeline.pipeline_mesh(n)
 
     def stage_fn(params, x):
         for i in range(per_stage):
@@ -485,7 +489,7 @@ def _pipeline_spec(stack: LayerStack, batch_shape: tuple, *,
         example_args=(tr._param_store, tr.opt_state, batch),
         arg_labels=("params", "opt_state", "batch"),
         declared_donated=("params", "opt_state"),
-        world=int(devs.size),
+        world=int(mesh.size),
         mesh=mesh,
         in_specs=(tr._pspec, tr._opt_spec, P(None, DATA_AXIS)),
     )
@@ -494,15 +498,15 @@ def _pipeline_spec(stack: LayerStack, batch_shape: tuple, *,
 def _tensor_spec(stack: LayerStack, batch_shape: tuple):
     import jax
     import jax.numpy as jnp
-    import numpy as np
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from tpu_syncbn import compat
     from tpu_syncbn.audit.jaxpr_audit import ProgramSpec
     from tpu_syncbn.compat import shard_map
     from tpu_syncbn.parallel import tensor
+    from tpu_syncbn.runtime import distributed as dist
 
-    mesh = Mesh(np.array(jax.devices()), (MODEL_AXIS,))
+    mesh = dist.make_mesh({MODEL_AXIS: -1})
     world = int(mesh.shape[MODEL_AXIS])
     d, h, n_layers = stack.d_model, stack.d_hidden, stack.n_layers
 
@@ -540,6 +544,59 @@ def _tensor_spec(stack: LayerStack, batch_shape: tuple):
     )
 
 
+def _dp_tensor_spec(stack: LayerStack, batch_shape: tuple, *,
+                    data: int, model_ways: int):
+    """Composed DP×TP: the :meth:`SpecLayout.tensor_parallel` 2-D mesh,
+    batch sharded over ``data``, each block's hidden dim sharded over
+    ``model`` — the 1-D :func:`_tensor_spec` program lifted onto the
+    composed layout (separate builder so the 1-D golden stays pinned)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_syncbn import compat
+    from tpu_syncbn.audit.jaxpr_audit import ProgramSpec
+    from tpu_syncbn.compat import shard_map
+    from tpu_syncbn.parallel import tensor
+    from tpu_syncbn.parallel.layout import SpecLayout, P
+
+    lay = SpecLayout.tensor_parallel(data=data, model=model_ways,
+                                     rules=())
+    d, h, n_layers = stack.d_model, stack.d_hidden, stack.n_layers
+
+    def fwd(x, w1, b1, w2, b2):
+        for i in range(n_layers):
+            x = x + tensor.tp_mlp(x, w1[i], b1[i], w2[i], b2[i])
+        return x
+
+    in_specs = (lay.batch_spec, P(None, None, MODEL_AXIS),
+                P(None, MODEL_AXIS), P(None, MODEL_AXIS, None), P())
+    sharded = shard_map(
+        fwd, mesh=lay.mesh, in_specs=in_specs,
+        out_specs=lay.batch_spec, check_vma=compat.HAS_VMA,
+    )
+
+    def train(x, w1, b1, w2, b2):
+        def loss(ws):
+            return (sharded(x, *ws) ** 2).mean()
+
+        return jax.grad(loss)((w1, b1, w2, b2))
+
+    fn = jax.jit(train)
+    sds = jax.ShapeDtypeStruct
+    args = (
+        sds(batch_shape, jnp.float32),
+        sds((n_layers, d, h), jnp.float32),
+        sds((n_layers, h), jnp.float32),
+        sds((n_layers, h, d), jnp.float32),
+        sds((n_layers, d), jnp.float32),
+    )
+    return ProgramSpec(
+        name=f"planner.dp_tp.d{data}.m{model_ways}", fn=fn,
+        example_args=args, arg_labels=("x", "w1", "b1", "w2", "b2"),
+        world=lay.world, mesh=lay.mesh, in_specs=in_specs,
+    )
+
+
 # ---------------------------------------------------------------------------
 # enumeration
 
@@ -572,7 +629,7 @@ def enumerate_candidates(
         )
     stack = model if isinstance(model, LayerStack) else None
     wanted = set(include) if include is not None else {
-        "dp", "dp_zero", "pipeline", "tensor",
+        "dp", "dp_zero", "dp_fsdp", "dp_tensor", "pipeline", "tensor",
     }
     out: list[Candidate] = []
     rejected: list[PlannedCandidate] = []
@@ -591,6 +648,65 @@ def enumerate_candidates(
                 name=f"zero.fp32.k{k}", kind="dp_zero",
                 mesh_axes=dp_axes, scan_k=int(k),
             ))
+
+    if "dp_fsdp" in wanted:
+        # every (D, F) factorization of the world with a real shard
+        # axis — F == world is ZeRO-over-a-2D-spelling and still a
+        # distinct traced program (batch over ('data','fsdp'))
+        from tpu_syncbn.parallel.layout import _INT8_MAX_WORLD
+
+        for f in (f for f in range(2, world + 1) if world % f == 0):
+            d = world // f
+            for mode in compress_modes:
+                for k in scan_ks:
+                    cand = Candidate(
+                        name=f"fsdp.{mode}.d{d}f{f}.k{k}",
+                        kind="dp_fsdp",
+                        mesh_axes=((DATA_AXIS, d), (FSDP_AXIS, f)),
+                        compress=mode, scan_k=int(k),
+                    )
+                    if batch % world:
+                        rejected.append(_reject(
+                            cand, f"layout: batch {batch} does not "
+                            f"divide over the {world}-device composed "
+                            f"('data','fsdp') batch axes"))
+                    elif mode == "int8" and f > _INT8_MAX_WORLD:
+                        rejected.append(_reject(
+                            cand, "layout: int8 accumulator budget "
+                            f"needs shard world <= {_INT8_MAX_WORLD}, "
+                            f"got {f}"))
+                    elif mode == "int8" and d > _INT8_MAX_WORLD:
+                        rejected.append(_reject(
+                            cand, "layout: int8 accumulator budget "
+                            f"needs reduce world <= {_INT8_MAX_WORLD}, "
+                            f"got {d}"))
+                    else:
+                        out.append(cand)
+
+    if "dp_tensor" in wanted:
+        # composed DP×TP factorizations with both axes real (M == world
+        # is the 1-D "tensor" kind below)
+        for m in (m for m in range(2, world) if world % m == 0):
+            d = world // m
+            cand = Candidate(
+                name=f"dp_tp.d{d}.m{m}", kind="dp_tensor",
+                mesh_axes=((DATA_AXIS, d), (MODEL_AXIS, m)),
+            )
+            if stack is None:
+                rejected.append(_reject(
+                    cand, "model: dp×tensor candidates need a "
+                    "LayerStack description (opaque module cannot be "
+                    "re-sharded)"))
+            elif stack.d_hidden % m:
+                rejected.append(_reject(
+                    cand, f"layout: hidden dim {stack.d_hidden} does "
+                    f"not divide over the {m}-way model axis"))
+            elif batch % d:
+                rejected.append(_reject(
+                    cand, f"layout: batch {batch} does not divide "
+                    f"over the {d}-way data axis"))
+            else:
+                out.append(cand)
 
     if "pipeline" in wanted:
         counts = (
@@ -741,14 +857,33 @@ def plan(
 
     def spec_for(cand: Candidate):
         # scan-K variants share one traced program (K-invariant
-        # contract), so the build key deliberately drops scan_k
-        key = (cand.kind, cand.compress, cand.n_stages, cand.schedule,
-               cand.microbatches)
+        # contract), so the build key deliberately drops scan_k; it
+        # keeps mesh_axes so composed (D, F) / (D, M) factorizations
+        # of the same kind stay distinct programs
+        key = (cand.kind, cand.mesh_axes, cand.compress, cand.n_stages,
+               cand.schedule, cand.microbatches)
         if key not in spec_memo:
             if cand.kind in ("dp", "dp_zero"):
                 spec_memo[key] = _dp_spec(
                     model, batch_shape, zero=cand.kind == "dp_zero",
                     compress=cand.compress,
+                )
+            elif cand.kind == "dp_fsdp":
+                from tpu_syncbn.parallel.layout import SpecLayout
+
+                axes = dict(cand.mesh_axes)
+                d, f = axes[DATA_AXIS], axes[FSDP_AXIS]
+                spec_memo[key] = _dp_spec(
+                    model, batch_shape, zero=False,
+                    compress=cand.compress,
+                    layout=SpecLayout.fsdp(data=d, fsdp=f),
+                    name=f"planner.fsdp.{cand.compress}.d{d}f{f}",
+                )
+            elif cand.kind == "dp_tensor":
+                axes = dict(cand.mesh_axes)
+                spec_memo[key] = _dp_tensor_spec(
+                    model, batch_shape, data=axes[DATA_AXIS],
+                    model_ways=axes[MODEL_AXIS],
                 )
             elif cand.kind == "pipeline":
                 spec_memo[key] = _pipeline_spec(

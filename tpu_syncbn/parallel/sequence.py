@@ -48,7 +48,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_syncbn.compat import axis_size as _compat_axis_size
 from tpu_syncbn.parallel.collectives import pcast_varying
@@ -494,7 +494,10 @@ def sharded_self_attention(
         out_specs=seq_sharded,
         check_vma=check_vma,
     )
-    put = lambda x: jax.device_put(x, NamedSharding(mesh, seq_sharded))
+    from tpu_syncbn.parallel.layout import SpecLayout
+
+    seq_layout = SpecLayout.from_mesh(mesh, param_shard_axis=None)
+    put = lambda x: jax.device_put(x, seq_layout.sharding(seq_sharded))
     out = shard_fn(put(q), put(k), put(v))
     if impl == "ring_zigzag":
         out = zigzag_unshard(out, n)

@@ -119,6 +119,26 @@ def _pallas_forces_vma_off(*models: nnx.Module) -> bool:
     return any(_model_traces_pallas_bn(m) for m in models)
 
 
+def _rewire_syncbn_axes(model: nnx.Module, axes: tuple) -> None:
+    """Point every default-axis SyncBatchNorm at the composed layout's
+    stat axes: the paper's contract is that BN statistics sync over ALL
+    batch replicas, and a composed layout shards the batch over more
+    than one mesh axis — a module still syncing over ``'data'`` alone
+    would compute partial statistics. Modules carrying a non-default
+    axis are left alone (deliberate sub-world scoping)."""
+    from tpu_syncbn.nn.normalization import SyncBatchNorm
+
+    for _, node in nnx.iter_graph(model):
+        if isinstance(node, SyncBatchNorm) and node.axis_name == DATA_AXIS:
+            if node.group_size is not None:
+                raise ValueError(
+                    "group-scoped SyncBN cannot ride a composed layout: "
+                    "the butterfly group reduction is single-axis "
+                    f"(module syncs groups of {node.group_size})"
+                )
+            node.axis_name = axes
+
+
 def _stats_replicated_by_construction(model: nnx.Module) -> bool:
     """True when every non-Param Variable in the model is owned by a
     full-world SyncBatchNorm: such stats are computed from psum'd global
@@ -213,6 +233,7 @@ class DataParallel:
         *,
         mesh: Mesh | None = None,
         axis_name: str = DATA_AXIS,
+        layout: Any | None = None,
         broadcast_buffers: bool | str = "auto",
         accum_steps: int = 1,
         donate: bool = True,
@@ -357,8 +378,59 @@ class DataParallel:
         self.remat = remat
         self.grad_compression = grad_compression
         self._model = model
-        self.mesh = mesh if mesh is not None else dist.data_parallel_mesh()
-        self.axis_name = axis_name
+        from tpu_syncbn.parallel.layout import SpecLayout
+
+        # The SpecLayout owns the mesh and every derived reduce/scatter
+        # axis (ROADMAP item 1). The legacy kwargs remain the
+        # single-axis surface: no layout → plain DP (or the ZeRO preset
+        # when zero=True) on the historical 1-D data mesh, byte-identical
+        # programs. A composed layout (SpecLayout.fsdp(...)) shards the
+        # batch over P(('data','fsdp')) and the flat param/opt store over
+        # the fsdp axis only.
+        if layout is None:
+            if mesh is not None:
+                layout = SpecLayout.from_mesh(
+                    mesh, param_shard_axis=axis_name if zero else "auto"
+                )
+            elif zero:
+                layout = SpecLayout.zero()
+            else:
+                layout = SpecLayout.data_parallel()
+        else:
+            if mesh is not None and mesh != layout.mesh:
+                raise ValueError(
+                    "pass either layout= or mesh=, not both — the layout "
+                    "owns the mesh"
+                )
+            if zero and layout.param_shard_axis is None:
+                raise ValueError(
+                    "zero=True needs a param-sharding layout: use "
+                    "SpecLayout.zero() or SpecLayout.fsdp()"
+                )
+        layout.check(compress=compress)
+        if layout.rules:
+            if monitors:
+                raise ValueError(
+                    "tensor-parallel param rules currently require "
+                    "monitors=False (the grad monitors assume replicated "
+                    "or flat-sharded params)"
+                )
+            if self._ef:
+                raise ValueError(
+                    "tensor-parallel param rules do not compose with "
+                    "error feedback (the residual store assumes "
+                    "replicated param shapes) — pass error_feedback=False"
+                )
+        self.layout = layout
+        self.mesh = layout.mesh
+        #: the mesh axis — or tuple of axes under a composed layout —
+        #: every batch-scoped reduction (grad reduce, SyncBN stats,
+        #: loss/metric pmean, guard consensus) runs over
+        self.axis_name = (
+            layout.stat_axes if layout.stat_axes is not None else axis_name
+        )
+        if isinstance(self.axis_name, tuple):
+            _rewire_syncbn_axes(model, self.axis_name)
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.accum_steps = accum_steps
@@ -384,14 +456,23 @@ class DataParallel:
         # drive: stay off.
         self._check_vma = compat.HAS_VMA and not _pallas_forces_vma_off(model)
 
-        self.zero = bool(zero)
+        self.zero = layout.param_shard_axis is not None
         self.graphdef, params, rest = nnx.split(model, nnx.Param, ...)
         self.rest = rest  # BatchStats + any other non-Param state
 
-        self.batch_sharding = NamedSharding(self.mesh, P(axis_name))
-        self._replicated = NamedSharding(self.mesh, P())
-        self._per_replica = NamedSharding(self.mesh, P(axis_name))
-        self.world = int(self.mesh.shape[axis_name])
+        self.batch_sharding = layout.batch_sharding
+        self._replicated = layout.replicated
+        self._per_replica = layout.sharding(P(self.axis_name))
+        #: total batch replicas — the gradient-mean divisor; the product
+        #: of the batch axes under a composed layout
+        self.world = layout.replica_world
+        #: flat param/opt shard axis and its size (ZeRO/FSDP): the whole
+        #: data axis for the zero preset, the dedicated fsdp axis when
+        #: composed
+        self._shard_axis = layout.grad_scatter_axis
+        self._shard_world = layout.shard_world
+        #: batch axes left to psum after the gradient reduce-scatter
+        self._cross_axes = layout.grad_cross_axes
 
         # put state on the mesh once. Params/opt replicated (or flat +
         # 1/world-sharded under zero); buffers replicated when
@@ -404,10 +485,13 @@ class DataParallel:
             from tpu_syncbn.parallel.zero import FlatLayout, check_elementwise
 
             check_elementwise(optimizer)
-            self._layout = FlatLayout(params, self.world)
-            self._pspec = {dt: P(axis_name) for dt in self._layout.groups}
+            self._layout = FlatLayout(params, self._shard_world)
+            self._pspec = {
+                dt: P(self._shard_axis) for dt in self._layout.groups
+            }
+            self._store_sharding = layout.sharding(P(self._shard_axis))
             self._param_store = jax.device_put(
-                self._layout.flatten(params), self._per_replica
+                self._layout.flatten(params), self._store_sharding
             )
             # optimizer state is born sharded: init runs per-shard under
             # shard_map; vector leaves (moments etc., shaped like the
@@ -419,7 +503,8 @@ class DataParallel:
             }
             opt_shapes = jax.eval_shape(optimizer.init, shard_tpl)
             self._opt_spec = jax.tree_util.tree_map(
-                lambda l: P() if l.ndim == 0 else P(axis_name), opt_shapes
+                lambda l: P() if l.ndim == 0 else P(self._shard_axis),
+                opt_shapes,
             )
             init_sharded = shard_map(
                 optimizer.init,
@@ -429,6 +514,23 @@ class DataParallel:
                 check_vma=self._check_vma,
             )
             self.opt_state = jax.jit(init_sharded)(self._param_store)
+        elif layout.rules:
+            # tensor-parallel rules: per-param specs from the layout's
+            # wildcard matching; the optimizer state inherits the param
+            # shardings through the compiler (elementwise init
+            # propagates input shardings; scalar leaves replicate)
+            self._pspec = layout.param_specs(params)
+            shardings = jax.tree_util.tree_map(
+                layout.sharding, self._pspec,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            self._param_store = jax.device_put(params, shardings)
+            self.opt_state = jax.jit(
+                optimizer.init, in_shardings=(shardings,)
+            )(self._param_store)
+            self._opt_spec = jax.tree_util.tree_map(
+                lambda a: a.sharding.spec, self.opt_state
+            )
         else:
             self._pspec = P()
             self._opt_spec = P()
@@ -481,7 +583,11 @@ class DataParallel:
             self.opt_state = (
                 self.opt_state, jax.device_put(res0, self._per_replica)
             )
-            self._opt_spec = (self._opt_spec, P(axis_name))
+            # self.axis_name, not the ctor arg: under a composed layout
+            # the per-replica store spans ALL batch axes — a 'data'-only
+            # spec would silently share residuals across the fsdp axis
+            # (and shrink the stored leading dim, breaking state_dict)
+            self._opt_spec = (self._opt_spec, P(self.axis_name))
         if broadcast_buffers:
             self.rest = jax.device_put(self.rest, self._replicated)
         else:
@@ -492,7 +598,7 @@ class DataParallel:
                 ),
                 self._per_replica,
             )
-        self._rest_spec = P() if broadcast_buffers else P(axis_name)
+        self._rest_spec = P() if broadcast_buffers else P(self.axis_name)
 
         self._donate = donate
         self._train_step = self._build_train_step(donate)
@@ -556,10 +662,12 @@ class DataParallel:
         return loss, metrics, new_rest, grads, numx
 
     def _gather_params(self, store):
-        """ZeRO path: rebuild the full (device-varying) param tree from
-        this device's flat shards — ONE all_gather per dtype group."""
+        """ZeRO/FSDP path: rebuild the full param tree from this
+        device's flat shards — ONE all_gather per dtype group, over the
+        shard axis only (a composed layout's data axis already holds the
+        value replicated)."""
         full = {
-            dt: collectives.all_gather(v, self.axis_name, axis=0, tiled=True)
+            dt: collectives.all_gather(v, self._shard_axis, axis=0, tiled=True)
             for dt, v in store.items()
         }
         return self._layout.unflatten(full)
@@ -711,6 +819,9 @@ class DataParallel:
                     )
                 ccol_ctx = obs_numerics.collect(enabled=bool(self.monitors))
 
+                shard_axis = self._shard_axis
+                cross = self._cross_axes
+
                 def scatter(dt, g):
                     floating = jnp.issubdtype(g.dtype, jnp.floating)
                     if self.compress != "none" and floating:
@@ -721,21 +832,38 @@ class DataParallel:
                         if self._ef:
                             p = p + ef_in[dt]
                         shard, res = collectives.compressed_reduce_scatter(
-                            p, axis, mode=self.compress,
+                            p, shard_axis, mode=self.compress,
                             want_residual=self._ef,
                         )
                         if self._ef:
                             new_ef[dt] = res
+                        if cross:
+                            # composed layout: finish the reduction over
+                            # the remaining batch axes on the 1/F shard
+                            # — the wire bytes were already cut by the
+                            # scatter, and the compressed wire stays
+                            # legal over the cross axes. (EF covers the
+                            # scatter stage only; the cross stage's
+                            # quantization error is unfed — int8
+                            # composed is convergence-tested, not
+                            # bit-parity-pinned.)
+                            shard = collectives.compressed_psum(
+                                shard, cross, mode=self.compress
+                            )
                         return (shard / self.world).astype(g.dtype)
                     if self._ef:
                         new_ef[dt] = ef_in[dt]  # exact group: no error
                     if self.grad_compression == "bf16":
                         d = g.dtype
                         g = collectives.reduce_scatter(
-                            g.astype(jnp.bfloat16), axis
+                            g.astype(jnp.bfloat16), shard_axis
                         ).astype(d)
                     else:
-                        g = collectives.reduce_scatter(g, axis)
+                        g = collectives.reduce_scatter(g, shard_axis)
+                    if cross:
+                        # exact completion of the mean over the other
+                        # batch axes, on shard-sized operands
+                        g = collectives.psum(g, cross)
                     return g / self.world
 
                 with ccol_ctx as ccol:
@@ -751,9 +879,11 @@ class DataParallel:
                         numx["ef_residual_ratio"] = obs_numerics.residual_ratio(
                             new_ef, numx["replica_grad_norm"]
                         )
-                    # shards only: one scalar device-side psum globalizes
+                    # shards only: one scalar device-side psum (over the
+                    # shard axis — the cross axes already hold the
+                    # reduced value replicated) globalizes
                     monitors.update(obs_stepstats.grad_monitors(
-                        gshard, axis, sharded=True
+                        gshard, shard_axis, sharded=True
                     ))
                 updates, opt_state = self.optimizer.update(
                     gshard, opt_state, pstore
@@ -984,8 +1114,8 @@ class DataParallel:
         produces."""
         from tpu_syncbn.parallel import scan_driver
 
-        return NamedSharding(
-            self.mesh, scan_driver.stack_batch_spec(P(self.axis_name))
+        return self.layout.sharding(
+            scan_driver.stack_batch_spec(P(self.axis_name))
         )
 
     def train_steps_batches(self, batches) -> StepOutput:
@@ -1049,7 +1179,7 @@ class DataParallel:
     def params(self, tree):
         if self.zero:
             self._param_store = jax.device_put(
-                self._layout.flatten(tree), self._per_replica
+                self._layout.flatten(tree), self._store_sharding
             )
         else:
             self._param_store = jax.device_put(tree, self._replicated)
@@ -1232,7 +1362,8 @@ class DataParallel:
                     "zero=True opt_state layout mismatch: this checkpoint "
                     "was saved with a different world size (flat shard "
                     "padding is world-dependent). Resume on the same "
-                    f"world ({self.world}) or retrain the optimizer state."
+                    f"shard world ({self._shard_world}) or retrain the "
+                    "optimizer state."
                 )
         self.params = state["params"]  # setter re-shards per mode
         rest_sharding = (
@@ -1241,7 +1372,7 @@ class DataParallel:
         self.rest = jax.device_put(state["rest"], rest_sharding)
         if self.zero:
             shardings = jax.tree_util.tree_map(
-                lambda spec: NamedSharding(self.mesh, spec), self._opt_spec,
+                self.layout.sharding, self._opt_spec,
                 is_leaf=lambda x: isinstance(x, P),
             )
             self.opt_state = jax.device_put(state["opt_state"], shardings)

@@ -114,6 +114,7 @@ class InferenceEngine:
         *,
         mesh=None,
         axis_name: str = DATA_AXIS,
+        layout=None,
         apply_fn: Callable[[Any, Any], Any] | None = None,
         buckets: Sequence[int] = (8, 32, 128),
         program_cache_bytes: int | None = None,
@@ -121,15 +122,30 @@ class InferenceEngine:
     ):
         import jax
         from flax import nnx
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import PartitionSpec as P
 
         from tpu_syncbn import compat
+        from tpu_syncbn.parallel.layout import SpecLayout
         from tpu_syncbn.parallel.trainer import _pallas_forces_vma_off
         from tpu_syncbn.runtime import distributed as dist
 
-        self.mesh = mesh if mesh is not None else dist.data_parallel_mesh()
-        self.axis_name = axis_name
-        self.world = int(self.mesh.shape[axis_name])
+        if layout is None:
+            layout = SpecLayout.from_mesh(
+                mesh if mesh is not None else dist.data_parallel_mesh(),
+                param_shard_axis=None,
+            )
+        elif mesh is not None and mesh != layout.mesh:
+            raise ValueError(
+                "InferenceEngine: both mesh= and layout= given and they "
+                "disagree — pass the layout alone (it carries its mesh)"
+            )
+        self.layout = layout
+        self.mesh = layout.mesh
+        self.axis_name = (
+            layout.batch_entry if layout.batch_entry is not None
+            else axis_name
+        )
+        self.world = int(layout.replica_world)
         self._apply_fn = apply_fn if apply_fn is not None else (
             lambda m, b: m(b)
         )
@@ -149,11 +165,32 @@ class InferenceEngine:
         # device-put state, so the host-side param tree can be freed.
         model.eval()
         self.graphdef, params, rest = nnx.split(model, nnx.Param, ...)
-        self._replicated = NamedSharding(self.mesh, P())
-        self.batch_sharding = NamedSharding(self.mesh, P(axis_name))
+        self._replicated = layout.replicated
+        self.batch_sharding = layout.batch_sharding
         # restore/reshard once: whatever layout the state arrived in
         # (host pytree from unshard_params, trainer-replicated arrays),
-        # serving storage is replicated on THIS mesh.
+        # serving storage is owned by THIS mesh. Under a param-sharding
+        # layout (fsdp-composed trainers) the params are stored as flat
+        # 1/shard_world dtype-group shards — the eval program gathers
+        # them on the wire, so no device ever holds a replicated copy
+        # (the max_replicated_bytes the sharding goldens pin shrinks
+        # accordingly). Otherwise params replicate as before.
+        self._shard_axis = layout.param_shard_axis
+        self._shard_world = int(layout.shard_world)
+        if self._shard_axis is not None:
+            from tpu_syncbn.parallel.zero import FlatLayout
+
+            self._flat = FlatLayout(params, self._shard_world)
+            self._store_sharding = layout.sharding(P(self._shard_axis))
+            # full-tree structure template: swap_params validates
+            # incoming trees against the model, not the flat store
+            self._param_template_specs = self._struct_specs(params)
+            params_store = self._own_store(self._flat.flatten(params))
+        else:
+            self._flat = None
+            self._store_sharding = self._replicated
+            self._param_template_specs = None
+            params_store = self._own_replicated(params)
         # Versioned storage: ONE attribute holds (version, params, rest)
         # so a predict call captures a consistent triple with a single
         # atomic read — in-flight batches finish on the version they
@@ -161,7 +198,7 @@ class InferenceEngine:
         # (the double-buffer half of serve.publish's zero-downtime swap)
         self._state: tuple[int, Any, Any] = (
             0,
-            self._own_replicated(params),
+            params_store,
             self._own_replicated(rest),
         )
         self._previous: tuple[int, Any, Any] | None = None
@@ -238,6 +275,28 @@ class InferenceEngine:
 
         return jax.tree_util.tree_map(one, tree)
 
+    def _own_store(self, vecs):
+        """``device_put`` flat param vectors to the sharded serving
+        layout (``P(shard_axis)``), with the same copy-on-alias
+        ownership rule as :meth:`_own_replicated`."""
+        import jax
+
+        def one(leaf):
+            arr = jax.device_put(leaf, self._store_sharding)
+            return arr.copy() if arr is leaf else arr
+
+        return {dt: one(v) for dt, v in vecs.items()}
+
+    def param_template(self):
+        """The serving parameters as a FULL pytree (the model's
+        structure) regardless of storage layout — the checkpoint/
+        publication template. Replicated engines return the store
+        itself; sharded engines gather the flat shards through host
+        memory (publication load is a host path anyway)."""
+        if self._flat is None:
+            return self._params
+        return self._flat.unflatten_host(self._params)
+
     def params_nbytes(self) -> int:
         """Per-device bytes of the replicated serving state (params +
         rest) — what a swap's transient double-buffer adds on top while
@@ -245,10 +304,17 @@ class InferenceEngine:
         in :mod:`tpu_syncbn.serve.publish`)."""
         import jax
 
-        return sum(
-            int(getattr(l, "nbytes", np.asarray(l).nbytes))
-            for l in jax.tree_util.tree_leaves((self._params, self._rest))
-        )
+        def total(tree):
+            return sum(
+                int(getattr(l, "nbytes", np.asarray(l).nbytes))
+                for l in jax.tree_util.tree_leaves(tree)
+            )
+
+        pb = total(self._params)
+        if self._flat is not None:
+            # flat store: each device holds a 1/shard_world slice
+            pb //= self._shard_world
+        return pb + total(self._rest)
 
     def swap_params(self, params, rest=None, *, version: int) -> int:
         """Atomically replace the serving weights with ``params`` (and
@@ -269,13 +335,23 @@ class InferenceEngine:
 
         with self._swap_lock:
             old = self._state
-            if self._struct_specs(params) != self._struct_specs(old[1]):
+            # sharded store: validate against the model's FULL tree
+            # template (the flat shards are an internal layout), then
+            # flatten and re-shard; replicated store compares directly
+            expect = (
+                self._param_template_specs if self._flat is not None
+                else self._struct_specs(old[1])
+            )
+            if self._struct_specs(params) != expect:
                 raise VersionSkewError(
                     "swap_params: new params tree does not match the "
                     "serving structure (treedef/shape/dtype) — "
                     "publisher schema skew; swap rejected"
                 )
-            new_params = self._own_replicated(params)
+            if self._flat is not None:
+                new_params = self._own_store(self._flat.flatten(params))
+            else:
+                new_params = self._own_replicated(params)
             if rest is not None:
                 if self._struct_specs(rest) != self._struct_specs(old[2]):
                     raise VersionSkewError(
@@ -331,10 +407,20 @@ class InferenceEngine:
         points there."""
         from tpu_syncbn.runtime import distributed as dist
 
+        # composed layouts (anything beyond the 1-D data mesh) flow
+        # through whole: the engine derives its batch spec from the
+        # layout, and a param-sharding (fsdp) layout makes the engine
+        # store flat shards instead of a replicated copy — the
+        # satellite bugfix that shrinks the pinned max_replicated_bytes
+        # for fsdp-composed trainers. Plain 1-D trainers keep the
+        # byte-identical legacy replicated path.
+        tl = getattr(trainer, "layout", None)
+        if ("layout" not in kwargs and "mesh" not in kwargs
+                and "axis_name" not in kwargs and tl is not None
+                and tuple(tl.mesh.axis_names) != (DATA_AXIS,)):
+            kwargs["layout"] = tl
         mesh = kwargs.get("mesh", trainer.mesh)
-        axis = kwargs.get("axis_name", getattr(trainer, "axis_name",
-                                               DATA_AXIS))
-        if int(mesh.shape[axis]) > 1:
+        if int(mesh.size) > 1:
             dist.get_logger("tpu_syncbn.serve").warning(
                 "InferenceEngine.from_trainer on a %d-device mesh "
                 "gathers the full parameter tree through host memory — "
@@ -342,11 +428,14 @@ class InferenceEngine:
                 "zero-downtime publication path instead "
                 "(tpu_syncbn.serve.publish.SwapController.swap_from_"
                 "trainer: on-mesh redistribution + hot swap, no host "
-                "gather, no restart).", int(mesh.shape[axis]),
+                "gather, no restart).", int(mesh.size),
             )
         model = trainer.sync_to_model()
-        kwargs.setdefault("mesh", trainer.mesh)
-        kwargs.setdefault("axis_name", getattr(trainer, "axis_name", DATA_AXIS))
+        if "layout" not in kwargs:
+            kwargs.setdefault("mesh", trainer.mesh)
+            kwargs.setdefault(
+                "axis_name", getattr(trainer, "axis_name", DATA_AXIS)
+            )
         return cls(model, **kwargs)
 
     # -- buckets / programs ------------------------------------------------
@@ -387,16 +476,33 @@ class InferenceEngine:
 
         from tpu_syncbn import compat
         from tpu_syncbn.compat import shard_map
+        from tpu_syncbn.parallel import collectives
+
+        flat, shard_axis = self._flat, self._shard_axis
 
         def fwd(params, rest, b):
+            if flat is not None:
+                # flat 1/shard_world store: ONE all_gather per dtype
+                # group rebuilds the tree inside the program — params
+                # cross the wire once per call instead of living
+                # replicated on every device
+                params = flat.unflatten({
+                    dt: collectives.all_gather(v, shard_axis, axis=0,
+                                               tiled=True)
+                    for dt, v in params.items()
+                })
             model = compat.nnx_merge(self.graphdef, params, rest, copy=True)
             model.eval()
             return self._apply_fn(model, b)
 
+        param_spec = (
+            {dt: P(shard_axis) for dt in flat.shard_sizes}
+            if flat is not None else P()
+        )
         return shard_map(
             fwd,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(self.axis_name)),
+            in_specs=(param_spec, P(), P(self.axis_name)),
             out_specs=P(self.axis_name),
             check_vma=self._check_vma,
         )

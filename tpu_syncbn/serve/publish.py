@@ -414,7 +414,10 @@ class SwapController:
 
             params = portable_redistribute(
                 trainer._layout, trainer._param_store, trainer.mesh,
-                getattr(trainer, "axis_name", "data"),
+                # composed layouts shard over ONE axis (fsdp); the stat
+                # axis tuple is not the redistribution axis
+                getattr(trainer, "_shard_axis", None)
+                or getattr(trainer, "axis_name", "data"),
             )
         else:
             params = trainer._param_store
@@ -433,7 +436,9 @@ class SwapController:
         bytes."""
         from tpu_syncbn.utils import checkpoint as ckpt
 
-        template = {"params": self.engine._params,
+        get_template = getattr(self.engine, "param_template", None)
+        template = {"params": get_template() if get_template is not None
+                    else self.engine._params,
                     "rest": self.engine._rest}
         expect = ckpt.tree_structure_hash(
             __import__("jax").device_get(ckpt._purify(template))
